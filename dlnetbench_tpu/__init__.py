@@ -1,0 +1,31 @@
+"""dlnetbench_tpu — a TPU-native distributed-DNN-training network benchmark.
+
+A ground-up rebuild of the capabilities of HicrestLaboratory/DLNetBench
+(reference: /root/reference) for TPU pod slices.  Where the reference replays
+communication schedules of DP / FSDP / DP+PP / DP+PP+TP / DP+PP+MoE training
+with MPI/NCCL/RCCL/oneCCL collectives on GPU buffers and simulates compute
+with ``usleep`` (reference cpp/data_parallel/dp.cpp:87-106), this framework
+expresses the same schedules as jitted ``shard_map`` programs over a
+``jax.sharding.Mesh``: collectives are XLA HLOs (``psum`` / ``all_gather`` /
+``psum_scatter`` / ``all_to_all`` / ``ppermute``) riding ICI/DCN, and
+simulated compute is a calibrated on-device matmul burn kernel (host sleeps
+would serialize against async dispatch and destroy the comm/compute overlap
+the benchmark exists to measure).
+
+Beyond the reference's five proxy workloads it adds sequence/context
+parallelism proxies (ring attention, Ulysses) and a *real compute* tier:
+actual transformer / ViT / MoE model families with dp/pp/tp/sp/ep shardings,
+so the same harness can run both proxy mode and real-math mode.
+
+Layout (mirrors SURVEY.md §7):
+  core/      model cards, stat files, TPU roofline, schedule algebra
+  parallel/  mesh construction, collective wrappers, grids
+  proxies/   the benchmark workloads (dp, fsdp, hybrid_2d/3d/3d_moe, ring, ulysses)
+  models/    real model families (transformer, vit, moe)
+  ops/       attention / kernels (pallas where it pays)
+  metrics/   structured JSON emit + pandas parsers
+  analysis/  plots (scaling, Pareto)
+  data/      architecture cards + generated model_stats
+"""
+
+__version__ = "0.1.0"

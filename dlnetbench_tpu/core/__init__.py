@@ -1,0 +1,13 @@
+from dlnetbench_tpu.core.hardware import HardwareSpec, HARDWARE, DEFAULT_DEVICE
+from dlnetbench_tpu.core.model_card import ModelCard, load_model_card, list_model_cards
+from dlnetbench_tpu.core.model_stats import ModelStats, load_model_stats, stats_path
+from dlnetbench_tpu.core.roofline import roofline_time_s, model_flops, model_bytes
+from dlnetbench_tpu.core import schedule
+
+__all__ = [
+    "HardwareSpec", "HARDWARE", "DEFAULT_DEVICE",
+    "ModelCard", "load_model_card", "list_model_cards",
+    "ModelStats", "load_model_stats", "stats_path",
+    "roofline_time_s", "model_flops", "model_bytes",
+    "schedule",
+]

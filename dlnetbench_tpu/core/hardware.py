@@ -1,0 +1,76 @@
+"""Hardware roofline presets.
+
+The reference hardcodes a single modeled device — NVIDIA B200-192GB — inside
+its stats generator (reference python/model_stats.py:19-25).  Here hardware is
+a first-class table keyed by device name, TPU-first, with the B200 kept only
+as a cross-check preset so our generated stat files can be diffed against the
+reference's committed ones.
+
+Peak numbers are per-chip, dense (no sparsity), from public datasheets.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class HardwareSpec:
+    name: str
+    # peak FLOP/s by dtype key ("bfloat16", "float8", "int8", "nvfp4")
+    peak_flops: dict
+    hbm_bandwidth: float        # bytes/s
+    hbm_capacity: int           # bytes
+    # one-way ICI link bandwidth per chip (bytes/s); 0 for non-TPU devices
+    ici_bandwidth: float = 0.0
+    num_ici_links: int = 0
+
+    def peak(self, dtype: str) -> float:
+        try:
+            return self.peak_flops[dtype]
+        except KeyError:
+            raise ValueError(
+                f"{self.name} has no peak for dtype {dtype!r}; "
+                f"available: {sorted(self.peak_flops)}"
+            ) from None
+
+
+# TPU presets (per chip).  v5e = v5 lite.
+HARDWARE: dict[str, HardwareSpec] = {
+    "tpu_v4": HardwareSpec(
+        name="TPU v4",
+        peak_flops={"bfloat16": 275e12, "int8": 275e12},
+        hbm_bandwidth=1228e9, hbm_capacity=32 << 30,
+        ici_bandwidth=50e9, num_ici_links=6,
+    ),
+    "tpu_v5e": HardwareSpec(
+        name="TPU v5e",
+        peak_flops={"bfloat16": 197e12, "int8": 394e12, "float8": 394e12},
+        hbm_bandwidth=819e9, hbm_capacity=16 << 30,
+        ici_bandwidth=50e9, num_ici_links=4,
+    ),
+    "tpu_v5p": HardwareSpec(
+        name="TPU v5p",
+        peak_flops={"bfloat16": 459e12, "int8": 918e12, "float8": 918e12},
+        hbm_bandwidth=2765e9, hbm_capacity=95 << 30,
+        ici_bandwidth=100e9, num_ici_links=6,
+    ),
+    "tpu_v6e": HardwareSpec(
+        name="TPU v6e",
+        peak_flops={"bfloat16": 918e12, "int8": 1836e12, "float8": 1836e12},
+        hbm_bandwidth=1640e9, hbm_capacity=32 << 30,
+        ici_bandwidth=90e9, num_ici_links=4,
+    ),
+    # Cross-check preset matching the reference's modeled device
+    # (reference python/model_stats.py:19-25: bf16 2.25 PF, fp8 4.5 PF,
+    # nvfp4 9 PF, 8 TB/s HBM).
+    "b200": HardwareSpec(
+        name="NVIDIA B200-192GB (Single)",
+        peak_flops={"bfloat16": 2.25e15, "float8": 4.5e15, "nvfp4": 9.0e15},
+        hbm_bandwidth=8.0e12, hbm_capacity=192 << 30,
+    ),
+}
+
+DEFAULT_DEVICE = "tpu_v5p"
+
+BYTES_PER_ELEMENT = {"bfloat16": 2.0, "float8": 1.0, "float32": 4.0,
+                     "int8": 1.0, "nvfp4": 0.5}

@@ -1,0 +1,166 @@
+"""Architecture cards — the model database.
+
+The reference keeps nine JSON architecture cards under ``models/*.json`` with
+``embed_dim / num_heads / ff_dim / seq_len / num_encoder_blocks /
+num_decoder_blocks`` and optional ``moe_params`` (reference
+models/llama3_8b.json, models/mixtral_8x7b.json), consumed by
+``count_layers`` (reference cpp/utils.hpp:279-294).
+
+This rebuild keeps that JSON schema as the interop surface and extends it
+with the fields a *real* TPU implementation of each model needs (vocab size,
+KV heads for GQA, MLP family, ViT patching) — the reference never needs them
+because it does no math.  Extended fields are optional in the parser so the
+reference's own card files load unchanged.
+
+Parameter counts are computed analytically from the card (the reference
+instead downloads full HuggingFace weights just to count parameters,
+reference python/model_stats.py:144-145 — an egress + 140 GB dependency this
+rebuild deliberately drops).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+_CARD_DIR = Path(__file__).resolve().parent.parent / "data" / "models"
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEParams:
+    num_experts: int
+    num_experts_per_tok: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCard:
+    name: str
+    embed_dim: int
+    num_heads: int
+    ff_dim: int
+    seq_len: int
+    num_encoder_blocks: int = 0
+    num_decoder_blocks: int = 0
+    moe_params: MoEParams | None = None
+    # --- extended fields (rebuild only; defaults make reference cards load) ---
+    vocab_size: int = 0             # 0 for patch-input models (ViT)
+    num_kv_heads: int = 0           # 0 => MHA (kv heads == heads)
+    gated_mlp: bool = False         # SwiGLU (llama family) vs GELU 2-matmul
+    tied_embeddings: bool = False   # share input embedding with LM head
+    max_position_embeddings: int = 0  # learned positions (gpt2); 0 => RoPE/none
+    image_size: int = 0             # ViT
+    patch_size: int = 0             # ViT
+    num_classes: int = 0            # ViT head
+
+    # ------------------------------------------------------------------ #
+    @property
+    def num_layers(self) -> int:
+        """Total block count (reference cpp/utils.hpp:279-294 semantics)."""
+        return self.num_encoder_blocks + self.num_decoder_blocks
+
+    @property
+    def is_moe(self) -> bool:
+        return self.moe_params is not None
+
+    @property
+    def is_vit(self) -> bool:
+        return self.patch_size > 0
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def kv_dim(self) -> int:
+        return self.kv_heads * self.head_dim
+
+    @property
+    def num_experts(self) -> int:
+        return self.moe_params.num_experts if self.moe_params else 1
+
+    @property
+    def top_k(self) -> int:
+        return self.moe_params.num_experts_per_tok if self.moe_params else 1
+
+    # ------------------------------------------------------------------ #
+    def attn_params_per_layer(self) -> int:
+        d, dkv = self.embed_dim, self.kv_dim
+        return d * d + 2 * d * dkv + d * d  # Wq, Wk, Wv, Wo
+
+    def mlp_params_per_expert(self) -> int:
+        n_mat = 3 if self.gated_mlp else 2
+        return n_mat * self.embed_dim * self.ff_dim
+
+    def num_params(self) -> int:
+        """Analytic total parameter count (biases/norms included coarsely)."""
+        d = self.embed_dim
+        per_layer = self.attn_params_per_layer() + 2 * d  # + two norms
+        if self.is_moe:
+            per_layer += self.num_experts * self.mlp_params_per_expert()
+            per_layer += d * self.num_experts  # router
+        else:
+            per_layer += self.mlp_params_per_expert()
+        total = self.num_layers * per_layer + d  # final norm
+        if self.vocab_size:
+            total += self.vocab_size * d  # input embedding
+            if not self.tied_embeddings:
+                total += self.vocab_size * d  # LM head
+        if self.max_position_embeddings:
+            total += self.max_position_embeddings * d
+        if self.is_vit:
+            total += 3 * self.patch_size ** 2 * d        # patch embed
+            total += (self.seq_len + 1) * d              # cls + positions
+            total += d * self.num_classes                # classifier head
+        return total
+
+    def non_expert_params(self) -> int:
+        """Params NOT sharded by expert parallelism (reference
+        hybrid_3d_moe.cpp:361-363 uses this to size the two-level grad sync).
+        Zero for dense models, matching the reference stat files'
+        ``Non_Expert_size:0`` convention."""
+        if not self.is_moe:
+            return 0
+        return self.num_params() - self.num_layers * self.num_experts * \
+            self.mlp_params_per_expert()
+
+
+# ---------------------------------------------------------------------- #
+def _parse_card(name: str, raw: dict) -> ModelCard:
+    moe = None
+    if "moe_params" in raw:
+        moe = MoEParams(
+            num_experts=int(raw["moe_params"]["num_experts"]),
+            num_experts_per_tok=int(raw["moe_params"]["num_experts_per_tok"]),
+        )
+    known = {f.name for f in dataclasses.fields(ModelCard)}
+    kwargs = {k: v for k, v in raw.items() if k in known and k != "moe_params"}
+    return ModelCard(name=name, moe_params=moe, **kwargs)
+
+
+def load_model_card(name: str, card_dir: Path | str | None = None) -> ModelCard:
+    """Load ``<card_dir>/<name>.json``.  Accepts reference-format cards
+    (base fields only) as well as extended rebuild cards."""
+    d = Path(card_dir) if card_dir else _CARD_DIR
+    path = d / f"{name}.json"
+    with open(path) as f:
+        raw = json.load(f)
+    return _parse_card(name, raw)
+
+
+def list_model_cards(card_dir: Path | str | None = None) -> list[str]:
+    d = Path(card_dir) if card_dir else _CARD_DIR
+    return sorted(p.stem for p in d.glob("*.json"))
+
+
+def arch_name_from_stats_name(stats_name: str) -> str:
+    """``llama3_8b_16_bfloat16`` → ``llama3_8b`` (the reference derives the
+    arch-card path by stripping the trailing ``_<batch>_<dtype>`` suffixes,
+    reference cpp/hybrid_parallel/hybrid_2d.cpp:214-216)."""
+    parts = stats_name.split("_")
+    if len(parts) < 3:
+        raise ValueError(f"not a stats name: {stats_name!r}")
+    return "_".join(parts[:-2])

@@ -1,0 +1,86 @@
+"""Analytic roofline model for per-model compute times.
+
+The reference derives simulated compute durations from a roofline on a
+modeled B200: ``t = flops / min(peak, AI * bandwidth)`` with closed-form
+attention/MLP FLOP formulas (reference python/model_stats.py:47-50, 128-134)
+and a fixed backward/forward ratio of 2x (reference python/model_stats.py:140).
+
+This rebuild keeps the same achievable-performance model but:
+  * hardware is a preset table (TPU chips first, B200 as cross-check) —
+    see ``core.hardware``;
+  * FLOP formulas are per-family-correct: SwiGLU MLPs cost 3 matmuls
+    (6*B*N*d*H) not 2 (the reference bills every MLP as 4*B*N*d*H,
+    reference python/model_stats.py:130); GQA models project K/V into the
+    smaller KV dim;
+  * MoE models bill only ``top_k`` experts per token (same as reference's
+    ``k`` factor).
+"""
+from __future__ import annotations
+
+from dlnetbench_tpu.core.hardware import HARDWARE, BYTES_PER_ELEMENT, HardwareSpec
+from dlnetbench_tpu.core.model_card import ModelCard
+
+
+def attention_flops(card: ModelCard, batch: int) -> int:
+    """Per-model forward FLOPs of all attention blocks.
+
+    Projections: Q (2BNd*d), K/V (2BNd*d_kv each), O (2BNd*d);
+    scores QK^T (2BN^2 d) + AV (2BN^2 d).  Full (non-causal) attention,
+    matching the reference's convention (python/model_stats.py:128).
+    """
+    b, n, d, dkv, L = batch, card.seq_len, card.embed_dim, card.kv_dim, card.num_layers
+    proj = 2 * b * n * d * (2 * d + 2 * dkv)
+    scores = 4 * b * n * n * d
+    return L * (proj + scores)
+
+
+def mlp_flops(card: ModelCard, batch: int) -> int:
+    """Per-model forward FLOPs of all MLP/FFN blocks (top_k experts for MoE)."""
+    b, n, d, h, L = batch, card.seq_len, card.embed_dim, card.ff_dim, card.num_layers
+    n_mat = 3 if card.gated_mlp else 2
+    return L * n_mat * 2 * b * n * d * h * card.top_k
+
+
+def model_flops(card: ModelCard, batch: int) -> int:
+    return attention_flops(card, batch) + mlp_flops(card, batch)
+
+
+def model_bytes(card: ModelCard, batch: int, dtype: str) -> int:
+    """HBM traffic estimate: weights streamed once (active params only for
+    MoE) + activation reads/writes per block (~8 d-sized tensors per token
+    per layer).  This feeds arithmetic intensity AI = flops/bytes."""
+    bpe = BYTES_PER_ELEMENT[dtype]
+    active_params = card.num_params()
+    if card.is_moe:
+        active_params -= card.num_layers * \
+            (card.num_experts - card.top_k) * card.mlp_params_per_expert()
+    weight_bytes = active_params * bpe
+    act_bytes = 8 * batch * card.seq_len * card.embed_dim * card.num_layers * bpe
+    return int(weight_bytes + act_bytes)
+
+
+def roofline_time_s(flops: int, nbytes: int, hw: HardwareSpec, dtype: str) -> float:
+    """t = flops / min(peak, AI * BW)  (reference python/model_stats.py:47-50)."""
+    ai = flops / max(nbytes, 1)
+    achievable = min(hw.peak(dtype), ai * hw.hbm_bandwidth)
+    return flops / achievable
+
+
+def forward_time_s(card: ModelCard, batch: int, dtype: str, device: str) -> float:
+    hw = HARDWARE[device]
+    return roofline_time_s(model_flops(card, batch),
+                           model_bytes(card, batch, dtype), hw, dtype)
+
+
+def ffn_forward_time_s(card: ModelCard, batch: int, dtype: str, device: str) -> float:
+    """Roofline time of the FFN part alone (the reference reports
+    ``FFN_Average_Forward_Time`` for the MoE proxy's expert-compute slice,
+    reference model_stats/*.txt line 8)."""
+    hw = HARDWARE[device]
+    fl = mlp_flops(card, batch)
+    total_bytes = model_bytes(card, batch, dtype)
+    frac = fl / max(model_flops(card, batch), 1)
+    return roofline_time_s(fl, int(total_bytes * frac), hw, dtype)
+
+
+BWD_FWD_RATIO = 2.0  # reference python/model_stats.py:140

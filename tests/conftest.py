@@ -1,0 +1,20 @@
+"""Test harness config: force an 8-device virtual CPU platform so every
+mesh/collective test runs without TPU hardware (the TPU analogue of the
+reference's ``mpi_cpu`` build config, reference README.md:96 — the property
+that the whole suite runs on a laptop)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,117 @@
+"""Unit tests for the schedule algebra (buckets, shards, grids, message
+sizes) — the reference enforces these only as runtime asserts
+(SURVEY.md §4.1); here they are a tested pure library."""
+import pytest
+
+from dlnetbench_tpu.core import schedule
+from dlnetbench_tpu.core.model_card import load_model_card
+from dlnetbench_tpu.core.model_stats import ModelStats
+from dlnetbench_tpu.stats_gen import generate_stats
+
+
+def _stats(name="llama3_8b", batch=16):
+    return generate_stats(load_model_card(name), batch, "bfloat16", "tpu_v5p")
+
+
+def test_split_buckets_conserves_and_spreads():
+    assert schedule.split_buckets(10, 3) == [4, 3, 3]
+    assert sum(schedule.split_buckets(1234567, 7)) == 1234567
+    assert schedule.split_buckets(5, 8) == [1, 1, 1, 1, 1, 0, 0, 0]
+    with pytest.raises(ValueError):
+        schedule.split_buckets(10, 0)
+
+
+def test_dp_schedule():
+    s = _stats()
+    dp = schedule.dp_schedule(s, 4)
+    assert sum(dp.bucket_sizes) == s.model_size
+    assert dp.bwd_us_per_bucket == pytest.approx(s.bwd_us / 4)
+    assert dp.bucket_bytes[0] == int(dp.bucket_sizes[0] * 2.0)
+
+
+def test_fsdp_schedule_padding():
+    s = _stats()
+    f = schedule.fsdp_schedule(s, num_units=6, world_size=8)
+    assert f.sharding_factor == 8 and f.num_replicas == 1
+    # padded: every rank's shard covers the largest unit
+    assert f.shard_size * f.sharding_factor >= max(f.unit_sizes)
+    f2 = schedule.fsdp_schedule(s, num_units=6, world_size=8, sharding_factor=4)
+    assert f2.num_replicas == 2
+    with pytest.raises(ValueError):
+        schedule.fsdp_schedule(s, num_units=6, world_size=6, sharding_factor=4)
+
+
+def test_grid3d_coords_roundtrip_and_colors():
+    g = schedule.Grid3D(dp=2, pp=4, tp=2)
+    assert g.world_size == 16
+    for rank in range(g.world_size):
+        assert g.rank(*g.coords(rank)) == rank
+    # ranks sharing a tp color must differ only in tp coordinate
+    for r1 in range(16):
+        for r2 in range(16):
+            if r1 != r2 and g.tp_color(r1) == g.tp_color(r2):
+                d1, p1, _ = g.coords(r1)
+                d2, p2, _ = g.coords(r2)
+                assert (d1, p1) == (d2, p2)
+    # tp is fastest-varying (reference hybrid_3d.cpp:283-285)
+    assert g.coords(1) == (0, 0, 1)
+    assert g.coords(2) == (0, 1, 0)
+
+
+def test_pipeline_schedule():
+    s = _stats()
+    card = load_model_card("llama3_8b")
+    p = schedule.pipeline_schedule(s, card, num_stages=4, num_microbatches=8,
+                                   dp=2)
+    assert p.layers_per_stage == 8
+    assert p.pipe_msg_elems == s.seq_len * s.embed_dim * (16 // 8)
+    assert p.dp_sync_elems == s.model_size // 4
+    assert p.tp_msg_elems == 0
+    p3 = schedule.pipeline_schedule(s, card, num_stages=4, num_microbatches=8,
+                                    dp=2, tp=2)
+    # pipe message NOT divided by tp (reference hybrid_3d.cpp:319); only the
+    # TP allreduce is (hybrid_3d.cpp:322)
+    assert p3.pipe_msg_elems == p.pipe_msg_elems
+    assert p3.tp_msg_elems == p.pipe_msg_elems // 2
+    assert p3.dp_sync_elems == s.model_size // 8
+    assert p3.fwd_us_per_stage_mb == pytest.approx(p.fwd_us_per_stage_mb / 2)
+
+
+def test_pipeline_divisibility_errors():
+    s = _stats()
+    card = load_model_card("llama3_8b")  # 32 layers
+    with pytest.raises(ValueError, match="layers"):
+        schedule.pipeline_schedule(s, card, num_stages=5, num_microbatches=8)
+    with pytest.raises(ValueError, match="microbatches"):
+        schedule.pipeline_schedule(s, card, num_stages=4, num_microbatches=5)
+
+
+def test_moe_schedule():
+    s = _stats("mixtral_8x7b")
+    card = load_model_card("mixtral_8x7b")
+    m = schedule.moe_schedule(s, card, num_stages=4, num_microbatches=4,
+                              num_expert_shards=4, dp=2)
+    tokens_per_mb = (16 // 4) * s.seq_len
+    assert m.a2a_elems == tokens_per_mb * 2 * s.embed_dim // 4
+    assert m.a2a_per_direction == 2 * (32 // 4)
+    assert m.nonexpert_sync_elems == s.non_expert_size // 4
+    # level-2 sync covers EXPERT params only (reference hybrid_3d_moe.cpp:278,362)
+    assert m.expert_sync_elems == (s.model_size - s.non_expert_size) // (4 * 4)
+    # EP does not divide compute or pipe message (hybrid_3d_moe.cpp:339-347)
+    assert m.pipe.fwd_us_per_stage_mb == pytest.approx(s.fwd_us / (4 * 4))
+    assert m.pipe.pipe_msg_elems == s.seq_len * s.embed_dim * (16 // 4)
+    assert m.grid.tp == 4  # EP takes the fastest-varying axis
+    with pytest.raises(ValueError, match="experts"):
+        schedule.moe_schedule(s, card, num_stages=4, num_microbatches=4,
+                              num_expert_shards=3)
+
+
+def test_sequence_schedule():
+    s = _stats()
+    card = load_model_card("llama3_8b")
+    q = schedule.sequence_schedule(s, card, sp=8)
+    assert q.seq_per_rank == card.seq_len // 8
+    assert q.kv_block_elems == 2 * 16 * (card.seq_len // 8) * card.kv_dim
+    assert q.num_ring_hops == 7
+    with pytest.raises(ValueError):
+        schedule.sequence_schedule(s, card, sp=3)
