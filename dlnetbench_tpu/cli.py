@@ -1,0 +1,185 @@
+"""Proxy runner CLI — the counterpart of the reference's per-proxy binaries.
+
+The reference builds one binary per proxy with an easyargs CLI: positional
+``model`` (stats-file name), grid dims, plus ``-w`` warmups, ``-r`` runs,
+``-d`` device list, ``-m`` min-exectime (reference
+cpp/data_parallel/dp.cpp:108-124).  Here one entry point hosts all proxies:
+
+    python -m dlnetbench_tpu.cli dp --model gpt2_l_16_bfloat16 --num_buckets 8
+    python -m dlnetbench_tpu.cli fsdp --model llama3_8b_16_bfloat16 \
+        --num_units 8 --sharding_factor 4
+    python -m dlnetbench_tpu.cli hybrid_3d --model llama3_70b_16_bfloat16 \
+        --num_stages 4 --num_microbatches 8 --tp 2
+
+Rebuild extras: ``--size_scale`` / ``--time_scale`` shrink buffers and burn
+times so any schedule runs on a dev box; ``--loop`` is the PROXY_LOOP
+congestor mode; ``--out`` appends the JSON record to a file instead of
+stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dlnetbench_tpu.core.model_card import arch_name_from_stats_name, load_model_card
+from dlnetbench_tpu.core.model_stats import load_model_stats
+from dlnetbench_tpu.metrics.emit import emit_result
+from dlnetbench_tpu.proxies.base import ProxyConfig, run_proxy
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--model", required=True,
+                   help="stats-file name, e.g. gpt2_l_16_bfloat16")
+    p.add_argument("-w", "--warmup", type=int, default=3)
+    p.add_argument("-r", "--runs", type=int, default=5)
+    p.add_argument("-m", "--min_exectime", type=float, default=0.0,
+                   help="seconds; when set, runs are estimated from warmup")
+    p.add_argument("--loop", action="store_true",
+                   help="run the schedule forever (congestor mode)")
+    p.add_argument("--devices", type=int, default=0,
+                   help="use only the first N devices (0 = all)")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. 'cpu'); combine with "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                        "for a virtual N-device mesh on a dev box")
+    p.add_argument("--size_scale", type=float, default=1.0)
+    p.add_argument("--time_scale", type=float, default=1.0)
+    p.add_argument("--stats_dir", default=None)
+    p.add_argument("--out", default=None, help="append JSON record to file")
+
+
+def _cfg(args) -> ProxyConfig:
+    return ProxyConfig(warmup=args.warmup, runs=args.runs,
+                       min_exectime_s=args.min_exectime, loop=args.loop,
+                       size_scale=args.size_scale, time_scale=args.time_scale)
+
+
+def _devices(args):
+    import jax
+    devs = jax.devices()
+    return devs[:args.devices] if args.devices else devs
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dlnetbench_tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="proxy", required=True)
+
+    p_dp = sub.add_parser("dp", help="bucketed data-parallel allreduce")
+    _add_common(p_dp)
+    p_dp.add_argument("--num_buckets", type=int, required=True)
+
+    p_fsdp = sub.add_parser("fsdp", help="ZeRO-3 allgather/reduce-scatter")
+    _add_common(p_fsdp)
+    p_fsdp.add_argument("--num_units", type=int, required=True)
+    p_fsdp.add_argument("--sharding_factor", type=int, default=0,
+                        help="0 = whole world (no replicas)")
+
+    p_2d = sub.add_parser("hybrid_2d", help="DP + GPipe pipeline")
+    _add_common(p_2d)
+    p_2d.add_argument("--num_stages", type=int, required=True)
+    p_2d.add_argument("--num_microbatches", type=int, required=True)
+    p_2d.add_argument("--dp", type=int, default=0, help="0 = infer from devices")
+
+    p_3d = sub.add_parser("hybrid_3d", help="DP + PP + tensor parallel")
+    _add_common(p_3d)
+    p_3d.add_argument("--num_stages", type=int, required=True)
+    p_3d.add_argument("--num_microbatches", type=int, required=True)
+    p_3d.add_argument("--tp", type=int, required=True)
+    p_3d.add_argument("--dp", type=int, default=0)
+
+    p_moe = sub.add_parser("hybrid_3d_moe", help="DP + PP + expert parallel")
+    _add_common(p_moe)
+    p_moe.add_argument("--num_stages", type=int, required=True)
+    p_moe.add_argument("--num_microbatches", type=int, required=True)
+    p_moe.add_argument("--num_expert_shards", type=int, required=True)
+    p_moe.add_argument("--dp", type=int, default=0)
+
+    p_ring = sub.add_parser("ring_attention",
+                            help="ring (context-parallel) attention proxy")
+    _add_common(p_ring)
+    p_ring.add_argument("--sp", type=int, required=True)
+    p_ring.add_argument("--dp", type=int, default=0)
+
+    p_uly = sub.add_parser("ulysses", help="Ulysses sequence-parallel proxy")
+    _add_common(p_uly)
+    p_uly.add_argument("--sp", type=int, required=True)
+    p_uly.add_argument("--dp", type=int, default=0)
+
+    args = parser.parse_args(argv)
+    cfg = _cfg(args)
+
+    # Some environments pre-import jax and pin the platform from
+    # sitecustomize, so the JAX_PLATFORMS env var alone is not reliable —
+    # honor it (and --platform) through jax.config before any backend use.
+    platform = args.platform or None
+    import os
+    if platform is None and os.environ.get("JAX_PLATFORMS"):
+        platform = os.environ["JAX_PLATFORMS"]
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+    try:
+        stats = load_model_stats(args.model, args.stats_dir)
+    except FileNotFoundError as e:
+        parser.error(str(e))
+    devices = _devices(args)
+
+    try:
+        bundle = _build_bundle(args, parser, stats, cfg, devices)
+    except ImportError as e:
+        parser.error(f"proxy {args.proxy!r} is not implemented yet ({e})")
+    result = run_proxy(args.proxy, bundle, cfg)
+    emit_result(result, path=args.out)
+    return 0
+
+
+def _build_bundle(args, parser, stats, cfg, devices):
+    if args.proxy == "dp":
+        from dlnetbench_tpu.parallel.mesh import make_flat_mesh
+        from dlnetbench_tpu.proxies import dp as proxy_mod
+        mesh = make_flat_mesh(devices=devices)
+        return proxy_mod.build(stats, args.num_buckets, cfg, mesh=mesh)
+    else:
+        card = load_model_card(arch_name_from_stats_name(args.model))
+        if args.proxy == "fsdp":
+            from dlnetbench_tpu.proxies import fsdp as proxy_mod
+            bundle = proxy_mod.build(stats, args.num_units, cfg,
+                                     devices=devices,
+                                     sharding_factor=args.sharding_factor or None)
+        elif args.proxy == "hybrid_2d":
+            from dlnetbench_tpu.proxies import hybrid_2d as proxy_mod
+            bundle = proxy_mod.build(stats, card, cfg,
+                                     num_stages=args.num_stages,
+                                     num_microbatches=args.num_microbatches,
+                                     dp=args.dp, devices=devices)
+        elif args.proxy == "hybrid_3d":
+            from dlnetbench_tpu.proxies import hybrid_3d as proxy_mod
+            bundle = proxy_mod.build(stats, card, cfg,
+                                     num_stages=args.num_stages,
+                                     num_microbatches=args.num_microbatches,
+                                     tp=args.tp, dp=args.dp, devices=devices)
+        elif args.proxy == "hybrid_3d_moe":
+            from dlnetbench_tpu.proxies import hybrid_3d_moe as proxy_mod
+            bundle = proxy_mod.build(stats, card, cfg,
+                                     num_stages=args.num_stages,
+                                     num_microbatches=args.num_microbatches,
+                                     num_expert_shards=args.num_expert_shards,
+                                     dp=args.dp, devices=devices)
+        elif args.proxy == "ring_attention":
+            from dlnetbench_tpu.proxies import ring_attention as proxy_mod
+            bundle = proxy_mod.build(stats, card, cfg, sp=args.sp,
+                                     dp=args.dp, devices=devices)
+        elif args.proxy == "ulysses":
+            from dlnetbench_tpu.proxies import ulysses as proxy_mod
+            bundle = proxy_mod.build(stats, card, cfg, sp=args.sp,
+                                     dp=args.dp, devices=devices)
+        else:  # pragma: no cover
+            parser.error(f"unknown proxy {args.proxy}")
+        return bundle
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,6 @@
+from dlnetbench_tpu.metrics.emit import emit_result, result_to_record
+from dlnetbench_tpu.metrics.parser import (
+    load_records, records_to_dataframe, get_metrics_dataframe)
+
+__all__ = ["emit_result", "result_to_record", "load_records",
+           "records_to_dataframe", "get_metrics_dataframe"]

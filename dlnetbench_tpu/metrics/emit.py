@@ -1,0 +1,65 @@
+"""Structured metrics emission.
+
+The reference emits, per proxy run, a named section with rank-0 global
+key/values (model, grid dims, message sizes, backend, device) plus per-rank
+JSON arrays of timer values (reference cpp/data_parallel/dp.cpp:275-295 via
+ccutils macros), parsed downstream into pandas DataFrames.
+
+Here a run emits ONE self-describing JSON object (one line when streamed):
+
+    {"section": "<proxy>", "version": 1,
+     "global": {...},                       # the rank-0 globals
+     "ranks": [{"rank": 0, "device_id": ..., "runtimes": [...],
+                "barrier_time": [...], ...}, ...]}
+
+Per-"rank" rows are per *device*.  Timing is host-measured per iteration
+(single-controller), so timer arrays are shared across rows on a single
+host; rows still carry device identity/coords so multi-host runs and the
+analysis layer keep the reference's rank-resolved shape.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import sys
+
+from dlnetbench_tpu.proxies.base import ProxyResult
+
+SCHEMA_VERSION = 1
+
+
+def result_to_record(result: ProxyResult) -> dict:
+    mesh_info = result.global_meta.get("mesh", {})
+    devices = mesh_info.get("devices", [{"id": 0, "process": 0}])
+    hostname = socket.gethostname()
+    ranks = []
+    for i, dev in enumerate(devices):
+        row = {
+            "rank": i,
+            "device_id": dev.get("id", i),
+            "process_index": dev.get("process", 0),
+            "hostname": hostname,
+            **({"coords": dev["coords"]} if "coords" in dev else {}),
+        }
+        row.update(result.timers_us)
+        ranks.append(row)
+    return {
+        "section": result.name,
+        "version": SCHEMA_VERSION,
+        "global": {k: v for k, v in result.global_meta.items() if k != "mesh"},
+        "mesh": {k: v for k, v in mesh_info.items() if k != "devices"},
+        "num_runs": result.num_runs,
+        "warmup_times": result.warmup_times_us,
+        "ranks": ranks,
+    }
+
+
+def emit_result(result: ProxyResult, stream=None, path: str | None = None) -> dict:
+    record = result_to_record(result)
+    line = json.dumps(record)
+    if path:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+    else:
+        (stream or sys.stdout).write(line + "\n")
+    return record

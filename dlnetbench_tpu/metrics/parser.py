@@ -1,0 +1,98 @@
+"""Metrics parsing: JSON-lines run records -> pandas DataFrames.
+
+Counterpart of the reference's analysis ingest (reference
+plots/parser.py:139-256): where that walks sbatchman job stdout through the
+ccutils MPIOutputParser and builds one row per rank x run, this walks
+JSON-lines files produced by ``metrics.emit`` and builds the same shape:
+one row per rank x run with ``runtime`` and the per-collective timers, plus
+the globals (model, world size, message sizes) replicated onto each row —
+ready for groupby/plotting.
+
+Validation mirrors ``validate_dp_output`` (reference plots/parser.py:102-136):
+every emitted record must cover the full expected rank set.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+_TIMER_KEYS_EXCLUDE = {"rank", "device_id", "process_index", "hostname", "coords"}
+
+
+def load_records(path: str | Path, section: str | None = None) -> list[dict]:
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: bad JSON record: {e}") from e
+            if section is None or rec.get("section") == section:
+                records.append(rec)
+    return records
+
+
+def validate_record(rec: dict) -> None:
+    """Every device rank must have reported (reference
+    plots/parser.py:102-136 'did every rank report' check)."""
+    world = rec["global"].get("world_size")
+    ranks = [r["rank"] for r in rec.get("ranks", [])]
+    if world is not None and sorted(ranks) != list(range(world)):
+        raise ValueError(
+            f"record for {rec.get('section')}/{rec['global'].get('model')}: "
+            f"rank set {sorted(ranks)} != range({world})")
+    n = rec.get("num_runs")
+    for row in rec.get("ranks", []):
+        for k, v in row.items():
+            if k not in _TIMER_KEYS_EXCLUDE and isinstance(v, list) and n \
+                    and len(v) != n:
+                raise ValueError(
+                    f"rank {row['rank']} timer {k!r} has {len(v)} entries, "
+                    f"expected {n}")
+
+
+def records_to_dataframe(records: list[dict], validate: bool = True):
+    """One row per rank x run; globals and mesh info as columns."""
+    import pandas as pd
+
+    rows = []
+    for rec in records:
+        if validate:
+            validate_record(rec)
+        g = rec.get("global", {})
+        mesh = rec.get("mesh", {})
+        for rank_row in rec.get("ranks", []):
+            timers = {k: v for k, v in rank_row.items()
+                      if k not in _TIMER_KEYS_EXCLUDE and isinstance(v, list)}
+            n = rec.get("num_runs") or max((len(v) for v in timers.values()),
+                                           default=0)
+            for run in range(n):
+                row = {
+                    "section": rec.get("section"),
+                    "run": run,
+                    "rank": rank_row["rank"],
+                    "device_id": rank_row.get("device_id"),
+                    "hostname": rank_row.get("hostname"),
+                    "platform": mesh.get("platform"),
+                    "device_kind": mesh.get("device_kind"),
+                }
+                for k, v in g.items():
+                    if not isinstance(v, (list, dict)):
+                        row[k] = v
+                for tname, tvals in timers.items():
+                    if run < len(tvals):
+                        # singular column names a la reference ('runtime')
+                        col = tname[:-1] if tname.endswith("s") else tname
+                        row[col] = tvals[run]
+                rows.append(row)
+    return pd.DataFrame(rows)
+
+
+def get_metrics_dataframe(path: str | Path, strategy: str | None = None,
+                          validate: bool = True):
+    """Reference-parity convenience: ``get_metrics_dataframe('runs.jsonl',
+    'dp')`` -> DataFrame (reference plots/parser.py:213-256)."""
+    return records_to_dataframe(load_records(path, strategy), validate)

@@ -1,0 +1,10 @@
+from dlnetbench_tpu.parallel.mesh import (
+    AXIS_DP, AXIS_PP, AXIS_TP, AXIS_SP,
+    make_grid_mesh, make_flat_mesh, mesh_from_grid, describe_mesh)
+from dlnetbench_tpu.parallel import collectives
+
+__all__ = [
+    "AXIS_DP", "AXIS_PP", "AXIS_TP", "AXIS_SP",
+    "make_grid_mesh", "make_flat_mesh", "mesh_from_grid", "describe_mesh",
+    "collectives",
+]

@@ -1,0 +1,35 @@
+"""Device buffer allocation — the ``Tensor<_FLOAT, device>`` analogue.
+
+The reference RAII-allocates zero-initialized collective buffers in device
+memory (reference cpp/proxy_classes.hpp:381-444: calloc / cudaMalloc).  Here
+buffers are jax Arrays created *on device* via a jitted zero-producer with
+explicit output shardings — never materialized on host, which matters when a
+proxy asks for multi-GiB gradient buffers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def sharded_zeros(mesh: Mesh, spec: P, shape: tuple[int, ...],
+                  dtype=jnp.float32) -> jax.Array:
+    sharding = NamedSharding(mesh, spec)
+    return jax.jit(lambda: jnp.zeros(shape, dtype),
+                   out_shardings=sharding)()
+
+
+def replicated(mesh: Mesh, value: jax.Array) -> jax.Array:
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(value, sharding)
+
+
+def scaled_elems(elems: int, scale: float, minimum: int = 128) -> int:
+    """Scale a schedule-derived buffer size for small test runs.  ``scale=1``
+    reproduces the schedule's true message sizes; tests use tiny scales so
+    the full suite runs on a laptop (the reference gets the same effect by
+    running small models on the mpi_cpu config)."""
+    if scale >= 1.0:
+        return elems
+    return max(minimum, int(elems * scale))

@@ -1,0 +1,100 @@
+"""Collective primitives — the TPU-native ``ProxyCommunicator``.
+
+The reference programs against an abstract communicator with
+Allreduce / Allgather / Reduce_Scatter_block / Alltoall / send / recv
+(reference cpp/proxy_classes.hpp:30-51), implemented by MPI/NCCL/oneCCL.
+Here each operation is the corresponding XLA collective HLO issued inside a
+``shard_map``-decorated program over a named mesh axis; XLA lowers them to
+ICI/DCN transfers and schedules them asynchronously (start/done pairs), so
+"nonblocking + Wait(i)" (proxy_classes.hpp:42-43) becomes dataflow: a
+collective's *done* is wherever its result is first consumed.
+
+``tie`` is the ordering tool: the reference's schedule semantics ("the
+bucket-i allreduce may only start after bucket-i backward compute") are
+data dependencies here, enforced with ``lax.optimization_barrier`` rather
+than host-side call order.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def tie(value, dep):
+    """Return ``value`` with a scheduling dependency on ``dep`` (both must
+    be arrays).  Prevents XLA from hoisting the collective that consumes
+    ``value`` above the computation that produces ``dep``."""
+    value, _ = lax.optimization_barrier((value, dep))
+    return value
+
+
+def fence(*values):
+    """Barrier over a set of values: returns them tied together so nothing
+    below reorders above (the WaitAll analogue, proxy_classes.hpp:43)."""
+    return lax.optimization_barrier(values)
+
+
+# --- collectives (call inside shard_map) ------------------------------- #
+def allreduce(x, axis: str):
+    """Sum-allreduce over a mesh axis (reference Allreduce,
+    proxy_classes.hpp:36-37; MPI_SUM hardcoded at :67)."""
+    return lax.psum(x, axis)
+
+
+def allgather(x, axis: str, tiled: bool = True):
+    """Concatenating allgather (reference Allgather/Iallgather,
+    proxy_classes.hpp:38-39; used for FSDP unit gathers fsdp.cpp:86-100)."""
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str):
+    """Block reduce-scatter (reference Reduce_Scatter_block,
+    proxy_classes.hpp:40; FSDP gradient shard fsdp.cpp:123-127).
+    Input length must divide evenly by the axis size."""
+    return lax.psum_scatter(x, axis, tiled=True)
+
+
+def alltoall(x, axis: str, split_axis: int = 0, concat_axis: int = 0):
+    """All-to-all (reference Alltoall, proxy_classes.hpp:41; MoE token
+    dispatch/combine hybrid_3d_moe.cpp:161-165).  ``x``'s ``split_axis``
+    dim must be divisible by the axis size."""
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ring_shift(x, axis: str, shift: int = 1):
+    """Send to the next rank on the axis ring, receive from the previous
+    (the p2p idiom on TPU: there is no send/recv primitive, so pipeline
+    hops (reference hybrid_2d.cpp:109-132) and ring-attention KV rotation
+    are ``ppermute`` steps over the axis)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def shift_up(x, axis: str):
+    """Stage s -> stage s+1 edge transfer (forward activations).  Non-ring:
+    the last stage's output is dropped and the first stage receives zeros,
+    encoding GPipe's 'stage 0 has no upstream' asymmetry as a masked
+    permute (SURVEY.md §7.3 hard-part 3)."""
+    n = lax.axis_size(axis)
+    perm = [(i, i + 1) for i in range(n - 1)]
+    return lax.ppermute(x, axis, perm)
+
+
+def shift_down(x, axis: str):
+    """Stage s -> stage s-1 edge transfer (backward gradients)."""
+    n = lax.axis_size(axis)
+    perm = [(i, i - 1) for i in range(1, n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def barrier(axis: str):
+    """Full-axis rendezvous: a 1-element psum nothing depends on for math,
+    used where the reference calls MPI_Barrier (dp.cpp:234)."""
+    return lax.psum(jnp.ones((), jnp.float32), axis)

@@ -1,0 +1,128 @@
+"""Proxy harness: warmup, run estimation, timed runs, loop mode.
+
+Reproduces the reference's measurement skeleton (reference
+cpp/data_parallel/dp.cpp:234-264):
+
+  barrier -> warmup loop (default 3) -> [estimate runs from warmup times,
+  skipping the first 2, when min_exectime is set] -> clear timers ->
+  timed runs (default 5) -> emit.
+
+Where the reference brackets host-blocking collective calls with wall
+timers, a TPU program is one async device launch, so per-collective cost is
+measured by *decomposition* (SURVEY.md §7.3 hard-part 1): each proxy
+provides up to three jitted variants of its step —
+
+  full      the real schedule (compute overlapped with collectives)
+  compute   collectives stripped (burn chains only)
+  comm      compute stripped (collectives only)
+
+All are timed whole-program with ``block_until_ready`` fencing.  Then
+
+  runtime        = t(full)                      per iteration
+  exposed comm   = max(0, t(full) - t(compute)) the reference's "barrier"
+                   timer: communication not hidden by compute (dp.cpp:191)
+  wire comm      = t(comm)                      fenced lower bound of the
+                   collective cost without contention from compute
+
+Loop mode (reference ``-DPROXY_LOOP`` binaries, dp.cpp:251-256) re-runs the
+full step forever to generate sustained background load for interference
+studies.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from collections.abc import Callable
+
+import jax
+
+from dlnetbench_tpu.utils.timing import time_callable
+
+DEFAULT_WARMUP = 3   # reference dp.cpp:65
+DEFAULT_RUNS = 5     # reference dp.cpp:66
+
+
+@dataclasses.dataclass
+class ProxyConfig:
+    warmup: int = DEFAULT_WARMUP
+    runs: int = DEFAULT_RUNS
+    min_exectime_s: float = 0.0    # reference -m flag -> estimate_runs
+    loop: bool = False             # reference PROXY_LOOP
+    size_scale: float = 1.0        # shrink buffers for dev machines
+    time_scale: float = 1.0        # shrink burn durations for dev machines
+    measure_comm_only: bool = True
+    measure_compute_only: bool = True
+
+
+@dataclasses.dataclass
+class StepBundle:
+    """What a proxy's ``build()`` returns."""
+    full: Callable          # () -> outputs (closed over device buffers)
+    compute: Callable | None
+    comm: Callable | None
+    global_meta: dict       # model/grid/message-size metadata for the emitter
+
+
+def estimate_runs(warmup_times_s: list[float], min_exectime_s: float,
+                  skip: int = 2) -> int:
+    """Runs needed so total measured time reaches ``min_exectime_s``, from
+    the mean warm-up iteration time excluding the first ``skip`` iterations
+    (reference cpp/utils.hpp:121-135 — including its intent, not its
+    divide-by-the-wrong-count bug, SURVEY.md §7.4)."""
+    usable = warmup_times_s[skip:] or warmup_times_s[-1:]
+    mean = sum(usable) / len(usable)
+    if mean <= 0:
+        return 1
+    return max(1, math.ceil(min_exectime_s / mean))
+
+
+@dataclasses.dataclass
+class ProxyResult:
+    name: str
+    global_meta: dict
+    timers_us: dict          # timer name -> list of per-iteration us
+    warmup_times_us: list
+    num_runs: int
+
+    def mean_us(self, timer: str) -> float:
+        vals = self.timers_us.get(timer, [])
+        return sum(vals) / len(vals) if vals else 0.0
+
+
+def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig) -> ProxyResult:
+    # warmup (also compiles); reference dp.cpp:234-244
+    warmup_s = time_callable(bundle.full, reps=max(cfg.warmup, 1))
+
+    runs = cfg.runs
+    if cfg.min_exectime_s > 0:
+        runs = estimate_runs(warmup_s, cfg.min_exectime_s)
+
+    if cfg.loop:  # reference PROXY_LOOP, dp.cpp:251-256
+        while True:
+            bundle.full()
+
+    timers: dict[str, list] = {}
+    full_s = time_callable(bundle.full, reps=runs)
+    timers["runtimes"] = [t * 1e6 for t in full_s]
+
+    if cfg.measure_compute_only and bundle.compute is not None:
+        time_callable(bundle.compute, reps=1)  # compile
+        comp_s = time_callable(bundle.compute, reps=runs)
+        timers["compute_time"] = [t * 1e6 for t in comp_s]
+        mean_comp = sum(comp_s) / len(comp_s)
+        timers["barrier_time"] = [max(0.0, (t - mean_comp)) * 1e6
+                                  for t in full_s]
+
+    if cfg.measure_comm_only and bundle.comm is not None:
+        time_callable(bundle.comm, reps=1)  # compile
+        comm_s = time_callable(bundle.comm, reps=runs)
+        timers["comm_time"] = [t * 1e6 for t in comm_s]
+
+    return ProxyResult(
+        name=name,
+        global_meta=bundle.global_meta,
+        timers_us=timers,
+        warmup_times_us=[t * 1e6 for t in warmup_s],
+        num_runs=runs,
+    )

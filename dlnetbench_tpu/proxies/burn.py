@@ -1,0 +1,112 @@
+"""Calibrated on-device compute burn — the ``usleep`` replacement.
+
+The reference simulates compute by host-sleeping for roofline-derived
+durations between collective calls (reference cpp/data_parallel/dp.cpp:93,
+98).  Inside an XLA program a host sleep is impossible — and sleeping on the
+host *between* device dispatches would serialize against the async runtime
+and destroy the comm/compute overlap the benchmark exists to measure
+(SURVEY.md §7.1 Tier A note).  Instead we burn device cycles with a chained
+matmul loop on a small VMEM-resident matrix:
+
+    state <- tanh(state @ state / n)      x iters   (MXU work, bounded values)
+
+The per-iteration cost is calibrated once per (device kind, shape, dtype)
+by differencing two loop lengths (cancelling dispatch and loop overheads),
+then any requested microsecond budget maps to a static trip count.  The
+chain is strictly sequential (each iteration consumes the previous state),
+so XLA cannot shrink or parallelize it, and ``tie``-ing a collective's
+operand to the chain state reproduces the reference's issue-order semantics.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlnetbench_tpu.utils.timing import time_callable
+
+# 256x256 bf16: two MXU tiles wide — big enough to exercise the MXU,
+# small enough to live in VMEM and calibrate in milliseconds.
+DEFAULT_SHAPE = (256, 256)
+DEFAULT_DTYPE = jnp.bfloat16
+
+
+def make_state(shape=DEFAULT_SHAPE, dtype=DEFAULT_DTYPE):
+    """Deterministic, well-conditioned initial burn state in (-1, 1)."""
+    n, m = shape
+    i = jnp.arange(n, dtype=jnp.float32)[:, None]
+    j = jnp.arange(m, dtype=jnp.float32)[None, :]
+    return jnp.sin(i * 0.7 + j * 1.3).astype(dtype) * 0.5
+
+
+def burn(state, iters: int):
+    """Advance the burn chain ``iters`` times (static count).  Returns the
+    new state; consuming it (or ``tie``-ing to it) orders work after the
+    burn."""
+    if iters <= 0:
+        return state
+    scale = 1.0 / state.shape[-1]
+
+    def body(_, s):
+        p = jnp.dot(s, s, preferred_element_type=jnp.float32)
+        return jnp.tanh(p * scale).astype(s.dtype)
+
+    return lax.fori_loop(0, iters, body, state, unroll=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnCalibration:
+    ns_per_iter: float
+    shape: tuple
+    dtype: str
+    device_kind: str
+
+    def iters_for_us(self, us: float) -> int:
+        if us <= 0:
+            return 0
+        return max(1, round(us * 1000.0 / self.ns_per_iter))
+
+    def us_for_iters(self, iters: int) -> float:
+        return iters * self.ns_per_iter / 1000.0
+
+
+def _calibrate_on_device(shape, dtype_name, device, n_lo, n_hi):
+    dtype = jnp.dtype(dtype_name)
+    with jax.default_device(device):
+        state = jax.device_put(make_state(shape, dtype), device)
+
+        lo = jax.jit(functools.partial(burn, iters=n_lo))
+        hi = jax.jit(functools.partial(burn, iters=n_hi))
+        lo(state).block_until_ready()  # compile
+        hi(state).block_until_ready()
+
+        t_lo = min(time_callable(lo, state, reps=5))
+        t_hi = min(time_callable(hi, state, reps=5))
+        ns = (t_hi - t_lo) * 1e9 / (n_hi - n_lo)
+        if ns <= 0:  # timer noise on very fast devices: widen the gap
+            t_hi = min(time_callable(
+                jax.jit(functools.partial(burn, iters=n_hi * 8)), state, reps=3))
+            ns = max((t_hi - t_lo) * 1e9 / (n_hi * 8 - n_lo), 1.0)
+    return BurnCalibration(ns_per_iter=ns, shape=shape, dtype=str(dtype_name),
+                           device_kind=device.device_kind)
+
+
+_CAL_CACHE: dict = {}
+
+
+def calibrate(shape=DEFAULT_SHAPE, dtype=DEFAULT_DTYPE,
+              device=None) -> BurnCalibration:
+    """Measure ns/iteration of the burn chain on the current default device.
+    Differenced between two trip counts so dispatch/compile overheads cancel
+    (the same discipline as the reference's warm-up skipping, reference
+    cpp/utils.hpp:121-123)."""
+    device = device or jax.devices()[0]
+    key = (tuple(shape), jnp.dtype(dtype).name, device.device_kind)
+    if key not in _CAL_CACHE:
+        _CAL_CACHE[key] = _calibrate_on_device(tuple(shape),
+                                               jnp.dtype(dtype).name,
+                                               device, 64, 256)
+    return _CAL_CACHE[key]

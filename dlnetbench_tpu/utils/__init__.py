@@ -1,0 +1,3 @@
+from dlnetbench_tpu.utils.timing import time_callable, median_us
+
+__all__ = ["time_callable", "median_us"]

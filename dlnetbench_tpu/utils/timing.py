@@ -1,0 +1,32 @@
+"""Wall-clock timing of jitted programs.
+
+Device execution is async: a jitted call returns before the device finishes
+(SURVEY.md §5.1).  Every measurement here fences with
+``jax.block_until_ready`` on the outputs, which is the TPU analogue of the
+reference's host-blocking timer brackets (reference
+CCUTILS_MPI_TIMER_START/STOP, cpp/data_parallel/dp.cpp:102-104) — applied
+around the *whole program*, never inside it, so on-device overlap is
+preserved.
+"""
+from __future__ import annotations
+
+import statistics
+import time
+
+import jax
+
+
+def time_callable(fn, *args, reps: int = 1, **kwargs) -> list[float]:
+    """Run ``fn(*args)`` ``reps`` times, fencing each run; returns seconds
+    per run.  Caller is responsible for warmup (compilation)."""
+    out = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res = fn(*args, **kwargs)
+        jax.block_until_ready(res)
+        out.append(time.perf_counter() - t0)
+    return out
+
+
+def median_us(samples_s: list[float]) -> float:
+    return statistics.median(samples_s) * 1e6

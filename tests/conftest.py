@@ -1,7 +1,14 @@
 """Test harness config: force an 8-device virtual CPU platform so every
 mesh/collective test runs without TPU hardware (the TPU analogue of the
 reference's ``mpi_cpu`` build config, reference README.md:96 — the property
-that the whole suite runs on a laptop)."""
+that the whole suite runs on a laptop).
+
+Note: some environments (e.g. the axon TPU tunnel) pre-import jax from
+sitecustomize and pin ``jax_platforms`` programmatically, so setting the
+JAX_PLATFORMS env var here is too late — we must override through
+``jax.config`` as well.  XLA_FLAGS is still read at first backend init,
+which has not happened yet at conftest time.
+"""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -9,12 +16,15 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
-    import jax
     devs = jax.devices()
-    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
     return devs

@@ -1,0 +1,109 @@
+"""Mesh + collective wrapper tests on the 8-device virtual CPU platform
+(the rebuild's ``mpi_cpu`` equivalent, SURVEY.md §4.4)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dlnetbench_tpu.core.schedule import Grid3D
+from dlnetbench_tpu.parallel import collectives as col
+from dlnetbench_tpu.parallel import mesh as meshlib
+
+
+def test_flat_mesh(eight_devices):
+    m = meshlib.make_flat_mesh(8)
+    assert m.devices.shape == (8,) and m.axis_names == ("x",)
+    m4 = meshlib.make_flat_mesh(4)
+    assert m4.devices.shape == (4,)
+
+
+def test_grid_mesh_matches_grid3d_ranks(eight_devices):
+    g = Grid3D(dp=2, pp=2, tp=2)
+    m = meshlib.mesh_from_grid(g)
+    assert m.axis_names == ("dp", "pp", "tp")
+    # device at mesh coordinate (d,p,t) must be flat rank (d*pp+p)*tp+t
+    flat = m.devices.flatten()
+    for d in range(2):
+        for p in range(2):
+            for t in range(2):
+                assert m.devices[d, p, t] == flat[g.rank(d, p, t)]
+
+
+def test_mesh_too_large_raises(eight_devices):
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        meshlib.make_grid_mesh(dp=4, pp=2, tp=2)
+
+
+def test_describe_mesh(eight_devices):
+    info = meshlib.describe_mesh(meshlib.make_grid_mesh(2, 2, 2))
+    assert info["axes"] == {"dp": 2, "pp": 2, "tp": 2}
+    assert info["num_devices"] == 8 and len(info["devices"]) == 8
+
+
+def _smap(mesh, fn, in_spec, out_spec):
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_spec,
+                             out_specs=out_spec, check_vma=False))
+
+
+def test_allreduce_and_barrier(eight_devices):
+    m = meshlib.make_flat_mesh(8)
+    x = jnp.arange(8.0)
+    out = _smap(m, lambda v: col.allreduce(v, "x"), P("x"), P("x"))(x)
+    np.testing.assert_allclose(out, np.full(8, 28.0))
+    b = _smap(m, lambda v: col.barrier("x"), P("x"), P())(x)
+    assert float(b) == 8.0
+
+
+def test_allgather_reduce_scatter(eight_devices):
+    m = meshlib.make_flat_mesh(4)
+    x = jnp.arange(8.0)  # 2 elements per rank
+    gathered = _smap(m, lambda v: col.allgather(v, "x"), P("x"), P())(x)
+    np.testing.assert_allclose(gathered, np.arange(8.0))
+    # reduce_scatter of the gathered full vector: every rank contributes the
+    # same 8-vector, rank i keeps slice i summed over ranks
+    def rs(v):
+        full = col.allgather(v, "x")
+        return col.reduce_scatter(full, "x")
+    out = _smap(m, rs, P("x"), P("x"))(x)
+    np.testing.assert_allclose(out, 4.0 * np.arange(8.0))
+
+
+def test_alltoall(eight_devices):
+    m = meshlib.make_flat_mesh(4)
+    # per rank: 4 blocks of 2; after A2A rank r holds block r of every rank
+    x = jnp.arange(32.0).reshape(4, 8)  # rank r gets row r
+    out = _smap(m, lambda v: col.alltoall(v.reshape(4, 2), "x"),
+                P("x", None), P("x", None))(x)
+    out = np.asarray(out).reshape(4, 4, 2)
+    ref = np.arange(32.0).reshape(4, 4, 2).transpose(1, 0, 2)
+    np.testing.assert_allclose(out, ref)
+
+
+def test_ring_shift_and_edge_shifts(eight_devices):
+    m = meshlib.make_flat_mesh(4)
+    x = jnp.arange(4.0)
+    shifted = _smap(m, lambda v: col.ring_shift(v, "x"), P("x"), P("x"))(x)
+    np.testing.assert_allclose(shifted, [3, 0, 1, 2])  # rank r receives r-1
+    up = _smap(m, lambda v: col.shift_up(v, "x"), P("x"), P("x"))(x)
+    np.testing.assert_allclose(up, [0, 0, 1, 2])  # stage 0 gets zeros
+    down = _smap(m, lambda v: col.shift_down(v, "x"), P("x"), P("x"))(x)
+    np.testing.assert_allclose(down, [1, 2, 3, 0])  # last stage gets zeros
+
+
+def test_subaxis_grouping(eight_devices):
+    """Collectives over one axis of a 3D mesh act within (dp,pp) groups —
+    the mesh-native replacement of comm colors (hybrid_3d.cpp:287-300)."""
+    m = meshlib.make_grid_mesh(2, 2, 2)
+    x = jnp.arange(8.0)
+
+    def tp_sum(v):
+        return col.allreduce(v, "tp")
+
+    out = _smap(m, tp_sum, P(("dp", "pp", "tp")), P(("dp", "pp", "tp")))(x)
+    # ranks (2k, 2k+1) pair up on the tp axis
+    expect = [1, 1, 5, 5, 9, 9, 13, 13]
+    np.testing.assert_allclose(out, expect)
