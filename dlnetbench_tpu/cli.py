@@ -131,6 +131,8 @@ def main(argv: list[str] | None = None) -> int:
         bundle = _build_bundle(args, parser, stats, cfg, devices)
     except ImportError as e:
         parser.error(f"proxy {args.proxy!r} is not implemented yet ({e})")
+    except ValueError as e:
+        parser.error(str(e))  # configuration-invariant violations
     result = run_proxy(args.proxy, bundle, cfg)
     emit_result(result, path=args.out)
     return 0

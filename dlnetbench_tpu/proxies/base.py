@@ -62,6 +62,10 @@ class StepBundle:
     compute: Callable | None
     comm: Callable | None
     global_meta: dict       # model/grid/message-size metadata for the emitter
+    # named comm-only sub-schedules timed into "<name>_time" timers — the
+    # per-collective parity channel (reference fsdp.cpp:61-66 allgather/
+    # reduce_scatter timers, hybrid_3d.cpp:65-68 pp/dp/tp_comm timers)
+    variants: dict | None = None
 
 
 def estimate_runs(warmup_times_s: list[float], min_exectime_s: float,
@@ -118,6 +122,12 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig) -> ProxyResult:
         time_callable(bundle.comm, reps=1)  # compile
         comm_s = time_callable(bundle.comm, reps=runs)
         timers["comm_time"] = [t * 1e6 for t in comm_s]
+
+    if cfg.measure_comm_only and bundle.variants:
+        for vname, vfn in bundle.variants.items():
+            time_callable(vfn, reps=1)  # compile
+            v_s = time_callable(vfn, reps=runs)
+            timers[f"{vname}_time"] = [t * 1e6 for t in v_s]
 
     return ProxyResult(
         name=name,

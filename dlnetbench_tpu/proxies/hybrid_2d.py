@@ -1,0 +1,13 @@
+"""DP+PP proxy (GPipe) — reference cpp/hybrid_parallel/hybrid_2d.cpp.
+Thin wrapper over the shared pipeline engine; see
+``proxies.pipeline_common`` for the schedule mapping."""
+from __future__ import annotations
+
+from dlnetbench_tpu.proxies import pipeline_common
+
+
+def build(stats, card, cfg, *, num_stages, num_microbatches, dp=0,
+          devices=None, **kw):
+    return pipeline_common.build(
+        stats, card, cfg, mode="2d", num_stages=num_stages,
+        num_microbatches=num_microbatches, dp=dp, devices=devices, **kw)

@@ -1,0 +1,14 @@
+"""DP+PP+TP proxy (Megatron 1D TP on top of GPipe) — reference
+cpp/hybrid_parallel/hybrid_3d.cpp.  Thin wrapper over the shared pipeline
+engine; see ``proxies.pipeline_common``."""
+from __future__ import annotations
+
+from dlnetbench_tpu.proxies import pipeline_common
+
+
+def build(stats, card, cfg, *, num_stages, num_microbatches, tp, dp=0,
+          devices=None, **kw):
+    return pipeline_common.build(
+        stats, card, cfg, mode="3d", num_stages=num_stages,
+        num_microbatches=num_microbatches, tp=tp, dp=dp, devices=devices,
+        **kw)

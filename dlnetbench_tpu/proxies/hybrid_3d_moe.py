@@ -1,0 +1,18 @@
+"""DP+PP+EP (MoE) proxy — reference cpp/hybrid_parallel/hybrid_3d_moe.cpp.
+Thin wrapper over the shared pipeline engine; see
+``proxies.pipeline_common``."""
+from __future__ import annotations
+
+from dlnetbench_tpu.proxies import pipeline_common
+
+
+def build(stats, card, cfg, *, num_stages, num_microbatches,
+          num_expert_shards, dp=0, devices=None, **kw):
+    if not card.is_moe:
+        raise ValueError(f"{card.name} has no moe_params; the MoE proxy "
+                         f"needs an MoE architecture card "
+                         f"(reference hybrid_3d_moe.cpp Experts field)")
+    return pipeline_common.build(
+        stats, card, cfg, mode="moe", num_stages=num_stages,
+        num_microbatches=num_microbatches,
+        num_expert_shards=num_expert_shards, dp=dp, devices=devices, **kw)

@@ -1,0 +1,243 @@
+"""Shared GPipe pipeline engine for the hybrid proxies (2D / 3D / 3D-MoE).
+
+Reference structure (cpp/hybrid_parallel/hybrid_2d.cpp:90-169): GPipe runs
+all microbatches forward, then all backward, then one blocking DP allreduce
+of the stage's gradient shard.  Per rank and per microbatch the work is
+recv -> compute -> send (direction mirrored in backward); stage position
+asymmetry (first stage never receives, last never sends) is encoded here as
+masked ``ppermute`` edge shifts (SURVEY.md §7.3 hard-part 3).
+
+The 3D variant adds two TP allreduces per microbatch per direction after
+the p2p hop (Megatron column+row parallel linear, hybrid_3d.cpp:142-148,
+177-183).  The MoE variant instead adds ``2 x layers_per_stage``
+all-to-alls per microbatch per direction (token dispatch + combine per MoE
+layer, hybrid_3d_moe.cpp:161-165, 196-200) and replaces the gradient sync
+with the two-level scheme (non-expert over EP, expert shard over DP,
+hybrid_3d_moe.cpp:202-208).
+
+All three are one jitted shard_map program over a (dp, pp, tp) mesh; the
+tp axis carries TP or EP grouping.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dlnetbench_tpu.core.model_card import ModelCard
+from dlnetbench_tpu.core.model_stats import ModelStats
+from dlnetbench_tpu.core.schedule import moe_schedule, pipeline_schedule
+from dlnetbench_tpu.parallel import collectives as col
+from dlnetbench_tpu.parallel.buffers import scaled_elems, sharded_zeros
+from dlnetbench_tpu.parallel.mesh import (
+    AXIS_DP, AXIS_PP, AXIS_TP, describe_mesh, make_grid_mesh)
+from dlnetbench_tpu.proxies import burn as burnlib
+from dlnetbench_tpu.proxies.base import ProxyConfig, StepBundle
+
+
+def _infer_dp(world: int, num_stages: int, tp: int, dp: int,
+              label: str = "stages*tp (reference hybrid_3d.cpp:272)") -> int:
+    if dp:
+        return dp
+    if world % (num_stages * tp) != 0:
+        raise ValueError(f"world {world} not divisible by "
+                         f"{label} = {num_stages * tp}")
+    return world // (num_stages * tp)
+
+
+def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
+          mode: str, num_stages: int, num_microbatches: int,
+          tp: int = 1, num_expert_shards: int = 1, dp: int = 0,
+          devices=None, dtype=jnp.float32) -> StepBundle:
+    assert mode in ("2d", "3d", "moe")
+    devices = devices if devices is not None else jax.devices()
+    world = len(devices)
+    inner = num_expert_shards if mode == "moe" else tp
+    dp = _infer_dp(world, num_stages, inner, dp)
+
+    moe = None
+    if mode == "moe":
+        moe = moe_schedule(stats, card, num_stages=num_stages,
+                           num_microbatches=num_microbatches,
+                           num_expert_shards=num_expert_shards, dp=dp)
+        sched = moe.pipe
+    else:
+        sched = pipeline_schedule(stats, card, num_stages=num_stages,
+                                  num_microbatches=num_microbatches,
+                                  dp=dp, tp=tp)
+    mesh = make_grid_mesh(dp=dp, pp=num_stages, tp=inner, devices=devices)
+    cal = burnlib.calibrate()
+
+    fwd_iters = cal.iters_for_us(sched.fwd_us_per_stage_mb * cfg.time_scale)
+    bwd_iters = cal.iters_for_us(sched.bwd_us_per_stage_mb * cfg.time_scale)
+
+    pipe_elems = scaled_elems(sched.pipe_msg_elems, cfg.size_scale)
+    dp_elems = scaled_elems(sched.dp_sync_elems, cfg.size_scale)
+    tp_elems = scaled_elems(sched.tp_msg_elems, cfg.size_scale) \
+        if sched.tp_msg_elems else 0
+    a2a_elems = 0
+    if moe is not None:
+        a2a_elems = scaled_elems(moe.a2a_elems, cfg.size_scale)
+        a2a_elems += (-a2a_elems) % num_expert_shards  # divisible for A2A
+        ne_elems = scaled_elems(moe.nonexpert_sync_elems, cfg.size_scale)
+        ex_elems = scaled_elems(moe.expert_sync_elems, cfg.size_scale)
+
+    act = sharded_zeros(mesh, P(), (pipe_elems,), dtype)
+    grad_shard = sharded_zeros(mesh, P(), (dp_elems,), dtype)
+    tp_buf = sharded_zeros(mesh, P(), (max(tp_elems, 1),), dtype)
+    a2a_buf = sharded_zeros(mesh, P(), (max(a2a_elems, num_expert_shards),),
+                            dtype)
+    ne_buf = sharded_zeros(mesh, P(), (max(ne_elems, 1),), dtype) \
+        if moe is not None else None
+    ex_buf = sharded_zeros(mesh, P(), (max(ex_elems, 1),), dtype) \
+        if moe is not None else None
+    state0 = sharded_zeros(mesh, P(), burnlib.DEFAULT_SHAPE,
+                           burnlib.DEFAULT_DTYPE) + burnlib.make_state()
+
+    a2a_count = moe.a2a_per_direction if moe is not None else 0
+
+    def inner_comms(state, bufs, with_comm):
+        """Per-microbatch TP allreduces or MoE A2As, after the p2p hop."""
+        outs = []
+        if not with_comm:
+            return outs
+        if mode == "3d":
+            t = bufs["tp"]
+            for _ in range(2):  # column + row parallel linear
+                t = col.allreduce(col.tie(t, state), AXIS_TP)
+                outs.append(t)
+        elif mode == "moe":
+            a = bufs["a2a"].reshape(num_expert_shards, -1)
+            for _ in range(a2a_count):  # dispatch+combine per MoE layer
+                a = col.alltoall(col.tie(a, state), AXIS_TP)
+                outs.append(a)
+        return outs
+
+    def step(state, act_b, grad_b, tp_b, a2a_b, ne_b, ex_b, *,
+             with_compute: bool, with_comm: bool):
+        def burn_(s, iters):
+            return burnlib.burn(s, iters) if with_compute else s
+
+        bufs = {"tp": tp_b, "a2a": a2a_b}
+        outs = []
+        cur = act_b
+        # phase 1: all microbatches forward (hybrid_2d.cpp:106-133)
+        for _ in range(num_microbatches):
+            state = burn_(state, fwd_iters)
+            if with_comm:
+                cur = col.shift_up(col.tie(cur, state), AXIS_PP)
+            state = col.tie(state, cur)
+            outs.extend(inner_comms(state, bufs, with_comm))
+        # phase 2: all microbatches backward, mirrored (hybrid_2d.cpp:135-161)
+        for _ in range(num_microbatches):
+            state = burn_(state, bwd_iters)
+            if with_comm:
+                cur = col.shift_down(col.tie(cur, state), AXIS_PP)
+            state = col.tie(state, cur)
+            outs.extend(inner_comms(state, bufs, with_comm))
+        # phase 3: gradient sync
+        if with_comm:
+            if mode == "moe":
+                # two-level: non-expert over EP, expert shard over DP
+                # (hybrid_3d_moe.cpp:202-208)
+                outs.append(col.allreduce(col.tie(ne_b, state), AXIS_TP))
+                outs.append(col.allreduce(col.tie(ex_b, state), AXIS_DP))
+            else:
+                outs.append(col.allreduce(col.tie(grad_b, state), AXIS_DP))
+        return (state, cur, *col.fence(*outs))
+
+    zero = jnp.zeros((1,), dtype)
+    ne_in = ne_buf if ne_buf is not None else zero
+    ex_in = ex_buf if ex_buf is not None else zero
+
+    def make(with_compute, with_comm):
+        fn = shard_map(
+            functools.partial(step, with_compute=with_compute,
+                              with_comm=with_comm),
+            mesh=mesh, in_specs=tuple(P() for _ in range(7)),
+            out_specs=P(), check_vma=False)
+        jitted = jax.jit(fn)
+        return lambda: jitted(state0, act, grad_shard, tp_buf, a2a_buf,
+                              ne_in, ex_in)
+
+    # per-collective comm-only variants
+    def make_var(body, *bufs):
+        fn = shard_map(body, mesh=mesh, in_specs=tuple(P() for _ in bufs),
+                       out_specs=P(), check_vma=False)
+        jitted = jax.jit(fn)
+        return lambda: jitted(*bufs)
+
+    def pp_body(a):
+        outs = []
+        for _ in range(num_microbatches):
+            a = col.shift_up(a, AXIS_PP)
+            outs.append(a)
+        for _ in range(num_microbatches):
+            a = col.shift_down(a, AXIS_PP)
+            outs.append(a)
+        return col.fence(*outs)
+
+    variants = {"pp_comm": make_var(pp_body, act)}
+    if mode == "moe":
+        def ep_body(a):
+            a = a.reshape(num_expert_shards, -1)
+            outs = []
+            for _ in range(2 * num_microbatches * a2a_count):
+                a = col.alltoall(a, AXIS_TP)
+                outs.append(a)
+            return col.fence(*outs)
+
+        def dp_ep_body(ne, ex):
+            return col.fence(col.allreduce(ne, AXIS_TP),
+                             col.allreduce(ex, AXIS_DP))
+
+        variants["ep_comm"] = make_var(ep_body, a2a_buf)
+        variants["dp_ep_comm"] = make_var(dp_ep_body, ne_buf, ex_buf)
+    else:
+        def dp_body(g):
+            return col.allreduce(g, AXIS_DP)
+
+        variants["dp_comm"] = make_var(dp_body, grad_shard)
+        if mode == "3d":
+            def tp_body(t):
+                outs = []
+                for _ in range(2 * 2 * num_microbatches):
+                    t = col.allreduce(t, AXIS_TP)
+                    outs.append(t)
+                return col.fence(*outs)
+
+            variants["tp_comm"] = make_var(tp_body, tp_buf)
+
+    itemsize = jnp.dtype(dtype).itemsize
+    meta = {
+        "proxy": {"2d": "hybrid_2d", "3d": "hybrid_3d",
+                  "moe": "hybrid_3d_moe"}[mode],
+        "model": stats.name,
+        "world_size": world,
+        "dp": dp, "num_stages": num_stages, "tp": tp,
+        "num_expert_shards": num_expert_shards if mode == "moe" else 0,
+        "num_microbatches": num_microbatches,
+        "layers_per_stage": sched.layers_per_stage,
+        "pipe_msg_bytes": int(pipe_elems * itemsize),
+        "schedule_pipe_msg_bytes": int(sched.pipe_msg_elems
+                                       * stats.bytes_per_element),
+        "dp_sync_bytes": int(dp_elems * itemsize),
+        "tp_msg_bytes": int(tp_elems * itemsize),
+        "a2a_bytes": int(a2a_elems * itemsize),
+        "fwd_us_per_stage_mb": sched.fwd_us_per_stage_mb * cfg.time_scale,
+        "bwd_us_per_stage_mb": sched.bwd_us_per_stage_mb * cfg.time_scale,
+        "burn_ns_per_iter": cal.ns_per_iter,
+        "mesh": describe_mesh(mesh),
+        "size_scale": cfg.size_scale,
+        "time_scale": cfg.time_scale,
+    }
+    return StepBundle(
+        full=make(True, True),
+        compute=make(True, False),
+        comm=make(False, True),
+        variants=variants,
+        global_meta=meta,
+    )

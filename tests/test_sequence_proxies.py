@@ -1,0 +1,51 @@
+"""Sequence-parallel proxy tests (ring attention, Ulysses) — the rebuild's
+extension beyond the reference (SURVEY.md §5.7)."""
+import pytest
+
+from dlnetbench_tpu.core.model_card import load_model_card
+from dlnetbench_tpu.core.model_stats import load_model_stats
+from dlnetbench_tpu.proxies import ring_attention, ulysses
+from dlnetbench_tpu.proxies.base import ProxyConfig, run_proxy
+
+TINY = dict(size_scale=1e-6, time_scale=5e-5)
+CFG = ProxyConfig(warmup=1, runs=2, **TINY)
+
+
+def test_ring_attention(eight_devices):
+    stats = load_model_stats("llama3_8b_16_bfloat16")
+    card = load_model_card("llama3_8b")
+    bundle = ring_attention.build(stats, card, CFG, sp=4, devices=eight_devices,
+                                  max_layers=4)
+    result = run_proxy("ring_attention", bundle, CFG)
+    g = result.global_meta
+    assert g["dp"] == 2 and g["sp"] == 4
+    assert g["ring_hops_per_layer"] == 3
+    assert g["seq_per_rank"] == card.seq_len // 4
+    assert "ring_comm_time" in result.timers_us
+    assert all(t > 0 for t in result.timers_us["runtimes"])
+
+
+def test_ring_attention_bad_sp(eight_devices):
+    stats = load_model_stats("llama3_8b_16_bfloat16")
+    card = load_model_card("llama3_8b")
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention.build(stats, card, CFG, sp=5, devices=eight_devices[:5])
+
+
+def test_ulysses(eight_devices):
+    stats = load_model_stats("llama3_8b_16_bfloat16")
+    card = load_model_card("llama3_8b")
+    bundle = ulysses.build(stats, card, CFG, sp=8, devices=eight_devices,
+                           max_layers=4)
+    result = run_proxy("ulysses", bundle, CFG)
+    g = result.global_meta
+    assert g["dp"] == 1 and g["sp"] == 8
+    assert g["a2a_bytes"] > 0 and g["a2a_bytes"] % 8 == 0
+    assert "a2a_comm_time" in result.timers_us
+
+
+def test_ulysses_head_divisibility(eight_devices):
+    stats = load_model_stats("vit_b_16_bfloat16")
+    card = load_model_card("vit_b")  # 12 heads
+    with pytest.raises(ValueError, match="heads"):
+        ulysses.build(stats, card, CFG, sp=8, devices=eight_devices)
