@@ -1,0 +1,86 @@
+"""Headline benchmark — runs on the real TPU chip under the driver.
+
+Measures the real-compute tier doing what the reference can only simulate:
+a full training step (forward + backward + SGD) of a llama3_8b-shaped
+block stack, and reports achieved FLOP/s as a fraction of this chip's
+roofline — the same ``min(peak, AI*BW)`` model the stat-file generator
+uses (reference python/model_stats.py:47-50, re-derived for TPU in
+core/roofline.py).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <step ms>, "unit": "ms",
+   "vs_baseline": <achieved/roofline, 1.0 = roofline-perfect>}
+"""
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+
+import jax
+import jax.numpy as jnp
+
+BATCH = 2
+SEQ = 1024
+LAYERS = 4
+VOCAB = 32768
+
+
+def main() -> int:
+    from dlnetbench_tpu.core.hardware import HARDWARE
+    from dlnetbench_tpu.core.model_card import ModelCard, load_model_card
+    from dlnetbench_tpu.core import roofline
+    from dlnetbench_tpu.models import transformer as tfm
+    from dlnetbench_tpu.utils.timing import time_callable
+
+    dev = jax.devices()[0]
+    # "TPU v5 lite" -> tpu_v5e, "TPU v5p"/"TPU v4"/"TPU v6 lite" likewise
+    kind = dev.device_kind.lower().replace(" ", "").replace("lite", "e")
+    hw_key = next((k for k in HARDWARE
+                   if k.startswith("tpu") and k.replace("tpu_", "") in kind),
+                  "tpu_v5e")
+
+    base = load_model_card("llama3_8b")
+    card = ModelCard(name="llama3_8b_bench", embed_dim=base.embed_dim,
+                     num_heads=base.num_heads, num_kv_heads=base.num_kv_heads,
+                     ff_dim=base.ff_dim, seq_len=SEQ,
+                     num_decoder_blocks=LAYERS, vocab_size=VOCAB,
+                     gated_mlp=True)
+    cfg = tfm.TransformerConfig.from_card(card)
+    cfg = tfm.TransformerConfig(**{**cfg.__dict__, "remat": True})
+
+    params = tfm.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (BATCH, SEQ + 1), 0, VOCAB)
+
+    @jax.jit
+    def train_step(p, t):
+        loss, g = jax.value_and_grad(tfm.loss_fn)(p, t, cfg)
+        return jax.tree.map(lambda a, b: a - 1e-3 * b.astype(a.dtype), p, g), loss
+
+    params2, loss = train_step(params, tokens)  # compile
+    jax.block_until_ready(params2)
+
+    samples = time_callable(train_step, params, tokens, reps=10)
+    step_s = statistics.median(samples)
+
+    # analytic FLOPs: fwd + ~2x bwd = 3x forward (reference bwd/fwd=2 model)
+    fwd_flops = roofline.model_flops(card, BATCH)
+    total_flops = 3 * fwd_flops
+    roofline_s = 3 * roofline.forward_time_s(card, BATCH, "bfloat16", hw_key)
+    achieved = total_flops / step_s
+    vs_baseline = roofline_s / step_s  # 1.0 = running at the roofline
+
+    print(json.dumps({
+        "metric": f"llama3_8b-shaped {LAYERS}L train step, B={BATCH} S={SEQ}, "
+                  f"{dev.device_kind} ({hw_key})",
+        "value": round(step_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(vs_baseline, 4),
+        "tflops_achieved": round(achieved / 1e12, 2),
+        "loss": round(float(loss), 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""Real model families — the rebuild's compute tier.
+
+The reference does no real math (compute is usleep, SURVEY.md intro); its
+model knowledge lives only in architecture cards and roofline stat files.
+This package implements the card architectures for real: a llama/gpt2
+decoder family, ViT encoders, and Mixtral-style MoE — pure-JAX pytrees
+with scan-stacked layers, bfloat16 compute, and (in ``spmd``) a manual
+shard_map training step exercising dp/pp/tp/sp/ep on a device mesh.  The
+same harness can therefore run both proxy mode (burn + collectives) and
+real-math mode, and calibration can compare the two.
+"""

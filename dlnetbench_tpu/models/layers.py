@@ -1,0 +1,116 @@
+"""Building-block layers (pure JAX, no flax): norms, RoPE, attention,
+MLPs, dense MoE routing.
+
+Conventions: parameters are plain dict pytrees; compute dtype is the
+input's dtype (bfloat16 on TPU) with float32 accumulation where precision
+matters (norm statistics, softmax, router logits); matmuls request float32
+``preferred_element_type`` so the MXU accumulates in fp32.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_F32 = jnp.float32
+
+
+def init_dense(key, shape, scale, dtype):
+    """Gaussian init in fp32, cast to the compute dtype (shared by all
+    model families)."""
+    return (jax.random.normal(key, shape, _F32) * scale).astype(dtype)
+
+
+def rmsnorm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(_F32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale
+
+
+def layernorm(x, scale, bias, eps=1e-5):
+    xf = x.astype(_F32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return y.astype(x.dtype) * scale + bias
+
+
+def rope(q, k, positions, theta=10000.0):
+    """Rotary embeddings; q/k: [..., S, H, Dh], positions: [S]."""
+    dh = q.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, dh, 2, dtype=_F32) / dh))
+    angles = positions.astype(_F32)[:, None] * inv_freq[None, :]  # [S, Dh/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(_F32), 2, axis=-1)
+        return jnp.concatenate([x1 * cos - x2 * sin,
+                                x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def attention(q, k, v, causal: bool):
+    """q: [B, S, Hq, Dh], k/v: [B, S, Hkv, Dh] (GQA broadcast).
+    Softmax in fp32."""
+    b, s, hq, dh = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    q = q.reshape(b, s, hkv, group, dh)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k,
+                        preferred_element_type=_F32)
+    scores = scores / jnp.sqrt(jnp.asarray(dh, _F32))
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
+                     preferred_element_type=_F32)
+    return out.reshape(b, s, hq, dh).astype(v.dtype)
+
+
+def swiglu(x, w_gate, w_up, w_down):
+    h = jax.nn.silu(jnp.dot(x, w_gate, preferred_element_type=_F32))
+    h = h * jnp.dot(x, w_up, preferred_element_type=_F32)
+    return jnp.dot(h.astype(x.dtype), w_down,
+                   preferred_element_type=_F32).astype(x.dtype)
+
+
+def gelu_mlp(x, w_in, b_in, w_out, b_out):
+    h = jax.nn.gelu(jnp.dot(x, w_in, preferred_element_type=_F32) + b_in)
+    return (jnp.dot(h.astype(x.dtype), w_out,
+                    preferred_element_type=_F32) + b_out).astype(x.dtype)
+
+
+def moe_router(x, w_router, top_k: int):
+    """Token router: returns (weights [T, k], expert indices [T, k]).
+    Softmax over the selected top-k (Mixtral convention)."""
+    logits = jnp.dot(x.astype(_F32), w_router.astype(_F32))
+    top_vals, top_idx = jax.lax.top_k(logits, top_k)
+    weights = jax.nn.softmax(top_vals, axis=-1)
+    return weights, top_idx
+
+
+def moe_dense(x2d, w_router, w_gate, w_up, w_down, top_k: int):
+    """Dense (every-expert-computes-selected-tokens) MoE for single-device
+    execution: experts stacked on the leading axis of w_* ([E, ...]).
+    Selection via one-hot combine — compiler-friendly, no dynamic shapes.
+    """
+    t, d = x2d.shape
+    e = w_gate.shape[0]
+    weights, idx = moe_router(x2d, w_router, top_k)        # [T,k], [T,k]
+    # combine[t, e] = sum_k weights[t,k] * (idx[t,k]==e)
+    combine = jnp.sum(jax.nn.one_hot(idx, e, dtype=_F32)
+                      * weights[..., None], axis=1)        # [T, E]
+    h = jnp.einsum("td,edh->teh", x2d, w_gate, preferred_element_type=_F32)
+    u = jnp.einsum("td,edh->teh", x2d, w_up, preferred_element_type=_F32)
+    h = jax.nn.silu(h) * u
+    y = jnp.einsum("teh,ehd->ted", h.astype(x2d.dtype), w_down,
+                   preferred_element_type=_F32)            # [T, E, D]
+    return jnp.einsum("ted,te->td", y, combine).astype(x2d.dtype)
+
+
+def cross_entropy(logits, targets):
+    """Mean token cross-entropy; logits [.., V] in any dtype, fp32 inside."""
+    logp = jax.nn.log_softmax(logits.astype(_F32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -1,0 +1,356 @@
+"""Fully-sharded training step: dp x pp x tp(+sp,+ep) on one mesh.
+
+This is the real-compute counterpart of the hybrid proxies — one manual
+``shard_map`` program over a (dp, pp, tp) mesh implementing, with actual
+math, every parallelism family the proxies replay as traffic (SURVEY.md
+§2.5) plus the sequence dimension the reference lacks:
+
+  dp  batch sharding; gradient psum over the dp axis
+      (the proxies' dp allreduce, reference dp.cpp:87-106)
+  pp  GPipe: layers split into stages, microbatches streamed with
+      ``ppermute``; stage s works on microbatch t-s at tick t, bubbles
+      masked (the hybrid_2d schedule, reference hybrid_2d.cpp:90-169)
+  tp  Megatron attention/head sharding: column-parallel QKV, row-parallel
+      output proj with psum_scatter (the hybrid_3d TP allreduces,
+      reference hybrid_3d.cpp:142-148)
+  sp  Megatron-style sequence parallelism: activations between blocks are
+      sequence-sharded over the tp axis; all_gather to enter attention,
+      psum_scatter to leave (no reference counterpart — SURVEY.md §5.7)
+  ep  GShard/Mixtral expert parallelism: capacity-based top-k dispatch via
+      one-hot matmuls, experts sharded over the tp axis, all_to_all to
+      dispatch and combine (the hybrid_3d_moe A2As, reference
+      hybrid_3d_moe.cpp:161-165)
+
+Backward is ``jax.grad`` *through the collectives* (XLA transposes
+ppermute/psum/all_to_all), then gradients are psum'd over every mesh axis
+a parameter is replicated on.  The driver's ``dryrun_multichip`` entry
+jit-compiles and runs this step on an N-virtual-device mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlnetbench_tpu.models import layers as Lyr
+from dlnetbench_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP, make_grid_mesh
+
+_F32 = jnp.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class SpmdConfig:
+    vocab_size: int = 128
+    embed_dim: int = 64
+    num_heads: int = 4
+    num_kv_heads: int = 4
+    ff_dim: int = 128
+    num_layers: int = 4          # total; split over pp
+    seq_len: int = 32            # split over tp (sequence parallelism)
+    num_experts: int = 4         # split over tp (expert parallelism)
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    batch: int = 8               # split over dp
+    num_microbatches: int = 2
+    lr: float = 0.1
+    dtype: str = "float32"       # bfloat16 on real TPU
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    def validate(self, dp: int, pp: int, tp: int) -> None:
+        checks = [
+            (self.num_layers % pp == 0, "layers % pp"),
+            (self.batch % (dp * self.num_microbatches) == 0,
+             "batch % (dp*microbatches)"),
+            (self.seq_len % tp == 0, "seq_len % tp (sp sharding)"),
+            (self.num_heads % tp == 0, "heads % tp"),
+            (self.num_kv_heads % tp == 0, "kv_heads % tp"),
+            (self.num_experts % tp == 0, "experts % tp (ep sharding)"),
+            (self.vocab_size % tp == 0, "vocab % tp (parallel head)"),
+        ]
+        for ok, what in checks:
+            if not ok:
+                raise ValueError(f"SpmdConfig invalid for mesh "
+                                 f"({dp},{pp},{tp}): {what} != 0")
+
+
+# --------------------------------------------------------------------- #
+# Parameter init + sharding specs (GLOBAL shapes; specs map to the mesh)
+# --------------------------------------------------------------------- #
+
+
+def init_params(key, cfg: SpmdConfig) -> dict:
+    d, dh = cfg.embed_dim, cfg.head_dim
+    dkv = cfg.num_kv_heads * dh
+    h, L, v, e = cfg.ff_dim, cfg.num_layers, cfg.vocab_size, cfg.num_experts
+    dt = cfg.jdtype
+    s_d, s_h = 1.0 / math.sqrt(d), 1.0 / math.sqrt(h)
+    ks = iter(jax.random.split(key, 16))
+    return {
+        "embed": Lyr.init_dense(next(ks), (v, d), 1.0, dt),
+        "layers": {
+            "wq": Lyr.init_dense(next(ks), (L, d, d), s_d, dt),
+            "wk": Lyr.init_dense(next(ks), (L, d, dkv), s_d, dt),
+            "wv": Lyr.init_dense(next(ks), (L, d, dkv), s_d, dt),
+            "wo": Lyr.init_dense(next(ks), (L, d, d), s_d, dt),
+            "norm1": jnp.ones((L, d), dt),
+            "norm2": jnp.ones((L, d), dt),
+            "w_router": Lyr.init_dense(next(ks), (L, d, e), s_d, dt),
+            "w_gate": Lyr.init_dense(next(ks), (L, e, d, h), s_d, dt),
+            "w_up": Lyr.init_dense(next(ks), (L, e, d, h), s_d, dt),
+            "w_down": Lyr.init_dense(next(ks), (L, e, h, d), s_h, dt),
+        },
+        "final_norm": jnp.ones((d,), dt),
+        "head": Lyr.init_dense(next(ks), (d, v), s_d, dt),
+    }
+
+
+def param_specs() -> dict:
+    """PartitionSpec per leaf: layer stack over pp; Megatron TP on qkv/o;
+    experts over tp (ep); parallel head over tp on vocab."""
+    return {
+        "embed": P(),                              # replicated
+        "layers": {
+            "wq": P(AXIS_PP, None, AXIS_TP),       # column parallel
+            "wk": P(AXIS_PP, None, AXIS_TP),
+            "wv": P(AXIS_PP, None, AXIS_TP),
+            "wo": P(AXIS_PP, AXIS_TP, None),       # row parallel
+            "norm1": P(AXIS_PP, None),
+            "norm2": P(AXIS_PP, None),
+            "w_router": P(AXIS_PP, None, None),
+            "w_gate": P(AXIS_PP, AXIS_TP, None, None),   # expert sharded
+            "w_up": P(AXIS_PP, AXIS_TP, None, None),
+            "w_down": P(AXIS_PP, AXIS_TP, None, None),
+        },
+        "final_norm": P(),
+        "head": P(None, AXIS_TP),                  # parallel vocab head
+    }
+
+
+def _replicated_axes(spec: P) -> tuple:
+    """Mesh axes (excluding dp, which every grad is already mean-reduced
+    over) that a parameter is replicated across — its gradient must be
+    psum'd over exactly these."""
+    used = {a for part in spec if part
+            for a in ((part,) if isinstance(part, str) else part)}
+    return tuple(a for a in (AXIS_PP, AXIS_TP) if a not in used)
+
+
+# --------------------------------------------------------------------- #
+# Per-device (shard_map) forward
+# --------------------------------------------------------------------- #
+def _moe_block(cfg: SpmdConfig, tp: int, y, lp):
+    """y: [mb, S/tp, d] local tokens; experts sharded over tp (EP)."""
+    mb, s_loc, d = y.shape
+    t = mb * s_loc
+    e = cfg.num_experts
+    x2 = y.reshape(t, d)
+    weights, idx = Lyr.moe_router(x2, lp["w_router"], cfg.top_k)
+    cap = max(1, int(cfg.capacity_factor * t * cfg.top_k / e))
+
+    # capacity-based one-hot dispatch (GShard style): token t -> expert e
+    onehot = jax.nn.one_hot(idx, e, dtype=_F32)            # [T, k, E]
+    gate = jnp.sum(onehot * weights[..., None], axis=1)     # [T, E]
+    mask = jnp.sum(onehot, axis=1)                          # [T, E] 0/1
+    pos = jnp.cumsum(mask, axis=0) - 1.0                    # arrival order
+    keep = mask * (pos < cap)
+    disp = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=_F32) \
+        * keep[..., None]                                   # [T, E, C]
+
+    ein = jnp.einsum("tec,td->ecd", disp, x2.astype(_F32))  # [E, C, d]
+    # EP all_to_all: [E, C, d] -> [E/tp, C*tp, d] (each rank gets its experts'
+    # tokens from every peer — the hybrid_3d_moe dispatch A2A)
+    if tp > 1:
+        ein = lax.all_to_all(ein, AXIS_TP, split_axis=0, concat_axis=1,
+                             tiled=True)
+    ein = ein.astype(cfg.jdtype)
+    h = jax.nn.silu(jnp.einsum("ecd,edh->ech", ein, lp["w_gate"],
+                               preferred_element_type=_F32))
+    h = h * jnp.einsum("ecd,edh->ech", ein, lp["w_up"],
+                       preferred_element_type=_F32)
+    out = jnp.einsum("ech,ehd->ecd", h.astype(cfg.jdtype), lp["w_down"],
+                     preferred_element_type=_F32)
+    if tp > 1:  # combine A2A (reverse reshard)
+        out = lax.all_to_all(out, AXIS_TP, split_axis=1, concat_axis=0,
+                             tiled=True)
+    y2 = jnp.einsum("ecd,tec->td", out, (disp * gate[..., None]))
+    return y2.reshape(mb, s_loc, d).astype(y.dtype)
+
+
+def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions):
+    """One decoder block under TP+SP; x: [mb, S/tp, d] sequence-sharded."""
+    mb, s_loc, d = x.shape
+    h_loc = cfg.num_heads // tp
+    hkv_loc = cfg.num_kv_heads // tp
+    dh = cfg.head_dim
+
+    y = Lyr.rmsnorm(x, lp["norm1"])
+    if tp > 1:  # SP: gather the full sequence to enter attention
+        y = lax.all_gather(y, AXIS_TP, axis=1, tiled=True)   # [mb, S, d]
+    s_full = y.shape[1]
+    q = jnp.dot(y, lp["wq"]).reshape(mb, s_full, h_loc, dh)
+    k = jnp.dot(y, lp["wk"]).reshape(mb, s_full, hkv_loc, dh)
+    v = jnp.dot(y, lp["wv"]).reshape(mb, s_full, hkv_loc, dh)
+    q, k = Lyr.rope(q, k, positions)
+    att = Lyr.attention(q, k, v, causal=True).reshape(mb, s_full, d // tp
+                                                      if tp > 1 else d)
+    out = jnp.dot(att, lp["wo"])                              # partial sums
+    if tp > 1:  # SP: reduce partials and scatter back to sequence shards
+        out = lax.psum_scatter(out, AXIS_TP, scatter_dimension=1, tiled=True)
+    x = x + out
+
+    y = Lyr.rmsnorm(x, lp["norm2"])
+    return x + _moe_block(cfg, tp, y, lp)
+
+
+def _vocab_parallel_ce(logits_loc, targets, tp: int, vocab: int):
+    """Megatron-style vocab-parallel cross entropy.
+
+    ``logits_loc``: [..., V/tp] — this rank's vocab shard of the logits for
+    the FULL (gathered) token set; ``targets``: [...] global vocab ids.
+    Softmax normalization and the target logit are assembled with
+    pmax/psum over the tp axis; every rank returns the same scalar.
+    """
+    v_loc = logits_loc.shape[-1]
+    shard = lax.axis_index(AXIS_TP)
+    lg = logits_loc.astype(_F32)
+    # the max shift is numerical stabilization only — constant wrt autodiff
+    m = jnp.max(lax.stop_gradient(lg), axis=-1)
+    gmax = lax.pmax(m, AXIS_TP)
+    sumexp = jnp.sum(jnp.exp(lg - gmax[..., None]), axis=-1)
+    denom = lax.psum(sumexp, AXIS_TP)
+    local_t = targets - shard * v_loc
+    in_range = (local_t >= 0) & (local_t < v_loc)
+    tval = jnp.take_along_axis(
+        lg, jnp.clip(local_t, 0, v_loc - 1)[..., None], axis=-1)[..., 0]
+    tval = lax.psum(jnp.where(in_range, tval, 0.0), AXIS_TP)
+    return jnp.mean(jnp.log(denom) + gmax - tval)
+
+
+def make_train_step(mesh: Mesh, cfg: SpmdConfig):
+    dp, pp, tp = (mesh.devices.shape[mesh.axis_names.index(a)]
+                  for a in (AXIS_DP, AXIS_PP, AXIS_TP))
+    cfg.validate(dp, pp, tp)
+    specs = param_specs()
+    mb_size = cfg.batch // (dp * cfg.num_microbatches)
+    m = cfg.num_microbatches
+    positions = jnp.arange(cfg.seq_len)
+
+    def local_loss(params_loc, tokens_loc):
+        """Per-device pipeline forward; tokens_loc: [B/dp, S+1]."""
+        stage = lax.axis_index(AXIS_PP)
+        tp_idx = lax.axis_index(AXIS_TP)
+        s_loc = cfg.seq_len // tp
+        inputs = tokens_loc[:, :-1].reshape(m, mb_size, cfg.seq_len)
+        targets = tokens_loc[:, 1:].reshape(m, mb_size, cfg.seq_len)
+
+        def run_stage(x):
+            def body(carry, lp):
+                return _stage_block(cfg, tp, carry, lp, positions), None
+            out, _ = lax.scan(body, x, params_loc["layers"])
+            return out
+
+        ticks = m + pp - 1
+        x_carry = jnp.zeros((mb_size, s_loc, cfg.embed_dim), cfg.jdtype)
+        loss_sum = jnp.zeros((), _F32)
+        for t in range(ticks):
+            mb_me = t - stage                       # my microbatch this tick
+            mb_c = jnp.clip(mb_me, 0, m - 1)
+            valid = (mb_me >= 0) & (mb_me < m)
+            inp = lax.dynamic_index_in_dim(inputs, mb_c, 0, keepdims=False)
+            # sequence shard for SP: my slice of the sequence
+            inp_loc = lax.dynamic_slice_in_dim(inp, tp_idx * s_loc, s_loc, 1)
+            emb = params_loc["embed"][inp_loc]      # [mb, S/tp, d]
+            x_in = jnp.where(stage == 0, emb, x_carry)
+            x_out = run_stage(x_in)
+            # last stage: loss for this tick's microbatch
+            xh = Lyr.rmsnorm(x_out, params_loc["final_norm"])
+            tgt = lax.dynamic_index_in_dim(targets, mb_c, 0, keepdims=False)
+            if tp > 1:
+                # gather the sequence so every rank scores all tokens
+                # against its vocab shard, then vocab-parallel CE
+                xh = lax.all_gather(xh, AXIS_TP, axis=1, tiled=True)
+                logits_loc = jnp.dot(xh, params_loc["head"],
+                                     preferred_element_type=_F32)
+                # divided by tp: every tp rank computes the same replicated
+                # scalar, so each seeds 1/tp of the cotangent — the psum
+                # transposes inside the CE then deliver exactly 1 in total
+                mb_loss = _vocab_parallel_ce(logits_loc, tgt, tp,
+                                             cfg.vocab_size) / tp
+            else:
+                logits = jnp.dot(xh, params_loc["head"],
+                                 preferred_element_type=_F32)
+                mb_loss = Lyr.cross_entropy(logits, tgt)
+            is_last = stage == pp - 1
+            loss_sum = loss_sum + jnp.where(valid & is_last, mb_loss, 0.0)
+            # stream activations to the next stage
+            if pp > 1:
+                perm = [(i, i + 1) for i in range(pp - 1)]
+                x_carry = lax.ppermute(x_out, AXIS_PP, perm)
+            else:
+                x_carry = x_out
+        # LOCAL loss (nonzero only on the last stage; 1/tp share per tp
+        # rank).  Deliberately NOT psum'd here: a psum inside the
+        # differentiated function transposes to a broadcast that double
+        # counts every rank's unit cotangent seed (grads would scale by
+        # the axis size).  step_local psums the value for reporting.
+        return loss_sum / m
+
+    def step_local(params_loc, tokens_loc):
+        loss, grads = jax.value_and_grad(local_loss)(params_loc, tokens_loc)
+        # grad sync: psum over dp (data parallel, mean) ...
+        grads = jax.tree.map(lambda g: lax.psum(g, AXIS_DP) / dp, grads)
+        # ... and over every axis the param is replicated on (transpose of
+        # the implicit broadcast in the manual-sharding forward)
+        grads = jax.tree.map(
+            lambda g, sp: lax.psum(g, _replicated_axes(sp))
+            if _replicated_axes(sp) else g,
+            grads, specs, is_leaf=lambda x: isinstance(x, P))
+        # reassemble the replicated loss value for reporting: sum the
+        # last-stage / per-tp-rank shares, mean over dp groups
+        loss = lax.psum(loss, (AXIS_PP, AXIS_TP))
+        loss = lax.psum(loss, AXIS_DP) / dp
+        new_params = jax.tree.map(lambda p_, g: p_ - cfg.lr * g.astype(p_.dtype),
+                                  params_loc, grads)
+        return new_params, loss
+
+    in_specs = (specs, P(AXIS_DP, None))
+    out_specs = (specs, P())
+    fn = shard_map(step_local, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_vma=False)
+    return jax.jit(fn)
+
+
+def factor_mesh(n_devices: int) -> tuple[int, int, int]:
+    """(dp, pp, tp) for an n-device dry run: prefer 2-way pp and tp."""
+    tp = 2 if n_devices % 2 == 0 else 1
+    pp = 2 if n_devices % (2 * tp) == 0 else 1
+    dp = n_devices // (pp * tp)
+    return dp, pp, tp
+
+
+def build(n_devices: int | None = None, cfg: SpmdConfig | None = None,
+          devices=None):
+    """Convenience: mesh + params + tokens + jitted step."""
+    devices = devices if devices is not None else jax.devices()
+    n = n_devices or len(devices)
+    dp, pp, tp = factor_mesh(n)
+    mesh = make_grid_mesh(dp=dp, pp=pp, tp=tp, devices=devices[:n])
+    cfg = cfg or SpmdConfig()
+    step = make_train_step(mesh, cfg)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1),
+                                (cfg.batch, cfg.seq_len + 1), 0,
+                                cfg.vocab_size)
+    return mesh, cfg, step, params, tokens

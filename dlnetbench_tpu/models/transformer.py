@@ -1,0 +1,190 @@
+"""Decoder-family transformer (llama / gpt2 variants), pure JAX.
+
+Architecture is read off a ``ModelCard``: ``gated_mlp`` selects
+SwiGLU+RMSNorm+RoPE (llama/minerva/mixtral family) vs GELU+LayerNorm+learned
+positions (gpt2 family); ``num_kv_heads`` gives GQA; ``moe_params`` turns
+every layer's MLP into a dense-dispatch MoE (Mixtral-style).  Layers are
+stacked on a leading axis and executed with ``lax.scan`` so compile time is
+O(1) in depth and XLA sees one fused block body.
+
+This is the compute that the reference only *simulates* (usleep from
+roofline stat files); here the same cards drive real math, so measured step
+times can be compared against the roofline predictions (see bench.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from dlnetbench_tpu.core.model_card import ModelCard
+from dlnetbench_tpu.models import layers as L
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int
+    embed_dim: int
+    num_heads: int
+    num_kv_heads: int
+    ff_dim: int
+    num_layers: int
+    seq_len: int
+    gated: bool              # SwiGLU+RMSNorm+RoPE vs GELU+LayerNorm+learned
+    max_positions: int       # learned positions (gpt2 family), 0 = RoPE
+    num_experts: int = 1
+    top_k: int = 1
+    tied_embeddings: bool = False
+    dtype: str = "bfloat16"
+    remat: bool = False      # jax.checkpoint each block: recompute activations
+                             # in backward instead of storing S x S residuals
+
+    @classmethod
+    def from_card(cls, card: ModelCard, *, seq_len: int | None = None,
+                  num_layers: int | None = None,
+                  vocab_size: int | None = None) -> "TransformerConfig":
+        """Build from an architecture card, optionally overriding size knobs
+        (tests and single-chip benches shrink seq/layers/vocab)."""
+        if card.is_vit:
+            raise ValueError(f"{card.name} is a ViT card; use models.vit")
+        return cls(
+            vocab_size=vocab_size or card.vocab_size or 32000,
+            embed_dim=card.embed_dim,
+            num_heads=card.num_heads,
+            num_kv_heads=card.kv_heads,
+            ff_dim=card.ff_dim,
+            num_layers=num_layers or card.num_layers,
+            seq_len=seq_len or card.seq_len,
+            gated=card.gated_mlp,
+            max_positions=card.max_position_embeddings,
+            num_experts=card.num_experts,
+            top_k=card.top_k,
+            tied_embeddings=card.tied_embeddings,
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+
+
+def init_params(key, cfg: TransformerConfig) -> dict:
+    d, dh = cfg.embed_dim, cfg.head_dim
+    dkv = cfg.num_kv_heads * dh
+    h, L_, v = cfg.ff_dim, cfg.num_layers, cfg.vocab_size
+    dt = cfg.jdtype
+    s_d = 1.0 / math.sqrt(d)
+    s_h = 1.0 / math.sqrt(h)
+    keys = iter(jax.random.split(key, 16))
+
+    layer = {
+        "wq": L.init_dense(next(keys), (L_, d, d), s_d, dt),
+        "wk": L.init_dense(next(keys), (L_, d, dkv), s_d, dt),
+        "wv": L.init_dense(next(keys), (L_, d, dkv), s_d, dt),
+        "wo": L.init_dense(next(keys), (L_, d, d), s_d, dt),
+        "norm1": jnp.ones((L_, d), dt),
+        "norm2": jnp.ones((L_, d), dt),
+    }
+    if not cfg.gated:
+        layer.update({
+            "norm1_b": jnp.zeros((L_, d), dt),
+            "norm2_b": jnp.zeros((L_, d), dt),
+            "w_in": L.init_dense(next(keys), (L_, d, h), s_d, dt),
+            "b_in": jnp.zeros((L_, h), dt),
+            "w_out": L.init_dense(next(keys), (L_, h, d), s_h, dt),
+            "b_out": jnp.zeros((L_, d), dt),
+        })
+    elif cfg.num_experts > 1:
+        e = cfg.num_experts
+        layer.update({
+            "w_router": L.init_dense(next(keys), (L_, d, e), s_d, dt),
+            "w_gate": L.init_dense(next(keys), (L_, e, d, h), s_d, dt),
+            "w_up": L.init_dense(next(keys), (L_, e, d, h), s_d, dt),
+            "w_down": L.init_dense(next(keys), (L_, e, h, d), s_h, dt),
+        })
+    else:
+        layer.update({
+            "w_gate": L.init_dense(next(keys), (L_, d, h), s_d, dt),
+            "w_up": L.init_dense(next(keys), (L_, d, h), s_d, dt),
+            "w_down": L.init_dense(next(keys), (L_, h, d), s_h, dt),
+        })
+
+    params = {
+        "embed": L.init_dense(next(keys), (v, d), 1.0, dt),
+        "layers": layer,
+        "final_norm": jnp.ones((d,), dt),
+    }
+    if not cfg.gated:
+        params["final_norm_b"] = jnp.zeros((d,), dt)
+    if cfg.max_positions:
+        params["pos_embed"] = L.init_dense(next(keys), (cfg.max_positions, d),
+                                    0.01, dt)
+    if not cfg.tied_embeddings:
+        params["head"] = L.init_dense(next(keys), (d, v), s_d, dt)
+    return params
+
+
+def _block(cfg: TransformerConfig, x, lp, positions):
+    """One decoder block; x: [B, S, D], lp: this layer's param slice."""
+    b, s, d = x.shape
+    if cfg.gated:
+        y = L.rmsnorm(x, lp["norm1"])
+    else:
+        y = L.layernorm(x, lp["norm1"], lp["norm1_b"])
+    q = jnp.dot(y, lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = jnp.dot(y, lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = jnp.dot(y, lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    if not cfg.max_positions:  # RoPE family
+        q, k = L.rope(q, k, positions)
+    att = L.attention(q, k, v, causal=True).reshape(b, s, d)
+    x = x + jnp.dot(att, lp["wo"])
+
+    if cfg.gated:
+        y = L.rmsnorm(x, lp["norm2"])
+        if cfg.num_experts > 1:
+            y2 = L.moe_dense(y.reshape(b * s, d), lp["w_router"],
+                             lp["w_gate"], lp["w_up"], lp["w_down"],
+                             cfg.top_k).reshape(b, s, d)
+        else:
+            y2 = L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
+    else:
+        y = L.layernorm(x, lp["norm2"], lp["norm2_b"])
+        y2 = L.gelu_mlp(y, lp["w_in"], lp["b_in"], lp["w_out"], lp["b_out"])
+    return x + y2
+
+
+def forward(params: dict, tokens, cfg: TransformerConfig):
+    """tokens [B, S] int32 -> logits [B, S, V]."""
+    x = params["embed"][tokens]
+    s = tokens.shape[1]
+    positions = jnp.arange(s)
+    if cfg.max_positions:
+        x = x + params["pos_embed"][positions][None]
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(0,))
+
+    def body(carry, lp):
+        return block(cfg, carry, lp, positions), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    if cfg.gated:
+        x = L.rmsnorm(x, params["final_norm"])
+    else:
+        x = L.layernorm(x, params["final_norm"], params["final_norm_b"])
+    head = params["embed"].T if cfg.tied_embeddings else params["head"]
+    return jnp.dot(x, head, preferred_element_type=jnp.float32)
+
+
+def loss_fn(params: dict, tokens, cfg: TransformerConfig):
+    """Next-token cross-entropy on a [B, S+1] token batch."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    return L.cross_entropy(logits, tokens[:, 1:])

@@ -1,0 +1,132 @@
+"""Vision Transformer family (vit_b / vit_l / vit_h cards), pure JAX.
+
+Encoder-only: patchify -> [cls] + positions -> pre-norm encoder blocks
+(GELU MLP, bidirectional attention) -> cls-token classifier head.  Layers
+scan-stacked like the decoder family.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from dlnetbench_tpu.core.model_card import ModelCard
+from dlnetbench_tpu.models import layers as L
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int
+    patch_size: int
+    embed_dim: int
+    num_heads: int
+    ff_dim: int
+    num_layers: int
+    num_classes: int
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def from_card(cls, card: ModelCard, *, num_layers: int | None = None,
+                  image_size: int | None = None) -> "ViTConfig":
+        if not card.is_vit:
+            raise ValueError(f"{card.name} is not a ViT card")
+        return cls(
+            image_size=image_size or card.image_size,
+            patch_size=card.patch_size,
+            embed_dim=card.embed_dim,
+            num_heads=card.num_heads,
+            ff_dim=card.ff_dim,
+            num_layers=num_layers or card.num_layers,
+            num_classes=card.num_classes,
+        )
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+
+
+def init_params(key, cfg: ViTConfig) -> dict:
+    d, h, L_ = cfg.embed_dim, cfg.ff_dim, cfg.num_layers
+    p = cfg.patch_size
+    dt = cfg.jdtype
+    s_d = 1.0 / math.sqrt(d)
+    keys = iter(jax.random.split(key, 12))
+    return {
+        "patch_embed": L.init_dense(next(keys), (p * p * 3, d),
+                             1.0 / math.sqrt(p * p * 3), dt),
+        "patch_bias": jnp.zeros((d,), dt),
+        "cls_token": jnp.zeros((1, 1, d), dt),
+        "pos_embed": L.init_dense(next(keys), (cfg.num_patches + 1, d), 0.02, dt),
+        "layers": {
+            "wq": L.init_dense(next(keys), (L_, d, d), s_d, dt),
+            "wk": L.init_dense(next(keys), (L_, d, d), s_d, dt),
+            "wv": L.init_dense(next(keys), (L_, d, d), s_d, dt),
+            "wo": L.init_dense(next(keys), (L_, d, d), s_d, dt),
+            "norm1": jnp.ones((L_, d), dt),
+            "norm1_b": jnp.zeros((L_, d), dt),
+            "norm2": jnp.ones((L_, d), dt),
+            "norm2_b": jnp.zeros((L_, d), dt),
+            "w_in": L.init_dense(next(keys), (L_, d, h), s_d, dt),
+            "b_in": jnp.zeros((L_, h), dt),
+            "w_out": L.init_dense(next(keys), (L_, h, d), 1.0 / math.sqrt(h), dt),
+            "b_out": jnp.zeros((L_, d), dt),
+        },
+        "final_norm": jnp.ones((d,), dt),
+        "final_norm_b": jnp.zeros((d,), dt),
+        "head_w": L.init_dense(next(keys), (d, cfg.num_classes), s_d, dt),
+        "head_b": jnp.zeros((cfg.num_classes,), dt),
+    }
+
+
+def patchify(images, cfg: ViTConfig):
+    """[B, H, W, 3] -> [B, N, p*p*3]."""
+    b, hh, ww, c = images.shape
+    p = cfg.patch_size
+    gh, gw = hh // p, ww // p
+    x = images.reshape(b, gh, p, gw, p, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, gh * gw, p * p * c)
+
+
+def _block(cfg: ViTConfig, x, lp):
+    b, s, d = x.shape
+    y = L.layernorm(x, lp["norm1"], lp["norm1_b"])
+    q = jnp.dot(y, lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = jnp.dot(y, lp["wk"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    v = jnp.dot(y, lp["wv"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    att = L.attention(q, k, v, causal=False).reshape(b, s, d)
+    x = x + jnp.dot(att, lp["wo"])
+    y = L.layernorm(x, lp["norm2"], lp["norm2_b"])
+    return x + L.gelu_mlp(y, lp["w_in"], lp["b_in"], lp["w_out"], lp["b_out"])
+
+
+def forward(params: dict, images, cfg: ViTConfig):
+    """images [B, H, W, 3] -> class logits [B, num_classes]."""
+    x = jnp.dot(patchify(images.astype(cfg.jdtype), cfg),
+                params["patch_embed"]) + params["patch_bias"]
+    b = x.shape[0]
+    cls = jnp.broadcast_to(params["cls_token"], (b, 1, cfg.embed_dim))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"][None]
+
+    def body(carry, lp):
+        return _block(cfg, carry, lp), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = L.layernorm(x[:, 0], params["final_norm"], params["final_norm_b"])
+    return (jnp.dot(x, params["head_w"], preferred_element_type=jnp.float32)
+            + params["head_b"].astype(jnp.float32))
+
+
+def loss_fn(params: dict, images, labels, cfg: ViTConfig):
+    return L.cross_entropy(forward(params, images, cfg), labels)

@@ -1,12 +1,17 @@
 """Wall-clock timing of jitted programs.
 
 Device execution is async: a jitted call returns before the device finishes
-(SURVEY.md §5.1).  Every measurement here fences with
-``jax.block_until_ready`` on the outputs, which is the TPU analogue of the
-reference's host-blocking timer brackets (reference
+(SURVEY.md §5.1).  Every measurement here fences on the outputs — the TPU
+analogue of the reference's host-blocking timer brackets (reference
 CCUTILS_MPI_TIMER_START/STOP, cpp/data_parallel/dp.cpp:102-104) — applied
 around the *whole program*, never inside it, so on-device overlap is
 preserved.
+
+Tunnel quirk: on the remote-TPU "axon" backend, ``jax.block_until_ready``
+returns immediately (the tunnel acks dispatch, not completion); only a
+device->host transfer truly waits, and it costs a measured round-trip
+(~75 ms here).  ``time_callable`` therefore fences with a one-element
+transfer on that backend and subtracts the calibrated RTT from each sample.
 """
 from __future__ import annotations
 
@@ -14,17 +19,69 @@ import statistics
 import time
 
 import jax
+import jax.numpy as jnp
+
+_RTT_S: float | None = None
+
+
+def _needs_transfer_fence() -> bool:
+    # The remote tunnel registers its PJRT platform as plain "tpu", so there
+    # is no reliable name to gate on; a transfer fence is semantically
+    # correct on every backend and its cost (the RTT) is measured and
+    # subtracted — so always fence by transfer.
+    return True
+
+
+def _transfer_fence(res) -> None:
+    """Force completion of everything queued before ``res`` by pulling one
+    element of each device shard of one leaf to the host (the slice ops
+    queue after the program; their transfers cannot complete earlier).
+    Per-shard so multi-device programs without a final collective are fully
+    fenced even where block_until_ready is a no-op."""
+    leaf = jax.tree.leaves(res)[0]
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards:
+        for shard in shards:
+            data = shard.data
+            idx = (0,) * data.ndim
+            data[idx].item() if data.ndim else data.item()
+    else:
+        idx = (0,) * leaf.ndim
+        leaf[idx].item() if leaf.ndim else leaf.item()
+
+
+def tunnel_rtt_s() -> float:
+    """Calibrated round-trip time of a transfer fence (cached).  Each probe
+    computes a FRESH device value — jax.Array caches its host copy after
+    the first read, so re-reading the same array would time host memory,
+    not the tunnel."""
+    global _RTT_S
+    if _RTT_S is None:
+        base = jnp.zeros(())
+        (base + 0).item()  # warm dispatch + transfer path
+        samples = []
+        for i in range(1, 6):
+            t0 = time.perf_counter()
+            (base + i).item()
+            samples.append(time.perf_counter() - t0)
+        _RTT_S = min(samples)
+    return _RTT_S
 
 
 def time_callable(fn, *args, reps: int = 1, **kwargs) -> list[float]:
     """Run ``fn(*args)`` ``reps`` times, fencing each run; returns seconds
-    per run.  Caller is responsible for warmup (compilation)."""
+    per run (tunnel RTT subtracted where the backend needs a transfer
+    fence).  Caller is responsible for warmup (compilation)."""
+    fence_transfer = _needs_transfer_fence()
+    rtt = tunnel_rtt_s() if fence_transfer else 0.0
     out = []
     for _ in range(reps):
         t0 = time.perf_counter()
         res = fn(*args, **kwargs)
         jax.block_until_ready(res)
-        out.append(time.perf_counter() - t0)
+        if fence_transfer:
+            _transfer_fence(res)
+        out.append(max(0.0, time.perf_counter() - t0 - rtt))
     return out
 
 
