@@ -46,6 +46,8 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--time_scale", type=float, default=1.0)
     p.add_argument("--stats_dir", default=None)
     p.add_argument("--out", default=None, help="append JSON record to file")
+    p.add_argument("--no_topology", action="store_true",
+                   help="skip the startup fabric-topology graph")
 
 
 def _cfg(args) -> ProxyConfig:
@@ -126,6 +128,12 @@ def main(argv: list[str] | None = None) -> int:
     except FileNotFoundError as e:
         parser.error(str(e))
     devices = _devices(args)
+
+    # startup fabric graph (reference print_topology_graph at every proxy's
+    # startup, cpp/netcommunicators.hpp:142); stderr keeps stdout pure JSON
+    if not args.no_topology:
+        from dlnetbench_tpu.utils.topology import print_topology
+        print_topology(devices, stream=sys.stderr)
 
     try:
         bundle = _build_bundle(args, parser, stats, cfg, devices)

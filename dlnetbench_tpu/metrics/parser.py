@@ -80,7 +80,9 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                     "device_kind": mesh.get("device_kind"),
                 }
                 for k, v in g.items():
-                    if not isinstance(v, (list, dict)):
+                    if isinstance(v, list):
+                        row[k] = tuple(v)  # hashable, groupby-safe
+                    elif not isinstance(v, dict):
                         row[k] = v
                 for tname, tvals in timers.items():
                     if run < len(tvals):

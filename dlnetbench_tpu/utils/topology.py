@@ -1,0 +1,86 @@
+"""ASCII topology graph of the device fabric.
+
+Counterpart of the reference's switch-tree visualizer (reference
+cpp/netcommunicators.hpp:79-290): that allgathers per-rank
+``SLURM_TOPOLOGY_ADDR`` switch paths and ASCII-draws switch -> node ->
+process.  On TPU the analogous structure comes from the runtime, not
+SLURM: every ``jax.Device`` carries ``process_index`` (host) and — on real
+TPU — ``coords`` on the ICI torus plus ``slice_index`` on multi-slice
+(DCN-connected) topologies.  The tree drawn here is
+
+    fabric
+    └── slice (ICI domain)
+        └── host (process)
+            └── chip  id=.. coords=(x,y,z) core=..
+
+with host-interconnect marked DCN and intra-slice links ICI.  For CPU
+device sets (dev boxes, the forced-host-platform mesh) a synthetic
+two-level tree is drawn, mirroring the reference's non-SLURM fallback
+(netcommunicators.hpp:148-157).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+
+def _device_row(dev) -> dict:
+    return {
+        "id": dev.id,
+        "process": getattr(dev, "process_index", 0),
+        "slice": getattr(dev, "slice_index", 0) or 0,
+        "coords": tuple(getattr(dev, "coords", ()) or ()),
+        "core": getattr(dev, "core_on_chip", None),
+        "kind": getattr(dev, "device_kind", getattr(dev, "platform", "?")),
+    }
+
+
+def build_topology(devices=None) -> dict:
+    """Nested dict: slice -> host(process) -> [device rows]."""
+    if devices is None:
+        import jax
+        devices = jax.devices()
+    rows = [_device_row(d) for d in devices]
+    tree: dict = defaultdict(lambda: defaultdict(list))
+    for r in rows:
+        tree[r["slice"]][r["process"]].append(r)
+    return {s: {p: sorted(devs, key=lambda r: r["id"])
+                for p, devs in sorted(hosts.items())}
+            for s, hosts in sorted(tree.items())}
+
+
+def format_topology(devices=None) -> str:
+    tree = build_topology(devices)
+    n_slices = len(tree)
+    n_hosts = sum(len(h) for h in tree.values())
+    n_chips = sum(len(d) for h in tree.values() for d in h.values())
+    any_dev = next(iter(next(iter(tree.values())).values()))[0]
+    lines = [
+        f"fabric: {n_chips} x {any_dev['kind']} "
+        f"({n_hosts} host{'s' if n_hosts != 1 else ''}, "
+        f"{n_slices} slice{'s' if n_slices != 1 else ''}"
+        f"{', DCN-linked' if n_slices > 1 else ''})",
+    ]
+    for si, (s, hosts) in enumerate(tree.items()):
+        s_last = si == len(tree) - 1
+        s_bar = "└──" if s_last else "├──"
+        lines.append(f"{s_bar} slice {s}  [ICI domain, {len(hosts)} host(s)]")
+        s_pad = "    " if s_last else "│   "
+        for hi, (p, devs) in enumerate(hosts.items()):
+            h_last = hi == len(hosts) - 1
+            h_bar = "└──" if h_last else "├──"
+            lines.append(f"{s_pad}{h_bar} host {p}  ({len(devs)} chip(s))")
+            h_pad = s_pad + ("    " if h_last else "│   ")
+            for di, r in enumerate(devs):
+                d_bar = "└──" if di == len(devs) - 1 else "├──"
+                extra = ""
+                if r["coords"]:
+                    extra += f"  coords={r['coords']}"
+                if r["core"] is not None:
+                    extra += f"  core={r['core']}"
+                lines.append(f"{h_pad}{d_bar} chip id={r['id']}{extra}")
+    return "\n".join(lines)
+
+
+def print_topology(devices=None, stream=None) -> None:
+    import sys
+    print(format_topology(devices), file=stream or sys.stdout)

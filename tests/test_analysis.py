@@ -1,0 +1,171 @@
+"""Analysis layer: byte formatting, style maps, Pareto math, plot smoke
+tests (headless Agg backend)."""
+from __future__ import annotations
+
+import json
+
+import matplotlib
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import pytest
+
+from dlnetbench_tpu.analysis import (
+    format_bytes,
+    get_metrics_dataframe,
+    pareto_front,
+    parse_bytes,
+    plot_barrier_scatter_by_bucket,
+    plot_pareto,
+    plot_runtime_scaling,
+)
+from dlnetbench_tpu.analysis.py_utils import StyleMap, add_zoom_inset
+
+
+# --- byte formatting --------------------------------------------------------
+
+@pytest.mark.parametrize("n,expect", [
+    (0, "0 B"), (512, "512 B"), (1024, "1 KiB"), (1536, "1.5 KiB"),
+    (1024 ** 2, "1 MiB"), (3 * 1024 ** 3, "3 GiB"),
+])
+def test_format_bytes(n, expect):
+    assert format_bytes(n) == expect
+
+
+@pytest.mark.parametrize("s,expect", [
+    ("512", 512), ("512 B", 512), ("1 KiB", 1024), ("1.5KB", 1536),
+    ("2 MiB", 2 * 1024 ** 2), ("0.5 GiB", 512 * 1024 ** 2),
+])
+def test_parse_bytes(s, expect):
+    assert parse_bytes(s) == expect
+
+
+def test_bytes_round_trip():
+    for n in (1, 512, 1024, 1536, 10 * 1024 ** 2, 7 * 1024 ** 3):
+        assert parse_bytes(format_bytes(n, precision=6)) == n
+
+
+def test_parse_bytes_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_bytes("twelve")
+    with pytest.raises(ValueError):
+        parse_bytes("5 parsecs")
+
+
+def test_style_map_stable():
+    sm = StyleMap()
+    a1 = sm["gpt2_l"]
+    _ = sm["llama3_8b"]
+    assert sm["gpt2_l"] is a1
+    assert sm["gpt2_l"]["color"] != sm["llama3_8b"]["color"]
+
+
+# --- pareto -----------------------------------------------------------------
+
+def test_pareto_front_basic():
+    pts = [(1, 10), (2, 5), (3, 6), (4, 1), (2, 20)]
+    assert pareto_front(pts) == [(1.0, 10.0), (2.0, 5.0), (4.0, 1.0)]
+
+
+def test_pareto_front_single_and_dominated():
+    assert pareto_front([(3, 3)]) == [(3.0, 3.0)]
+    # one point dominates everything
+    assert pareto_front([(1, 1), (2, 2), (5, 9)]) == [(1.0, 1.0)]
+
+
+# --- plot smoke tests over a synthetic run file -----------------------------
+
+def _record(model, world, buckets, runtime, barrier):
+    return {
+        "section": "dp", "version": 1,
+        "global": {"model": model, "world_size": world,
+                   "num_buckets": buckets,
+                   "bucket_bytes": [4096] * buckets},
+        "mesh": {"platform": "cpu", "device_kind": "cpu"},
+        "num_runs": len(runtime),
+        "warmup_times": [],
+        "ranks": [
+            {"rank": r, "device_id": r, "process_index": 0,
+             "hostname": "h0", "runtimes": runtime,
+             "barrier_time": barrier}
+            for r in range(world)
+        ],
+    }
+
+
+@pytest.fixture()
+def run_df(tmp_path):
+    recs = [
+        _record("gpt2_l", 2, 4, [100.0, 110.0], [10.0, 12.0]),
+        _record("gpt2_l", 4, 4, [90.0, 95.0], [20.0, 21.0]),
+        _record("gpt2_l", 8, 8, [80.0, 85.0], [30.0, 29.0]),
+        _record("llama3_8b", 2, 4, [200.0, 210.0], [15.0, 14.0]),
+        _record("llama3_8b", 4, 8, [150.0, 160.0], [22.0, 25.0]),
+    ]
+    path = tmp_path / "runs.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    return get_metrics_dataframe(path, "dp")
+
+
+def test_plot_runtime_scaling(run_df):
+    ax = plot_runtime_scaling(run_df)
+    assert len(ax.get_lines()) == 2  # one per model
+    labels = {t.get_text() for t in ax.get_legend().get_texts()}
+    assert labels == {"gpt2_l", "llama3_8b"}
+    plt.close("all")
+
+
+def test_plot_barrier_scatter(run_df):
+    ax = plot_barrier_scatter_by_bucket(run_df)
+    ticklabels = [t.get_text() for t in ax.get_xticklabels()]
+    assert len(ticklabels) == 2  # bucket counts 4 and 8
+    assert "4 KiB" in ticklabels[0]  # msg-size annotation
+    plt.close("all")
+
+
+def test_plot_pareto(run_df):
+    ax = plot_pareto(run_df, config_cols=("world_size",))
+    # scatter + staircase per model
+    assert len(ax.collections) == 2
+    plt.close("all")
+
+
+def test_plot_missing_column_raises(run_df):
+    with pytest.raises(ValueError, match="lacks columns"):
+        plot_runtime_scaling(run_df.drop(columns=["runtime"]))
+    plt.close("all")
+
+
+def test_plot_runtime_scaling_agg_min_max(run_df):
+    # agg='min'/'max' collide with the variance band columns — must dedupe
+    for agg in ("min", "max", "median"):
+        ax = plot_runtime_scaling(run_df, agg=agg)
+        assert len(ax.get_lines()) == 2
+        plt.close("all")
+
+
+def test_plot_pareto_unknown_config_col_raises(run_df):
+    with pytest.raises(ValueError, match="lacks columns"):
+        plot_pareto(run_df, config_cols=("nccl_protocol",))
+    plt.close("all")
+
+
+def test_barrier_scatter_mixed_sizes_label(tmp_path):
+    # two models share num_buckets=4 with very different wire sizes: the
+    # column label must show the range, not whichever row came first
+    recs = [_record("gpt2_l", 2, 4, [100.0], [10.0]),
+            _record("llama3_8b", 2, 4, [200.0], [15.0])]
+    recs[1]["global"]["bucket_bytes"] = [16 * 1024 ** 2] * 4
+    path = tmp_path / "mixed.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    df = get_metrics_dataframe(path, "dp")
+    ax = plot_barrier_scatter_by_bucket(df)
+    label = ax.get_xticklabels()[0].get_text()
+    assert "4 KiB" in label and "16 MiB" in label
+    plt.close("all")
+
+
+def test_zoom_inset(run_df):
+    ax = plot_runtime_scaling(run_df)
+    axins = add_zoom_inset(ax, (0.55, 0.55, 0.4, 0.4), (2, 4), (80, 120))
+    assert len(axins.get_lines()) == len(ax.get_lines())
+    plt.close("all")

@@ -1,0 +1,56 @@
+"""Topology visualizer: tree building and ASCII rendering, including the
+multi-slice / multi-host shapes a dev box can't produce natively."""
+from __future__ import annotations
+
+import types
+
+import jax
+
+from dlnetbench_tpu.utils.topology import build_topology, format_topology
+
+
+def _fake_dev(id, process=0, slice_index=0, coords=None, core=None,
+              kind="TPU v5p"):
+    return types.SimpleNamespace(id=id, process_index=process,
+                                 slice_index=slice_index, coords=coords,
+                                 core_on_chip=core, device_kind=kind)
+
+
+def test_build_topology_real_devices():
+    tree = build_topology(jax.devices())
+    chips = [d for hosts in tree.values() for devs in hosts.values()
+             for d in devs]
+    assert len(chips) == len(jax.devices())
+    assert sorted(c["id"] for c in chips) == sorted(d.id for d in jax.devices())
+
+
+def test_format_topology_cpu_fallback():
+    out = format_topology(jax.devices())
+    assert "fabric:" in out
+    assert "slice 0" in out
+    assert "host 0" in out
+    assert out.count("chip id=") == len(jax.devices())
+
+
+def test_format_topology_multislice_multihost():
+    devs = [
+        _fake_dev(0, process=0, slice_index=0, coords=(0, 0, 0), core=0),
+        _fake_dev(1, process=0, slice_index=0, coords=(1, 0, 0), core=0),
+        _fake_dev(2, process=1, slice_index=0, coords=(0, 1, 0), core=0),
+        _fake_dev(3, process=2, slice_index=1, coords=(0, 0, 0), core=0),
+    ]
+    out = format_topology(devs)
+    assert "2 slices" in out and "DCN-linked" in out
+    assert "3 host" in out
+    assert "coords=(1, 0, 0)" in out
+    # slice 1 holds exactly one chip, drawn under host 2
+    assert "slice 1" in out and "host 2" in out
+
+
+def test_tree_sorted_and_grouped():
+    devs = [_fake_dev(3, process=1), _fake_dev(0, process=0),
+            _fake_dev(2, process=1), _fake_dev(1, process=0)]
+    tree = build_topology(devs)
+    assert list(tree[0].keys()) == [0, 1]
+    assert [d["id"] for d in tree[0][0]] == [0, 1]
+    assert [d["id"] for d in tree[0][1]] == [2, 3]
