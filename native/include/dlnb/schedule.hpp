@@ -1,0 +1,238 @@
+// Schedule algebra — pure math of the proxy workloads, native tier.
+//
+// Mirrors dlnetbench_tpu/core/schedule.py exactly (the Python tier is the
+// executable spec; tests/test_native.py cross-checks the two).  Reference
+// counterparts:
+//   bucket split             reference cpp/data_parallel/dp.cpp:159-164
+//   FSDP units/shards/grid   reference cpp/data_parallel/fsdp.cpp:217-265
+//   2D pipe grid + messages  reference cpp/hybrid_parallel/hybrid_2d.cpp:236-276
+//   3D grid + TP messages    reference cpp/hybrid_parallel/hybrid_3d.cpp:283-325
+//   MoE A2A + two-level sync reference cpp/hybrid_parallel/hybrid_3d_moe.cpp:291-363
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "dlnb/model_data.hpp"
+
+namespace dlnb {
+
+using i64 = std::int64_t;
+
+// ----------------------------------------------------------------- DP
+// Near-equal split, remainder spread one-per-bucket from the front
+// (reference dp.cpp:159-164 semantics).  sum(result) == total always.
+inline std::vector<i64> split_buckets(i64 total, i64 num_buckets) {
+  if (num_buckets <= 0) throw std::invalid_argument("num_buckets must be > 0");
+  i64 base = total / num_buckets, rem = total % num_buckets;
+  std::vector<i64> out(num_buckets);
+  for (i64 i = 0; i < num_buckets; ++i) out[i] = base + (i < rem ? 1 : 0);
+  return out;
+}
+
+struct DPSchedule {
+  i64 num_buckets;
+  std::vector<i64> bucket_sizes;  // elements per bucket
+  double fwd_us;                  // whole-model forward compute
+  double bwd_us_per_bucket;
+  double bytes_per_element;
+
+  std::vector<i64> bucket_bytes() const {
+    std::vector<i64> out;
+    out.reserve(bucket_sizes.size());
+    for (i64 s : bucket_sizes)
+      out.push_back(static_cast<i64>(s * bytes_per_element));
+    return out;
+  }
+};
+
+inline DPSchedule dp_schedule(const ModelStats& st, i64 num_buckets) {
+  return DPSchedule{num_buckets, split_buckets(st.model_size, num_buckets),
+                    st.fwd_us, st.bwd_us / num_buckets, st.bytes_per_element};
+}
+
+// ----------------------------------------------------------------- FSDP
+struct FSDPSchedule {
+  i64 num_units;
+  i64 sharding_factor;
+  i64 num_replicas;
+  std::vector<i64> unit_sizes;  // full (unsharded) unit sizes, elements
+  i64 shard_size;               // padded per-rank shard of one unit
+  double fwd_us_per_unit;
+  double bwd_us_per_unit;
+  double bytes_per_element;
+
+  i64 padded_unit_size() const { return shard_size * sharding_factor; }
+};
+
+// World = sharding_factor x num_replicas (reference fsdp.cpp:217,258);
+// shard sizes padded so every rank holds an equal slice (fsdp.cpp:251-255).
+inline FSDPSchedule fsdp_schedule(const ModelStats& st, i64 num_units,
+                                  i64 world_size, i64 sharding_factor = 0) {
+  i64 sf = sharding_factor > 0 ? sharding_factor : world_size;
+  if (world_size % sf != 0)
+    throw std::invalid_argument("world_size " + std::to_string(world_size) +
+                                " not divisible by sharding_factor " +
+                                std::to_string(sf));
+  auto units = split_buckets(st.model_size, num_units);
+  i64 max_unit = 0;
+  for (i64 u : units) max_unit = std::max(max_unit, u);
+  i64 shard = (max_unit + sf - 1) / sf;  // ceil
+  return FSDPSchedule{num_units, sf, world_size / sf, units, shard,
+                      st.fwd_us / num_units, st.bwd_us / num_units,
+                      st.bytes_per_element};
+}
+
+// ----------------------------------------------------------------- grids
+// 3D process grid, fastest-varying axis LAST (tp/ep): `tp_id = rank % tp;
+// stage_id = (rank/tp) % pp; dp_id = rank/(tp*pp)` (hybrid_3d.cpp:283-285).
+struct Grid3D {
+  i64 dp, pp, tp;
+
+  i64 world_size() const { return dp * pp * tp; }
+
+  struct Coords { i64 dp_id, pp_id, tp_id; };
+  Coords coords(i64 rank) const {
+    return {rank / (tp * pp), (rank / tp) % pp, rank % tp};
+  }
+  i64 rank(i64 dp_id, i64 pp_id, i64 tp_id) const {
+    return (dp_id * pp + pp_id) * tp + tp_id;
+  }
+  // Communicator "colors" — ranks sharing a color form one group
+  // (reference hybrid_3d.cpp:287-300).
+  i64 dp_color(i64 r) const { auto c = coords(r); return c.pp_id * tp + c.tp_id; }
+  i64 pp_color(i64 r) const { auto c = coords(r); return c.dp_id * tp + c.tp_id; }
+  i64 tp_color(i64 r) const { auto c = coords(r); return c.dp_id * pp + c.pp_id; }
+};
+
+// ----------------------------------------------------------------- PP(+TP)
+struct PipelineSchedule {
+  Grid3D grid;
+  i64 num_microbatches;
+  i64 layers_per_stage;
+  i64 pipe_msg_elems;   // activations per microbatch hop
+  i64 dp_sync_elems;    // per-stage gradient shard for DP allreduce
+  i64 tp_msg_elems;     // per-microbatch TP allreduce (0 if tp==1)
+  double fwd_us_per_stage_mb;
+  double bwd_us_per_stage_mb;
+  double bytes_per_element;
+
+  i64 num_stages() const { return grid.pp; }
+};
+
+// Invariants from the reference: layers divisible by stages and batch by
+// microbatches (hybrid_2d.cpp:264-265); pipe message = seq_len x embed_dim
+// x samples-per-microbatch (hybrid_2d.cpp:244-247); DP allreduce =
+// model/(num_stages*tp) (hybrid_2d.cpp:250, hybrid_3d.cpp:325); with TP the
+// per-microbatch compute divides by tp and the TP message is pipe_msg/tp
+// (hybrid_3d.cpp:314-315, 322).
+inline PipelineSchedule pipeline_schedule(const ModelStats& st,
+                                          const ModelCard& card,
+                                          i64 num_stages, i64 num_microbatches,
+                                          i64 dp = 1, i64 tp = 1) {
+  if (card.num_layers() % num_stages != 0)
+    throw std::invalid_argument(std::to_string(card.num_layers()) +
+                                " layers not divisible by " +
+                                std::to_string(num_stages) + " stages");
+  if (st.batch_size % num_microbatches != 0)
+    throw std::invalid_argument("batch " + std::to_string(st.batch_size) +
+                                " not divisible by " +
+                                std::to_string(num_microbatches) +
+                                " microbatches");
+  i64 samples_per_mb = st.batch_size / num_microbatches;
+  i64 pipe_msg = st.seq_len * st.embed_dim * samples_per_mb;
+  return PipelineSchedule{
+      Grid3D{dp, num_stages, tp},
+      num_microbatches,
+      card.num_layers() / num_stages,
+      pipe_msg,
+      st.model_size / (num_stages * tp),
+      tp > 1 ? pipe_msg / tp : 0,
+      st.fwd_us / (num_stages * num_microbatches * tp),
+      st.bwd_us / (num_stages * num_microbatches * tp),
+      st.bytes_per_element};
+}
+
+// ----------------------------------------------------------------- MoE/EP
+struct MoESchedule {
+  PipelineSchedule pipe;
+  i64 num_expert_shards;
+  i64 top_k;
+  i64 a2a_elems;             // one all-to-all dispatch/combine message
+  i64 a2a_per_direction;     // A2As per microbatch per direction
+  i64 nonexpert_sync_elems;  // level-1 grad sync over the EP group
+  i64 expert_sync_elems;     // level-2 expert-param stage shard over DP
+
+  Grid3D grid() const {
+    return Grid3D{pipe.grid.dp, pipe.grid.pp, num_expert_shards};
+  }
+};
+
+// A2A message = tokens_per_microbatch x top_k x embed_dim /
+// num_expert_shards (reference hybrid_3d_moe.cpp:354-359); two A2As per MoE
+// layer per direction (:161-165); two-level grad sync sizes from
+// non_expert_size (:278, 361-363).  Unlike TP, EP does not divide the
+// per-microbatch compute or the pipe message (hybrid_3d_moe.cpp:339-347).
+inline MoESchedule moe_schedule(const ModelStats& st, const ModelCard& card,
+                                i64 num_stages, i64 num_microbatches,
+                                i64 num_expert_shards, i64 dp = 1) {
+  if (card.num_experts % num_expert_shards != 0)
+    throw std::invalid_argument(std::to_string(card.num_experts) +
+                                " experts not divisible by " +
+                                std::to_string(num_expert_shards) + " shards");
+  auto pipe = pipeline_schedule(st, card, num_stages, num_microbatches, dp, 1);
+  i64 samples_per_mb = st.batch_size / num_microbatches;
+  i64 tokens_per_mb = samples_per_mb * st.seq_len;
+  i64 a2a = tokens_per_mb * card.top_k * st.embed_dim / num_expert_shards;
+  i64 layers_per_stage = card.num_layers() / num_stages;
+  i64 non_expert = st.non_expert_size;
+  i64 expert_params = st.model_size - non_expert;
+  return MoESchedule{pipe,
+                     num_expert_shards,
+                     card.top_k,
+                     a2a,
+                     2 * layers_per_stage,
+                     non_expert / std::max<i64>(num_stages, 1),
+                     expert_params / (num_stages * num_expert_shards)};
+}
+
+// ------------------------------------------------- sequence parallelism
+// Rebuild extension (SURVEY.md §5.7): ring attention + Ulysses.
+struct SequenceSchedule {
+  i64 sp;
+  i64 seq_per_rank;
+  i64 kv_block_elems;  // ring: one K+V block exchanged per hop
+  i64 a2a_elems;       // ulysses: one head<->seq reshard message
+  i64 num_ring_hops;   // sp - 1 per attention layer
+  double attn_us_per_block;
+  i64 layers;
+  double bytes_per_element;
+};
+
+inline SequenceSchedule sequence_schedule(const ModelStats& st,
+                                          const ModelCard& card, i64 sp,
+                                          i64 batch = 0) {
+  if (card.seq_len % sp != 0)
+    throw std::invalid_argument("seq_len " + std::to_string(card.seq_len) +
+                                " not divisible by sp=" + std::to_string(sp));
+  i64 b = batch > 0 ? batch : st.batch_size;
+  i64 n_local = card.seq_len / sp;
+  double attn_frac = (st.fwd_us > 0 && st.ffn_fwd_us > 0)
+                         ? 1.0 - st.ffn_fwd_us / st.fwd_us
+                         : 0.5;
+  double attn_us = st.fwd_us * attn_frac /
+                   std::max<i64>(card.num_layers(), 1) /
+                   static_cast<double>(sp * sp);
+  return SequenceSchedule{sp,
+                          n_local,
+                          2 * b * n_local * card.kv_dim(),
+                          b * n_local * card.embed_dim,
+                          sp - 1,
+                          attn_us,
+                          card.num_layers(),
+                          st.bytes_per_element};
+}
+
+}  // namespace dlnb

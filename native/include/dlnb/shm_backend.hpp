@@ -1,0 +1,514 @@
+// In-process threaded rank fabric — the testable fake backend.
+//
+// The reference's `mpi_cpu` build config runs every proxy on plain CPU
+// buffers over ordinary MPI ranks, which is what makes the whole suite
+// runnable on a laptop (reference README.md:96, SURVEY.md §4).  There is
+// no MPI on a TPU host image, so the rebuild's equivalent is an
+// in-process fabric: N rank *threads* share one `ShmFabric`, collectives
+// rendezvous through shared memory, and nonblocking ops run on per-slot
+// worker threads — reproducing the NCCL stream-per-request-index
+// discipline (reference cpp/proxy_classes.hpp:143-147) with real
+// asynchrony, so compute/comm overlap is genuinely exercised in tests.
+//
+// Collective algorithm: all group members publish (src, dst) into a
+// per-(group, slot) Rendezvous; once everyone arrived, each rank computes
+// its own output from the published inputs (sum-reduction in float via
+// dtype conversion, gather/scatter/alltoall as copies); a second phase
+// releases the round.  Mismatched op/count across ranks is detected and
+// aborts — the debugging check MPI never gave the reference.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <list>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dlnb/communicator.hpp"
+#include "dlnb/tensor.hpp"
+
+namespace dlnb {
+
+namespace shm {
+
+// ------------------------------------------------------------------ ops
+enum class OpKind : int {
+  Allreduce, Allgather, ReduceScatterBlock, Alltoall, Barrier
+};
+
+// One reusable all-arrive/compute/all-depart synchronization point.
+class Rendezvous {
+ public:
+  explicit Rendezvous(int n) : n_(n), srcs_(n), dsts_(n) {}
+
+  // fn(grank, srcs, dsts) runs on every rank after all pointers are
+  // published; inputs stay stable until the last rank departs.
+  void collective(
+      int grank, OpKind op, std::int64_t count, const void* src, void* dst,
+      const std::function<void(int, const std::vector<const void*>&,
+                               const std::vector<void*>&)>& fn) {
+    std::unique_lock<std::mutex> lk(m_);
+    std::uint64_t my_gen = gen_;
+    srcs_[grank] = src;
+    dsts_[grank] = dst;
+    if (arrived_ == 0) {
+      op_ = op;
+      count_ = count;
+    } else if (op_ != op || count_ != count) {
+      mismatch_ = true;
+    }
+    if (++arrived_ == n_) cv_.notify_all();
+    cv_.wait(lk, [&] { return gen_ == my_gen && arrived_ == n_; });
+    bool bad = mismatch_;
+    lk.unlock();
+    // on mismatch still complete the round (skip the math) so the
+    // rendezvous resets and later collectives error instead of hanging
+    if (!bad) fn(grank, srcs_, dsts_);
+    lk.lock();
+    if (++departed_ == n_) {
+      arrived_ = 0;
+      departed_ = 0;
+      mismatch_ = false;
+      ++gen_;
+      cv_.notify_all();
+    } else {
+      cv_.wait(lk, [&] { return gen_ != my_gen; });
+    }
+    lk.unlock();
+    if (bad)
+      throw std::runtime_error(
+          "shm collective mismatch: ranks disagree on op/count");
+  }
+
+ private:
+  int n_;
+  std::mutex m_;
+  std::condition_variable cv_;
+  std::vector<const void*> srcs_;
+  std::vector<void*> dsts_;
+  int arrived_ = 0;
+  int departed_ = 0;
+  bool mismatch_ = false;
+  OpKind op_ = OpKind::Barrier;
+  std::int64_t count_ = 0;
+  std::uint64_t gen_ = 0;
+};
+
+// Synchronous-rendezvous point-to-point mailbox for one group.  The
+// sender publishes a pointer and blocks until the receiver copies (NCCL
+// send/recv pairing semantics); entries live in a std::list so references
+// stay valid while both sides rendezvous, and the sender erases its own
+// entry after the ack.  Messages match on (from, to, tag): nonblocking
+// ops tag with their slot index and blocking ops with tag 0, so
+// concurrent slot workers between the same rank pair never cross-match
+// (the stream-per-index discipline, reference proxy_classes.hpp:143-147).
+class Mailboxes {
+ public:
+  void send(int from, int to, int tag, const void* data, std::size_t bytes) {
+    std::unique_lock<std::mutex> lk(m_);
+    Key k{from, to, tag};
+    auto& box = boxes_[k];
+    box.push_back(Msg{data, bytes, false});
+    auto mine = std::prev(box.end());
+    cv_.notify_all();
+    cv_.wait(lk, [&] { return mine->consumed; });
+    box.erase(mine);
+  }
+
+  void recv(int from, int to, int tag, void* out, std::size_t bytes) {
+    std::unique_lock<std::mutex> lk(m_);
+    Key k{from, to, tag};
+    std::list<Msg>::iterator it;
+    cv_.wait(lk, [&] {
+      auto& box = boxes_[k];
+      for (it = box.begin(); it != box.end(); ++it)
+        if (!it->consumed) return true;
+      return false;
+    });
+    if (it->bytes != bytes)
+      throw std::runtime_error("shm p2p size mismatch: send " +
+                               std::to_string(it->bytes) + "B vs recv " +
+                               std::to_string(bytes) + "B");
+    std::memcpy(out, it->data, bytes);
+    it->consumed = true;
+    cv_.notify_all();
+  }
+
+ private:
+  struct Key {
+    int from, to, tag;
+    bool operator<(const Key& o) const {
+      if (from != o.from) return from < o.from;
+      if (to != o.to) return to < o.to;
+      return tag < o.tag;
+    }
+  };
+  struct Msg {
+    const void* data;
+    std::size_t bytes;
+    bool consumed;
+  };
+  std::mutex m_;
+  std::condition_variable cv_;
+  std::map<Key, std::list<Msg>> boxes_;
+};
+
+// Shared state of one communicator group (all member ranks).
+struct Group {
+  explicit Group(std::vector<int> world_ranks, int num_slots)
+      : members(std::move(world_ranks)), mailboxes() {
+    int n = static_cast<int>(members.size());
+    // slot rendezvous 0..num_slots-1; extra slot for blocking ops
+    for (int i = 0; i <= num_slots; ++i)
+      rendezvous.push_back(std::make_unique<Rendezvous>(n));
+  }
+  std::vector<int> members;  // world ranks, ascending == group rank order
+  std::vector<std::unique_ptr<Rendezvous>> rendezvous;
+  Mailboxes mailboxes;
+};
+
+// Single-thread ordered task queue — one per (rank, slot); the analogue of
+// one CUDA stream per request index (reference proxy_classes.hpp:143-147).
+class SlotWorker {
+ public:
+  SlotWorker() = default;
+  ~SlotWorker() { stop(); }
+
+  void enqueue(std::function<void()> fn) {
+    ensure_started();
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      q_.push_back(std::move(fn));
+      ++outstanding_;
+    }
+    cv_.notify_all();
+  }
+
+  // Block until every enqueued task has completed (stream synchronize).
+  void wait() {
+    std::unique_lock<std::mutex> lk(m_);
+    cv_done_.wait(lk, [&] { return outstanding_ == 0; });
+    if (error_) {
+      auto e = error_;
+      error_ = nullptr;
+      std::rethrow_exception(e);
+    }
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      if (!started_) return;
+      stopping_ = true;
+    }
+    cv_.notify_all();
+    if (thread_.joinable()) thread_.join();
+    started_ = false;
+    stopping_ = false;
+  }
+
+ private:
+  void ensure_started() {
+    std::lock_guard<std::mutex> lk(m_);
+    if (started_) return;
+    started_ = true;
+    thread_ = std::thread([this] { run(); });
+  }
+
+  void run() {
+    while (true) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lk(m_);
+        cv_.wait(lk, [&] { return stopping_ || !q_.empty(); });
+        if (stopping_ && q_.empty()) return;
+        fn = std::move(q_.front());
+        q_.pop_front();
+      }
+      try {
+        fn();
+      } catch (...) {
+        std::lock_guard<std::mutex> lk(m_);
+        error_ = std::current_exception();
+      }
+      {
+        std::lock_guard<std::mutex> lk(m_);
+        --outstanding_;
+      }
+      cv_done_.notify_all();
+    }
+  }
+
+  std::mutex m_;
+  std::condition_variable cv_, cv_done_;
+  std::deque<std::function<void()>> q_;
+  int outstanding_ = 0;
+  bool started_ = false;
+  bool stopping_ = false;
+  std::exception_ptr error_;
+  std::thread thread_;
+};
+
+}  // namespace shm
+
+class ShmFabric;
+
+// Per-rank view of a group — implements ProxyCommunicator.
+class ShmCommunicator : public ProxyCommunicator {
+ public:
+  ShmCommunicator(std::shared_ptr<shm::Group> group, int group_rank,
+                  DType dtype, int num_slots, std::string name)
+      : group_(std::move(group)),
+        grank_(group_rank),
+        dtype_(dtype),
+        num_slots_(num_slots),
+        name_(std::move(name)),
+        workers_(num_slots) {}
+
+  ~ShmCommunicator() override {
+    for (auto& w : workers_) w.stop();
+  }
+
+  int rank() const override { return grank_; }
+  int size() const override {
+    return static_cast<int>(group_->members.size());
+  }
+  std::string name() const override { return name_; }
+  DType dtype() const override { return dtype_; }
+
+  // ---- blocking ----
+  void Allreduce(const void* src, void* dst, std::int64_t count) override {
+    run_collective(num_slots_, shm::OpKind::Allreduce, count, src, dst);
+  }
+  void Allgather(const void* src, void* dst, std::int64_t cpr) override {
+    run_collective(num_slots_, shm::OpKind::Allgather, cpr, src, dst);
+  }
+  void ReduceScatterBlock(const void* src, void* dst,
+                          std::int64_t cpr) override {
+    run_collective(num_slots_, shm::OpKind::ReduceScatterBlock, cpr, src, dst);
+  }
+  void Alltoall(const void* src, void* dst, std::int64_t cpr) override {
+    run_collective(num_slots_, shm::OpKind::Alltoall, cpr, src, dst);
+  }
+  void Barrier() override {
+    run_collective(num_slots_, shm::OpKind::Barrier, 0, nullptr, nullptr);
+  }
+
+  // ---- p2p (group-rank addressed; blocking ops match tag 0,
+  // nonblocking ops match on their slot — pair them consistently) ----
+  void Send(const void* src, std::int64_t count, int dst_rank) override {
+    group_->mailboxes.send(grank_, dst_rank, 0, src,
+                           count * dtype_bytes(dtype_));
+  }
+  void Recv(void* dst, std::int64_t count, int src_rank) override {
+    group_->mailboxes.recv(src_rank, grank_, 0, dst,
+                           count * dtype_bytes(dtype_));
+  }
+
+  // ---- nonblocking, slot-indexed ----
+  void Iallreduce(const void* src, void* dst, std::int64_t count,
+                  int slot) override {
+    enqueue(slot, [=] {
+      run_collective(slot, shm::OpKind::Allreduce, count, src, dst);
+    });
+  }
+  void Iallgather(const void* src, void* dst, std::int64_t cpr,
+                  int slot) override {
+    enqueue(slot, [=] {
+      run_collective(slot, shm::OpKind::Allgather, cpr, src, dst);
+    });
+  }
+  void Isend(const void* src, std::int64_t count, int dst_rank,
+             int slot) override {
+    enqueue(slot, [=] {
+      group_->mailboxes.send(grank_, dst_rank, 1 + slot, src,
+                             count * dtype_bytes(dtype_));
+    });
+  }
+  void Irecv(void* dst, std::int64_t count, int src_rank, int slot) override {
+    enqueue(slot, [=] {
+      group_->mailboxes.recv(src_rank, grank_, 1 + slot, dst,
+                             count * dtype_bytes(dtype_));
+    });
+  }
+  void Wait(int slot) override { worker(slot).wait(); }
+  void WaitAll(int num_slots) override {
+    for (int i = 0; i < num_slots && i < num_slots_; ++i) workers_[i].wait();
+  }
+
+ private:
+  shm::SlotWorker& worker(int slot) {
+    if (slot < 0 || slot >= num_slots_)
+      throw std::out_of_range("slot " + std::to_string(slot) +
+                              " out of range (num_slots=" +
+                              std::to_string(num_slots_) + ")");
+    return workers_[slot];
+  }
+  void enqueue(int slot, std::function<void()> fn) {
+    worker(slot).enqueue(std::move(fn));
+  }
+
+  void run_collective(int slot, shm::OpKind op, std::int64_t count,
+                      const void* src, void* dst) {
+    int n = size();
+    DType dt = dtype_;
+    auto& rz = *group_->rendezvous[slot];
+    rz.collective(
+        grank_, op, count, src, dst,
+        [n, dt, count, op](int g, const std::vector<const void*>& srcs,
+                           const std::vector<void*>& dsts) {
+          std::size_t esz = dtype_bytes(dt);
+          switch (op) {
+            case shm::OpKind::Barrier:
+              break;
+            case shm::OpKind::Allreduce: {
+              // each rank computes its own full output (tree-free, but the
+              // arithmetic is the real sum in float via dtype conversion)
+              void* out = dsts[g];
+              for (std::int64_t i = 0; i < count; ++i) {
+                float acc = 0.0f;
+                for (int r = 0; r < n; ++r)
+                  acc += load_element(srcs[r], i, dt);
+                store_element(out, i, dt, acc);
+              }
+              break;
+            }
+            case shm::OpKind::Allgather: {
+              char* out = static_cast<char*>(dsts[g]);
+              for (int r = 0; r < n; ++r)
+                std::memcpy(out + r * count * esz, srcs[r], count * esz);
+              break;
+            }
+            case shm::OpKind::ReduceScatterBlock: {
+              void* out = dsts[g];
+              for (std::int64_t i = 0; i < count; ++i) {
+                float acc = 0.0f;
+                for (int r = 0; r < n; ++r)
+                  acc += load_element(srcs[r], g * count + i, dt);
+                store_element(out, i, dt, acc);
+              }
+              break;
+            }
+            case shm::OpKind::Alltoall: {
+              char* out = static_cast<char*>(dsts[g]);
+              for (int r = 0; r < n; ++r)
+                std::memcpy(out + r * count * esz,
+                            static_cast<const char*>(srcs[r]) + g * count * esz,
+                            count * esz);
+              break;
+            }
+          }
+        });
+  }
+
+  std::shared_ptr<shm::Group> group_;
+  int grank_;
+  DType dtype_;
+  int num_slots_;
+  std::string name_;
+  std::vector<shm::SlotWorker> workers_;
+};
+
+// The world: spawns rank threads and arbitrates group splits.
+class ShmFabric {
+ public:
+  ShmFabric(int world_size, DType dtype, int num_slots = 32)
+      : world_size_(world_size), dtype_(dtype), num_slots_(num_slots) {
+    if (world_size <= 0) throw std::invalid_argument("world_size must be > 0");
+    std::vector<int> all(world_size);
+    for (int i = 0; i < world_size; ++i) all[i] = i;
+    world_group_ = std::make_shared<shm::Group>(all, num_slots_);
+  }
+
+  int world_size() const { return world_size_; }
+  DType dtype() const { return dtype_; }
+  int num_slots() const { return num_slots_; }
+
+  std::unique_ptr<ShmCommunicator> world_comm(int rank) {
+    return std::make_unique<ShmCommunicator>(world_group_, rank, dtype_,
+                                             num_slots_, "shm_world");
+  }
+
+  // Collective split: all world ranks must call with their color
+  // (MPI_Comm_split, key = world rank — reference comm-color math,
+  // hybrid_3d.cpp:287-300).  Returns this rank's communicator for its
+  // color group.
+  std::unique_ptr<ShmCommunicator> split(int world_rank, int color,
+                                         const std::string& name) {
+    std::uint64_t seq;
+    {
+      std::unique_lock<std::mutex> lk(split_m_);
+      // pair up with the ongoing round, or start a new one
+      if (split_arrived_ == 0) split_colors_.assign(world_size_, 0);
+      split_colors_[world_rank] = color;
+      seq = split_seq_;
+      if (++split_arrived_ == world_size_) {
+        // build groups for this round
+        std::map<int, std::vector<int>> by_color;
+        for (int r = 0; r < world_size_; ++r)
+          by_color[split_colors_[r]].push_back(r);
+        for (auto& [c, members] : by_color)
+          split_groups_[{seq, c}] =
+              std::make_shared<shm::Group>(members, num_slots_);
+        split_arrived_ = 0;
+        ++split_seq_;
+        split_cv_.notify_all();
+      } else {
+        split_cv_.wait(lk, [&] { return split_seq_ > seq; });
+      }
+    }
+    std::shared_ptr<shm::Group> g;
+    {
+      std::lock_guard<std::mutex> lk(split_m_);
+      g = split_groups_.at({seq, color});
+    }
+    int grank = 0;
+    for (std::size_t i = 0; i < g->members.size(); ++i)
+      if (g->members[i] == world_rank) grank = static_cast<int>(i);
+    return std::make_unique<ShmCommunicator>(g, grank, dtype_, num_slots_,
+                                             name);
+  }
+
+  // Run body(rank) on world_size threads; rethrows the first rank failure.
+  void launch(const std::function<void(int)>& body) {
+    std::vector<std::thread> threads;
+    std::mutex err_m;
+    std::exception_ptr first_error;
+    threads.reserve(world_size_);
+    for (int r = 0; r < world_size_; ++r)
+      threads.emplace_back([&, r] {
+        try {
+          body(r);
+        } catch (...) {
+          std::lock_guard<std::mutex> lk(err_m);
+          if (!first_error) first_error = std::current_exception();
+        }
+      });
+    for (auto& t : threads) t.join();
+    if (first_error) std::rethrow_exception(first_error);
+  }
+
+ private:
+  int world_size_;
+  DType dtype_;
+  int num_slots_;
+  std::shared_ptr<shm::Group> world_group_;
+
+  std::mutex split_m_;
+  std::condition_variable split_cv_;
+  std::vector<int> split_colors_;
+  int split_arrived_ = 0;
+  std::uint64_t split_seq_ = 0;
+  std::map<std::pair<std::uint64_t, int>, std::shared_ptr<shm::Group>>
+      split_groups_;
+};
+
+}  // namespace dlnb

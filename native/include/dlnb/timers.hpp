@@ -1,0 +1,129 @@
+// Interval timers + structured section emission — the ccutils equivalent.
+//
+// The reference leans on the external ccutils header library for
+// `CCUTILS_MPI_TIMER_DEF` (a per-rank std::vector<float> of interval
+// timings), `CCUTILS_MPI_SECTION_*` named output sections, and
+// `CCUTILS_*_JSON_PUT` key/value emission (reference
+// cpp/data_parallel/dp.cpp:28-30, 69-70, 275-295; SURVEY.md §1
+// "out-of-repo dependencies").  The rebuild owns this layer: a TimerSet
+// holds named per-iteration microsecond vectors per rank, and
+// `make_record` assembles the same JSON schema the Python tier's
+// metrics.emit writes, so dlnetbench_tpu.metrics.parser ingests native
+// runs unchanged.
+#pragma once
+
+#include <chrono>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "dlnb/json.hpp"
+
+namespace dlnb {
+
+using Clock = std::chrono::steady_clock;
+
+inline double us_since(Clock::time_point t0) {
+  return std::chrono::duration<double, std::micro>(Clock::now() - t0).count();
+}
+
+// Named per-iteration timer vectors for one rank.  Equivalent of ccutils
+// `__timer_vals_<name>` declared by CCUTILS_MPI_TIMER_DEF; `clear()` is the
+// reference's pre-measured-run timer reset (dp.cpp:258, fsdp.cpp:384-389).
+class TimerSet {
+ public:
+  void record(const std::string& name, double us) { vals_[name].push_back(us); }
+
+  // Scoped START/STOP (CCUTILS_MPI_TIMER_START/STOP equivalent).
+  class Scoped {
+   public:
+    Scoped(TimerSet& ts, std::string name)
+        : ts_(ts), name_(std::move(name)), t0_(Clock::now()) {}
+    ~Scoped() { ts_.record(name_, us_since(t0_)); }
+
+   private:
+    TimerSet& ts_;
+    std::string name_;
+    Clock::time_point t0_;
+  };
+  Scoped scoped(std::string name) { return Scoped(*this, std::move(name)); }
+
+  const std::vector<double>& values(const std::string& name) const {
+    static const std::vector<double> kEmpty;
+    auto it = vals_.find(name);
+    return it == vals_.end() ? kEmpty : it->second;
+  }
+  const std::map<std::string, std::vector<double>>& all() const {
+    return vals_;
+  }
+  void clear() { vals_.clear(); }
+
+  // Merge raw per-hop entries into per-iteration totals of `group` entries
+  // each — the reference's middle-stage PP timer merge
+  // (hybrid_2d.cpp:416-439 collapses recv+send entries per microbatch).
+  void merge_entries(const std::string& name, std::size_t group) {
+    auto it = vals_.find(name);
+    if (it == vals_.end() || group <= 1) return;
+    std::vector<double>& v = it->second;
+    std::vector<double> merged;
+    merged.reserve(v.size() / group + 1);
+    for (std::size_t i = 0; i < v.size(); i += group) {
+      double s = 0;
+      for (std::size_t j = i; j < std::min(i + group, v.size()); ++j) s += v[j];
+      merged.push_back(s);
+    }
+    v = std::move(merged);
+  }
+
+ private:
+  std::map<std::string, std::vector<double>> vals_;
+};
+
+// One per-rank output row: identity + this rank's timers.
+struct RankReport {
+  int rank = 0;
+  int device_id = 0;
+  int process_index = 0;
+  std::string hostname;
+  Json extra = Json::object();  // stage_id / dp_id / tp_id etc.
+  const TimerSet* timers = nullptr;
+};
+
+// Assemble the run record in the exact schema of the Python tier's
+// metrics.emit.result_to_record (section/version/global/mesh/num_runs/
+// warmup_times/ranks) so one parser serves both tiers.
+inline Json make_record(const std::string& section, const Json& global_meta,
+                        const Json& mesh_meta, int num_runs,
+                        const std::vector<double>& warmup_us,
+                        const std::vector<RankReport>& ranks) {
+  Json rec = Json::object();
+  rec["section"] = section;
+  rec["version"] = 1;
+  rec["global"] = global_meta;
+  rec["mesh"] = mesh_meta;
+  rec["num_runs"] = num_runs;
+  Json warm = Json::array();
+  for (double w : warmup_us) warm.push_back(w);
+  rec["warmup_times"] = warm;
+  Json rows = Json::array();
+  for (const auto& r : ranks) {
+    Json row = Json::object();
+    row["rank"] = r.rank;
+    row["device_id"] = r.device_id;
+    row["process_index"] = r.process_index;
+    row["hostname"] = r.hostname;
+    if (r.extra.is_object())
+      for (const auto& [k, v] : r.extra.fields()) row[k] = v;
+    if (r.timers)
+      for (const auto& [name, vals] : r.timers->all()) {
+        Json arr = Json::array();
+        for (double v : vals) arr.push_back(v);
+        row[name] = arr;
+      }
+    rows.push_back(row);
+  }
+  rec["ranks"] = rows;
+  return rec;
+}
+
+}  // namespace dlnb

@@ -1,0 +1,120 @@
+// ASCII fabric-topology graph, native tier.
+//
+// Counterpart of the reference's switch-tree visualizer (reference
+// cpp/netcommunicators.hpp:79-290), which allgathers per-rank
+// SLURM_TOPOLOGY_ADDR dot-paths and draws switch -> node -> process.  On a
+// TPU fabric the hierarchy is slice (ICI domain) -> host -> chip; rank
+// placement comes from the environment instead of SLURM:
+//   DLNB_TOPOLOGY   comma-separated dot-paths, one per rank,
+//                   e.g. "s0.h0,s0.h0,s0.h1,s0.h1" (slice.host)
+//   otherwise       a synthetic two-level tree is drawn, mirroring the
+//                   reference's non-SLURM fallback
+//                   (netcommunicators.hpp:148-157).
+// Output format matches the Python tier's utils/topology.py tree.
+#pragma once
+
+#include <cstdlib>
+#include <map>
+#include <ostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace dlnb {
+
+struct RankPlacement {
+  std::string slice_name;
+  std::string host_name;
+  int rank;
+};
+
+inline std::vector<std::string> split_csv(const std::string& s, char sep) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (char c : s) {
+    if (c == sep) {
+      out.push_back(cur);
+      cur.clear();
+    } else {
+      cur += c;
+    }
+  }
+  out.push_back(cur);
+  return out;
+}
+
+// Resolve per-rank placements from DLNB_TOPOLOGY or synthesize a balanced
+// two-level tree (4 ranks per host, 2 hosts per slice by default).
+inline std::vector<RankPlacement> resolve_placements(int world_size) {
+  std::vector<RankPlacement> out;
+  const char* env = std::getenv("DLNB_TOPOLOGY");
+  if (env && *env) {
+    auto paths = split_csv(env, ',');
+    for (int r = 0; r < world_size; ++r) {
+      std::string p = r < static_cast<int>(paths.size()) ? paths[r] : "s0.h0";
+      auto parts = split_csv(p, '.');
+      out.push_back({parts.empty() ? "s0" : parts[0],
+                     parts.size() > 1 ? parts[1] : "h0", r});
+    }
+    return out;
+  }
+  for (int r = 0; r < world_size; ++r) {
+    int host = r / 4;
+    int slice = host / 2;
+    out.push_back({"slice" + std::to_string(slice),
+                   "host" + std::to_string(host), r});
+  }
+  return out;
+}
+
+inline std::string format_topology(int world_size,
+                                   const std::string& kind = "shm-rank") {
+  auto placements = resolve_placements(world_size);
+  // slice -> host -> ranks, insertion-ordered by first appearance
+  std::vector<std::string> slice_order;
+  std::map<std::string, std::vector<std::string>> host_order;
+  std::map<std::string, std::vector<int>> host_ranks;
+  for (const auto& p : placements) {
+    if (host_ranks.find(p.slice_name + "/" + p.host_name) ==
+        host_ranks.end()) {
+      if (host_order.find(p.slice_name) == host_order.end())
+        slice_order.push_back(p.slice_name);
+      host_order[p.slice_name].push_back(p.host_name);
+    }
+    host_ranks[p.slice_name + "/" + p.host_name].push_back(p.rank);
+  }
+
+  std::ostringstream os;
+  std::size_t n_hosts = host_ranks.size();
+  os << "fabric: " << world_size << " x " << kind << " (" << n_hosts
+     << " host" << (n_hosts != 1 ? "s" : "") << ", " << slice_order.size()
+     << " slice" << (slice_order.size() != 1 ? "s" : "")
+     << (slice_order.size() > 1 ? ", DCN-linked" : "") << ")\n";
+  for (std::size_t si = 0; si < slice_order.size(); ++si) {
+    const auto& s = slice_order[si];
+    bool s_last = si == slice_order.size() - 1;
+    const auto& hosts = host_order[s];
+    os << (s_last ? "└── " : "├── ") << "slice " << s << "  [ICI domain, "
+       << hosts.size() << " host(s)]\n";
+    std::string s_pad = s_last ? "    " : "│   ";
+    for (std::size_t hi = 0; hi < hosts.size(); ++hi) {
+      bool h_last = hi == hosts.size() - 1;
+      const auto& ranks = host_ranks[s + "/" + hosts[hi]];
+      os << s_pad << (h_last ? "└── " : "├── ") << "host " << hosts[hi]
+         << "  (" << ranks.size() << " rank(s))\n";
+      std::string h_pad = s_pad + (h_last ? "    " : "│   ");
+      for (std::size_t di = 0; di < ranks.size(); ++di) {
+        os << h_pad << (di == ranks.size() - 1 ? "└── " : "├── ")
+           << "rank id=" << ranks[di] << "\n";
+      }
+    }
+  }
+  return os.str();
+}
+
+inline void print_topology(int world_size, std::ostream& os,
+                           const std::string& kind = "shm-rank") {
+  os << format_topology(world_size, kind);
+}
+
+}  // namespace dlnb
