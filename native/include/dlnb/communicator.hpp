@@ -47,8 +47,15 @@ class ProxyCommunicator {
   virtual void Barrier() = 0;
 
   // ---- point-to-point ----
-  virtual void Send(const void* src, std::int64_t count, int dst_rank) = 0;
-  virtual void Recv(void* dst, std::int64_t count, int src_rank) = 0;
+  // `tag` disambiguates concurrent transfers between the same rank pair
+  // (MPI-tag role).  Blocking ops default to tag 0; nonblocking ops with
+  // tag < 0 derive the tag from their slot, which pairs naturally when
+  // both sides use the same slot.  A send only matches a recv with the
+  // same effective tag.
+  virtual void Send(const void* src, std::int64_t count, int dst_rank,
+                    int tag = 0) = 0;
+  virtual void Recv(void* dst, std::int64_t count, int src_rank,
+                    int tag = 0) = 0;
 
   // ---- nonblocking, slot-indexed ----
   virtual void Iallreduce(const void* src, void* dst, std::int64_t count,
@@ -56,9 +63,9 @@ class ProxyCommunicator {
   virtual void Iallgather(const void* src, void* dst,
                           std::int64_t count_per_rank, int slot) = 0;
   virtual void Isend(const void* src, std::int64_t count, int dst_rank,
-                     int slot) = 0;
+                     int slot, int tag = -1) = 0;
   virtual void Irecv(void* dst, std::int64_t count, int src_rank,
-                     int slot) = 0;
+                     int slot, int tag = -1) = 0;
   virtual void Wait(int slot) = 0;
   virtual void WaitAll(int num_slots) = 0;
 

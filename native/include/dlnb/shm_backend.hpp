@@ -302,14 +302,15 @@ class ShmCommunicator : public ProxyCommunicator {
     run_collective(num_slots_, shm::OpKind::Barrier, 0, nullptr, nullptr);
   }
 
-  // ---- p2p (group-rank addressed; blocking ops match tag 0,
-  // nonblocking ops match on their slot — pair them consistently) ----
-  void Send(const void* src, std::int64_t count, int dst_rank) override {
-    group_->mailboxes.send(grank_, dst_rank, 0, src,
+  // ---- p2p (group-rank addressed; see communicator.hpp tag rules) ----
+  void Send(const void* src, std::int64_t count, int dst_rank,
+            int tag = 0) override {
+    group_->mailboxes.send(grank_, dst_rank, tag, src,
                            count * dtype_bytes(dtype_));
   }
-  void Recv(void* dst, std::int64_t count, int src_rank) override {
-    group_->mailboxes.recv(src_rank, grank_, 0, dst,
+  void Recv(void* dst, std::int64_t count, int src_rank,
+            int tag = 0) override {
+    group_->mailboxes.recv(src_rank, grank_, tag, dst,
                            count * dtype_bytes(dtype_));
   }
 
@@ -326,16 +327,19 @@ class ShmCommunicator : public ProxyCommunicator {
       run_collective(slot, shm::OpKind::Allgather, cpr, src, dst);
     });
   }
-  void Isend(const void* src, std::int64_t count, int dst_rank,
-             int slot) override {
+  void Isend(const void* src, std::int64_t count, int dst_rank, int slot,
+             int tag = -1) override {
+    int t = tag >= 0 ? tag : 1 + slot;
     enqueue(slot, [=] {
-      group_->mailboxes.send(grank_, dst_rank, 1 + slot, src,
+      group_->mailboxes.send(grank_, dst_rank, t, src,
                              count * dtype_bytes(dtype_));
     });
   }
-  void Irecv(void* dst, std::int64_t count, int src_rank, int slot) override {
+  void Irecv(void* dst, std::int64_t count, int src_rank, int slot,
+             int tag = -1) override {
+    int t = tag >= 0 ? tag : 1 + slot;
     enqueue(slot, [=] {
-      group_->mailboxes.recv(src_rank, grank_, 1 + slot, dst,
+      group_->mailboxes.recv(src_rank, grank_, t, dst,
                              count * dtype_bytes(dtype_));
     });
   }

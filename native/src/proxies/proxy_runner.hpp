@@ -1,0 +1,164 @@
+// Shared native-proxy skeleton: args, data discovery, fabric launch,
+// measurement, record emission.
+//
+// Counterpart of the reference's per-binary main() skeleton (reference
+// cpp/data_parallel/dp.cpp:127-302, traced in SURVEY.md §3.0): parse args,
+// locate the repo data, load the model stats (+card for hybrids), print
+// the fabric topology, build communicators, run warmup + measured runs per
+// rank, and emit one structured JSON record that
+// dlnetbench_tpu.metrics.parser ingests directly.
+//
+// Build-time DLNB_PROXY_LOOP produces the `_loop` congestor binaries
+// (reference -DPROXY_LOOP, Makefile.common:96-109); at runtime --loop does
+// the same.
+#pragma once
+
+#include <fstream>
+#include <functional>
+#include <iostream>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+#include "dlnb/args.hpp"
+#include "dlnb/harness.hpp"
+#include "dlnb/model_data.hpp"
+#include "dlnb/shm_backend.hpp"
+#include "dlnb/timers.hpp"
+#include "dlnb/topology.hpp"
+
+namespace dlnb {
+
+inline bool path_exists(const std::string& p) {
+  struct stat st;
+  return ::stat(p.c_str(), &st) == 0;
+}
+
+// Locate the repo data dir (reference get_dnnproxy_base_path,
+// cpp/utils.hpp:44-59): --base_path flag, DLNB_BASE_PATH env, else walk up
+// from cwd looking for dlnetbench_tpu/data.
+inline std::string find_base_path(const std::string& flag_value) {
+  if (!flag_value.empty()) return flag_value;
+  if (const char* env = std::getenv("DLNB_BASE_PATH"); env && *env)
+    return env;
+  std::string prefix = ".";
+  for (int depth = 0; depth < 6; ++depth) {
+    if (path_exists(prefix + "/dlnetbench_tpu/data/model_stats")) return prefix;
+    prefix += "/..";
+  }
+  throw std::runtime_error(
+      "cannot locate dlnetbench_tpu/data — pass --base_path or set "
+      "DLNB_BASE_PATH to the repo root");
+}
+
+struct ProxyEnv {
+  HarnessConfig cfg;
+  ModelStats stats;
+  std::string base_path;
+  int world = 0;
+  DType dtype = DType::BF16;
+  std::string model_name;
+  std::string out_path;  // empty -> stdout
+  bool no_topology = false;
+};
+
+inline void add_common_args(Args& args) {
+  args.required_str("model", "stats-file name, e.g. gpt2_l_16_bfloat16")
+      .required_int("world", "number of ranks (threads) to run")
+      .optional_int("warmup", 3, "warm-up iterations")
+      .optional_int("runs", 5, "measured iterations")
+      .optional_double("min_exectime", 0.0,
+                       "seconds; when >0, runs are estimated from warmup")
+      .optional_double("time_scale", 1.0, "scale simulated compute durations")
+      .optional_double("size_scale", 1.0, "scale communication buffer sizes")
+      .optional_str("base_path", "", "repo root containing dlnetbench_tpu/data")
+      .optional_str("out", "", "append the JSON record here instead of stdout")
+      .flag("loop", "run the schedule forever (congestor mode)")
+      .flag("no_topology", "skip the startup fabric-topology graph");
+}
+
+inline ProxyEnv make_env(const Args& args) {
+  ProxyEnv env;
+  env.model_name = args.str("model");
+  env.world = static_cast<int>(args.integer("world"));
+  env.cfg.warmup = static_cast<int>(args.integer("warmup"));
+  env.cfg.runs = static_cast<int>(args.integer("runs"));
+  env.cfg.min_exectime_s = args.number("min_exectime");
+  env.cfg.time_scale = args.number("time_scale");
+  env.cfg.size_scale = args.number("size_scale");
+  env.cfg.loop = args.flag_set("loop");
+#ifdef DLNB_PROXY_LOOP
+  env.cfg.loop = true;
+#endif
+  env.base_path = find_base_path(args.str("base_path"));
+  env.stats = load_model_stats(env.base_path + "/dlnetbench_tpu/data/" +
+                                   "model_stats/" + env.model_name + ".txt",
+                               env.model_name);
+  env.dtype = dtype_from_name(env.stats.dtype);
+  env.out_path = args.str("out");
+  env.no_topology = args.flag_set("no_topology");
+  if (env.world <= 0) throw std::runtime_error("--world must be positive");
+  return env;
+}
+
+inline ModelCard load_card_for(const ProxyEnv& env) {
+  std::string arch = arch_name_from_stats_name(env.model_name);
+  return load_model_card(
+      env.base_path + "/dlnetbench_tpu/data/models/" + arch + ".json", arch);
+}
+
+// Per-rank body: receives (rank, fabric, timers) and returns the rank's
+// extra identity fields (stage_id/dp_id/... as a Json object).  It must
+// call run_measured itself so proxies control communicator setup.
+using RankBody = std::function<Json(int rank, ShmFabric& fab, TimerSet& ts,
+                                    RankRun& run_out)>;
+
+inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
+                          const Json& global_meta, const RankBody& body) {
+  if (!env.no_topology)
+    print_topology(env.world, std::cerr,
+                   std::string("shm-rank[") + dtype_name(env.dtype) + "]");
+
+  ShmFabric fab(env.world, env.dtype);
+  std::vector<TimerSet> timers(env.world);
+  std::vector<RankRun> runs(env.world);
+  std::vector<Json> extras(env.world);
+  fab.launch([&](int r) { extras[r] = body(r, fab, timers[r], runs[r]); });
+
+  std::string host = local_hostname();
+  std::vector<RankReport> reports;
+  for (int r = 0; r < env.world; ++r) {
+    RankReport rep;
+    rep.rank = r;
+    rep.device_id = r;
+    rep.process_index = 0;
+    rep.hostname = host;
+    rep.extra = extras[r];
+    rep.timers = &timers[r];
+    reports.push_back(rep);
+  }
+
+  Json meta = global_meta;
+  meta["model"] = env.model_name;
+  meta["world_size"] = env.world;
+  meta["backend"] = "shm";
+  meta["device"] = "cpu";
+  meta["dtype"] = dtype_name(env.dtype);
+  meta["time_scale"] = env.cfg.time_scale;
+  meta["size_scale"] = env.cfg.size_scale;
+  Json mesh = Json::object();
+  mesh["platform"] = "shm";
+  mesh["device_kind"] = "thread-rank";
+
+  Json rec = make_record(section, meta, mesh, runs[0].runs,
+                         runs[0].warmup_us, reports);
+  if (!env.out_path.empty()) {
+    std::ofstream f(env.out_path, std::ios::app);
+    f << rec.dump() << "\n";
+  } else {
+    std::cout << rec.dump() << std::endl;
+  }
+  return 0;
+}
+
+}  // namespace dlnb

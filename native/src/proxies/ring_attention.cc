@@ -1,0 +1,111 @@
+// Native ring-attention (context-parallel) proxy — rebuild extension.
+//
+// No reference counterpart (SURVEY.md §2.5/§5.7: the reference has no
+// sequence parallelism).  Schedule mirrors the Python tier's
+// proxies/ring_attention.py: the sequence axis is sharded over `sp` ranks;
+// each attention layer rotates K/V blocks around the ring (sp-1 hops of
+// Isend/Irecv with the next/prev rank) while computing block-local
+// attention, so communication hides behind compute; backward mirrors the
+// ring at ~2x compute; MLP compute burns between layers; dp > 1 closes the
+// step with a gradient allreduce.
+#include "pipeline_engine.hpp"
+
+using namespace dlnb;
+
+int main(int argc, char** argv) {
+  Args args("ring_attention — context-parallel KV-ring proxy (native shm)");
+  add_common_args(args);
+  args.required_int("sp", "sequence-parallel (ring) degree")
+      .optional_int("dp", 0, "data-parallel degree (0 = infer from world)")
+      .optional_int("max_layers", 0, "cap simulated layers (0 = all)");
+  args.parse(argc, argv);
+
+  try {
+    ProxyEnv env = make_env(args);
+    ModelCard card = load_card_for(env);
+    i64 sp = args.integer("sp");
+    i64 dp = infer_dp(env.world, sp, args.integer("dp"), "sp");
+    SequenceSchedule sched = sequence_schedule(env.stats, card, sp);
+    i64 max_layers = args.integer("max_layers");
+    i64 layers = max_layers > 0 ? std::min(sched.layers, max_layers)
+                                : sched.layers;
+    double mlp_us_per_layer =
+        (env.stats.ffn_fwd_us / std::max<i64>(sched.layers, 1)) / sp;
+
+    i64 kv_elems = scale_count(sched.kv_block_elems, env.cfg.size_scale);
+    i64 grad_elems = scale_count(env.stats.model_size / std::max<i64>(sp, 1),
+                                 env.cfg.size_scale);
+
+    Json meta = Json::object();
+    meta["proxy"] = "ring_attention";
+    meta["sp"] = sp;
+    meta["dp"] = dp;
+    meta["layers"] = layers;
+    meta["num_ring_hops"] = sched.num_ring_hops;
+    meta["kv_block_bytes"] =
+        static_cast<i64>(kv_elems * dtype_bytes(env.dtype));
+    meta["schedule_kv_block_bytes"] =
+        static_cast<i64>(sched.kv_block_elems * sched.bytes_per_element);
+    meta["attn_us_per_block"] = sched.attn_us_per_block * env.cfg.time_scale;
+
+    return run_proxy_main(
+        "ring_attention", env, meta,
+        [&](int r, ShmFabric& fab, TimerSet& ts, RankRun& run) {
+          // sp fastest-varying: ring peers are consecutive world ranks
+          Grid3D grid{dp, 1, sp};
+          auto c = grid.coords(r);
+          auto world = fab.world_comm(r);
+          auto sp_comm =
+              fab.split(r, static_cast<int>(grid.tp_color(r)), "sp_comm");
+          std::unique_ptr<ShmCommunicator> dp_comm;
+          if (dp > 1)
+            dp_comm =
+                fab.split(r, static_cast<int>(grid.dp_color(r)), "dp_comm");
+
+          int me = sp_comm->rank();
+          int next = (me + 1) % static_cast<int>(sp);
+          int prev = (me + static_cast<int>(sp) - 1) % static_cast<int>(sp);
+          Tensor kv_out(kv_elems, env.dtype), kv_in(kv_elems, env.dtype);
+          Tensor g_src(grad_elems, env.dtype), g_dst(grad_elems, env.dtype);
+
+          auto ring_pass = [&](TimerSet& t, double block_us) {
+            for (i64 hop = 0; hop < sp; ++hop) {
+              burn_us(block_us, env.cfg.time_scale);
+              if (hop < sp - 1) {
+                auto sc = t.scoped("ring_comm");
+                // rotate: send on slot 0, recv on slot 1, one shared tag
+                // (the ppermute idiom — every rank shifts simultaneously)
+                sp_comm->Isend(kv_out.data(), kv_elems, next, 0, 100);
+                sp_comm->Irecv(kv_in.data(), kv_elems, prev, 1, 100);
+                sp_comm->WaitAll(2);
+              }
+            }
+          };
+
+          run = run_measured(env.cfg, *world, ts, [&](TimerSet& t) {
+            for (i64 l = 0; l < layers; ++l) {  // forward
+              ring_pass(t, sched.attn_us_per_block);
+              burn_us(mlp_us_per_layer, env.cfg.time_scale);
+            }
+            for (i64 l = 0; l < layers; ++l) {  // backward ~2x
+              ring_pass(t, 2 * sched.attn_us_per_block);
+              burn_us(2 * mlp_us_per_layer, env.cfg.time_scale);
+            }
+            if (dp_comm) {
+              auto sc = t.scoped("dp_comm");
+              dp_comm->Allreduce(g_src.data(), g_dst.data(), grad_elems);
+            }
+          });
+          if (sp > 1)
+            ts.merge_entries("ring_comm", 2 * layers * (sp - 1));
+
+          Json extra = Json::object();
+          extra["sp_id"] = c.tp_id;
+          extra["dp_id"] = c.dp_id;
+          return extra;
+        });
+  } catch (const std::exception& e) {
+    std::cerr << "ring_attention: " << e.what() << "\n";
+    return 1;
+  }
+}
