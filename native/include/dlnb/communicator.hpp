@@ -11,9 +11,10 @@
 // Backends in the rebuild:
 //   * ShmCommunicator (shm_backend.hpp) — in-process rank threads, the
 //     testable fake (role of the reference's `mpi_cpu` build, SURVEY.md §4).
-//   * PjrtCommunicator (pjrt_backend.hpp) — XLA collectives over real TPU
-//     devices through the PJRT C API; the "communicator" is a mesh axis and
-//     each op replays a cached compiled module (SURVEY.md §5.8).
+//   * PjrtCollectiveRunner (pjrt_backend.hpp) — XLA collectives over real
+//     TPU devices through the PJRT C API; the "communicator" is a set of
+//     replica groups and each op replays a cached compiled module
+//     (SURVEY.md §5.8).
 #pragma once
 
 #include <cstdint>

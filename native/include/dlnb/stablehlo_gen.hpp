@@ -1,0 +1,210 @@
+// StableHLO program generation for TPU collectives.
+//
+// On TPU there is no NCCL-style imperative collective API: the native
+// backend compiles one tiny XLA (StableHLO) module per (collective, dtype,
+// shape, group layout) and replays it (SURVEY.md §5.8 — "that compilation
+// cache is a genuinely new architectural element with no reference
+// counterpart").  This header is the pure text-generation half: replica-
+// mode modules (mhlo.num_replicas = N) whose semantics were validated
+// op-by-op against the XLA CPU runtime (tests/test_pjrt_programs.py
+// compiles and executes every generated program on a multi-device CPU
+// client and checks the math).
+#pragma once
+
+#include <cstdint>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "dlnb/tensor.hpp"
+
+namespace dlnb {
+
+enum class CollOp {
+  AllReduce,
+  AllGather,
+  ReduceScatter,
+  AllToAll,
+  CollectivePermute,
+};
+
+inline const char* coll_op_name(CollOp op) {
+  switch (op) {
+    case CollOp::AllReduce: return "all_reduce";
+    case CollOp::AllGather: return "all_gather";
+    case CollOp::ReduceScatter: return "reduce_scatter";
+    case CollOp::AllToAll: return "all_to_all";
+    case CollOp::CollectivePermute: return "collective_permute";
+  }
+  return "?";
+}
+
+inline const char* mlir_dtype(DType d) {
+  switch (d) {
+    case DType::F32: return "f32";
+    case DType::BF16: return "bf16";
+    case DType::F8E4M3: return "f8E4M3FN";
+  }
+  return "f32";
+}
+
+struct CollectiveProgram {
+  CollOp op;
+  DType dtype = DType::F32;
+  std::int64_t in_count = 0;   // per-replica input elements
+  int num_replicas = 1;
+  // replica groups (each inner vector = one group of replica ids); empty
+  // means one group of all replicas
+  std::vector<std::vector<int>> groups;
+  // for CollectivePermute only: (source, target) replica pairs
+  std::vector<std::pair<int, int>> pairs;
+
+  int group_size() const {
+    return groups.empty() ? num_replicas : static_cast<int>(groups[0].size());
+  }
+  std::int64_t out_count() const {
+    switch (op) {
+      case CollOp::AllGather: return in_count * group_size();
+      case CollOp::ReduceScatter: return in_count / group_size();
+      default: return in_count;
+    }
+  }
+
+  // Stable identity for the executable cache.
+  std::string cache_key() const {
+    std::ostringstream os;
+    os << coll_op_name(op) << "/" << mlir_dtype(dtype) << "/" << in_count
+       << "/r" << num_replicas << "/g";
+    for (const auto& g : groups) {
+      for (int r : g) os << r << ",";
+      os << ";";
+    }
+    os << "/p";
+    for (const auto& [s, t] : pairs) os << s << ">" << t << ";";
+    return os.str();
+  }
+};
+
+namespace detail {
+
+inline std::string replica_groups_attr(const CollectiveProgram& p) {
+  std::vector<std::vector<int>> groups = p.groups;
+  if (groups.empty()) {
+    groups.emplace_back();
+    for (int r = 0; r < p.num_replicas; ++r) groups[0].push_back(r);
+  }
+  std::ostringstream os;
+  os << "dense<[";
+  for (std::size_t g = 0; g < groups.size(); ++g) {
+    os << (g ? ", [" : "[");
+    for (std::size_t i = 0; i < groups[g].size(); ++i)
+      os << (i ? ", " : "") << groups[g][i];
+    os << "]";
+  }
+  os << "]> : tensor<" << groups.size() << "x" << groups[0].size() << "xi64>";
+  return os.str();
+}
+
+inline std::string sum_body(const std::string& et) {
+  std::ostringstream os;
+  os << " ({\n"
+     << "    ^bb0(%a: tensor<" << et << ">, %b: tensor<" << et << ">):\n"
+     << "      %s = stablehlo.add %a, %b : tensor<" << et << ">\n"
+     << "      stablehlo.return %s : tensor<" << et << ">\n"
+     << "  })";
+  return os.str();
+}
+
+}  // namespace detail
+
+// Generate the full replica-mode module text for one collective.
+inline std::string generate_stablehlo(const CollectiveProgram& p) {
+  const std::string et = mlir_dtype(p.dtype);
+  const std::string in_t =
+      "tensor<" + std::to_string(p.in_count) + "x" + et + ">";
+  const std::string out_t =
+      "tensor<" + std::to_string(p.out_count()) + "x" + et + ">";
+  const std::string sig = "(" + in_t + ") -> " + out_t;
+
+  std::ostringstream body;
+  switch (p.op) {
+    case CollOp::AllReduce:
+      body << "%0 = \"stablehlo.all_reduce\"(%arg0) <{replica_groups = "
+           << detail::replica_groups_attr(p) << "}>"
+           << detail::sum_body(et) << " : " << sig;
+      break;
+    case CollOp::AllGather:
+      body << "%0 = \"stablehlo.all_gather\"(%arg0) <{all_gather_dim = 0 : "
+              "i64, replica_groups = "
+           << detail::replica_groups_attr(p) << "}> : " << sig;
+      break;
+    case CollOp::ReduceScatter:
+      body << "%0 = \"stablehlo.reduce_scatter\"(%arg0) <{scatter_dimension "
+              "= 0 : i64, replica_groups = "
+           << detail::replica_groups_attr(p) << "}>"
+           << detail::sum_body(et) << " : " << sig;
+      break;
+    case CollOp::AllToAll:
+      body << "%0 = \"stablehlo.all_to_all\"(%arg0) <{split_dimension = 0 : "
+              "i64, concat_dimension = 0 : i64, split_count = "
+           << p.group_size()
+           << " : i64, replica_groups = " << detail::replica_groups_attr(p)
+           << "}> : " << sig;
+      break;
+    case CollOp::CollectivePermute: {
+      std::ostringstream pairs;
+      pairs << "dense<[";
+      for (std::size_t i = 0; i < p.pairs.size(); ++i)
+        pairs << (i ? ", [" : "[") << p.pairs[i].first << ", "
+              << p.pairs[i].second << "]";
+      pairs << "]> : tensor<" << p.pairs.size() << "x2xi64>";
+      body << "%0 = \"stablehlo.collective_permute\"(%arg0) "
+              "<{source_target_pairs = "
+           << pairs.str() << "}> : " << sig;
+      break;
+    }
+  }
+
+  std::ostringstream os;
+  os << "module @dlnb_" << coll_op_name(p.op) << " attributes "
+     << "{mhlo.num_replicas = " << p.num_replicas
+     << " : i32, mhlo.num_partitions = 1 : i32} {\n"
+     << "  func.func public @main(%arg0: " << in_t << ") -> " << out_t
+     << " {\n"
+     << "    " << body.str() << "\n"
+     << "    return %0 : " << out_t << "\n"
+     << "  }\n"
+     << "}\n";
+  return os.str();
+}
+
+// Serialized xla CompileOptionsProto carrying {executable_build_options
+// {num_replicas, num_partitions: 1}} — the options blob
+// PJRT_Client_Compile expects.  Hand-encoded protobuf wire format; field
+// numbers from xla/pjrt/proto/compile_options.proto
+// (executable_build_options = 3; num_replicas = 4, num_partitions = 5).
+inline std::string compile_options_proto(int num_replicas,
+                                         int num_partitions = 1) {
+  auto varint = [](std::uint64_t v) {
+    std::string out;
+    do {
+      std::uint8_t b = v & 0x7F;
+      v >>= 7;
+      if (v) b |= 0x80;
+      out.push_back(static_cast<char>(b));
+    } while (v);
+    return out;
+  };
+  std::string build_opts;
+  build_opts += static_cast<char>((4 << 3) | 0);  // num_replicas, varint
+  build_opts += varint(static_cast<std::uint64_t>(num_replicas));
+  build_opts += static_cast<char>((5 << 3) | 0);  // num_partitions, varint
+  build_opts += varint(static_cast<std::uint64_t>(num_partitions));
+  std::string out;
+  out += static_cast<char>((3 << 3) | 2);  // executable_build_options, msg
+  out += varint(build_opts.size());
+  out += build_opts;
+  return out;
+}
+
+}  // namespace dlnb

@@ -1,0 +1,174 @@
+// pjrt_probe — diagnostic + program-emission tool for the PJRT backend.
+//
+// Modes:
+//   --emit <op>        print the generated StableHLO module for a
+//                      collective (op = all_reduce | all_gather |
+//                      reduce_scatter | all_to_all | collective_permute).
+//                      tests/test_pjrt_programs.py compiles and EXECUTES
+//                      every emitted program on a multi-device CPU client
+//                      and checks the math — the semantic validation loop
+//                      for the generator.
+//   --options_proto N  print the serialized CompileOptionsProto for
+//                      num_replicas=N as hex (cross-checked against the
+//                      real proto parser in the same pytest).
+//   (default)          probe mode: resolve the PJRT plugin (libtpu.so or
+//                      $DLNB_PJRT_PLUGIN), create a client, list devices,
+//                      and run one end-to-end bf16 allreduce through the
+//                      compile cache.  Prints a one-line JSON report;
+//                      exits 0 with {"available": false} when no plugin
+//                      or no devices are present (dev boxes).
+#include <cstdio>
+#include <iostream>
+#include <string>
+#include <vector>
+
+#include "dlnb/args.hpp"
+#include "dlnb/json.hpp"
+#include "dlnb/pjrt_backend.hpp"
+#include "dlnb/stablehlo_gen.hpp"
+#include "dlnb/tensor.hpp"
+
+using namespace dlnb;
+
+static CollOp op_from_name(const std::string& s) {
+  if (s == "all_reduce") return CollOp::AllReduce;
+  if (s == "all_gather") return CollOp::AllGather;
+  if (s == "reduce_scatter") return CollOp::ReduceScatter;
+  if (s == "all_to_all") return CollOp::AllToAll;
+  if (s == "collective_permute") return CollOp::CollectivePermute;
+  throw std::runtime_error("unknown collective op '" + s + "'");
+}
+
+// "0,1;2,3" -> {{0,1},{2,3}}
+static std::vector<std::vector<int>> parse_groups(const std::string& s) {
+  std::vector<std::vector<int>> out;
+  std::vector<int> cur;
+  std::string num;
+  auto flush_num = [&] {
+    if (!num.empty()) {
+      cur.push_back(std::stoi(num));
+      num.clear();
+    }
+  };
+  for (char c : s) {
+    if (c == ',') flush_num();
+    else if (c == ';') {
+      flush_num();
+      if (!cur.empty()) out.push_back(cur);
+      cur.clear();
+    } else num += c;
+  }
+  flush_num();
+  if (!cur.empty()) out.push_back(cur);
+  return out;
+}
+
+// "0>1;1>2" -> {{0,1},{1,2}}
+static std::vector<std::pair<int, int>> parse_pairs(const std::string& s) {
+  std::vector<std::pair<int, int>> out;
+  for (const auto& grp : parse_groups(
+           [&] {
+             std::string t = s;
+             for (char& c : t)
+               if (c == '>') c = ',';
+             return t;
+           }())) {
+    if (grp.size() == 2) out.emplace_back(grp[0], grp[1]);
+  }
+  return out;
+}
+
+int main(int argc, char** argv) {
+  Args args("pjrt_probe — PJRT backend diagnostics and program emission");
+  args.optional_str("emit", "", "emit StableHLO for this collective op")
+      .optional_str("dtype", "f32", "element type: f32 | bfloat16 | float8")
+      .optional_int("count", 8, "per-replica input element count")
+      .optional_int("replicas", 4, "num_replicas")
+      .optional_str("groups", "", "replica groups, e.g. '0,1;2,3'")
+      .optional_str("pairs", "", "permute pairs, e.g. '0>1;1>2;2>0'")
+      .optional_int("options_proto", 0,
+                    "print CompileOptionsProto hex for N replicas")
+      .optional_str("plugin", "", "PJRT plugin path override");
+  args.parse(argc, argv);
+
+  try {
+    if (long long n = args.integer("options_proto"); n > 0) {
+      std::string proto = compile_options_proto(static_cast<int>(n));
+      for (unsigned char c : proto) std::printf("%02x", c);
+      std::printf("\n");
+      return 0;
+    }
+
+    if (std::string op = args.str("emit"); !op.empty()) {
+      CollectiveProgram prog;
+      prog.op = op_from_name(op);
+      prog.dtype = dtype_from_name(args.str("dtype"));
+      prog.in_count = args.integer("count");
+      prog.num_replicas = static_cast<int>(args.integer("replicas"));
+      prog.groups = parse_groups(args.str("groups"));
+      prog.pairs = parse_pairs(args.str("pairs"));
+      std::cout << generate_stablehlo(prog);
+      return 0;
+    }
+
+    // ---- probe mode ----
+    Json report = Json::object();
+    std::string plugin = args.str("plugin");
+    if (plugin.empty()) plugin = default_pjrt_plugin_path();
+    report["plugin"] = plugin;
+#ifndef DLNB_HAVE_PJRT
+    report["available"] = false;
+    report["reason"] = "built without pjrt_c_api.h (DLNB_HAVE_PJRT unset)";
+    std::cout << report.dump() << std::endl;
+    return 0;
+#else
+    if (plugin.empty()) {
+      report["available"] = false;
+      report["reason"] = "no PJRT plugin found (set DLNB_PJRT_PLUGIN)";
+      std::cout << report.dump() << std::endl;
+      return 0;
+    }
+    try {
+      PjrtContext ctx(plugin);
+      report["platform"] = ctx.platform_name();
+      report["num_devices"] = ctx.num_devices();
+      int n = ctx.num_devices();
+      if (n > 0) {
+        // end-to-end: bf16 allreduce over all devices, twice (second hit
+        // must come from the executable cache)
+        CollectiveProgram prog;
+        prog.op = CollOp::AllReduce;
+        prog.dtype = DType::BF16;
+        prog.in_count = 128;
+        prog.num_replicas = n;
+        std::vector<Tensor> src(n), dst(n);
+        std::vector<const void*> sp(n);
+        std::vector<void*> dp(n);
+        for (int d = 0; d < n; ++d) {
+          src[d] = Tensor(128, DType::BF16);
+          dst[d] = Tensor(128, DType::BF16);
+          src[d].fill(static_cast<float>(d + 1));
+          sp[d] = src[d].data();
+          dp[d] = dst[d].data();
+        }
+        PjrtCollectiveRunner runner{ctx};
+        runner.run(prog, sp, dp, DType::BF16);
+        runner.run(prog, sp, dp, DType::BF16);
+        float expect = n * (n + 1) / 2.0f;
+        report["allreduce_ok"] = dst[0].get(0) == expect;
+        report["cache_hits"] = ctx.cache_hits();
+        report["cache_misses"] = ctx.cache_misses();
+      }
+      report["available"] = n > 0;
+    } catch (const std::exception& e) {
+      report["available"] = false;
+      report["reason"] = std::string(e.what());
+    }
+    std::cout << report.dump() << std::endl;
+    return 0;
+#endif
+  } catch (const std::exception& e) {
+    std::cerr << "pjrt_probe: " << e.what() << "\n";
+    return 1;
+  }
+}

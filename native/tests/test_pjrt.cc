@@ -1,0 +1,130 @@
+// Unit tests: StableHLO program generation, cache keys, options proto.
+// (Device-free — the semantic compile+execute validation of the same
+// programs runs in tests/test_pjrt_programs.py against a multi-device
+// CPU PJRT client.)
+#include "dlnb_test.hpp"
+
+#include "dlnb/stablehlo_gen.hpp"
+
+using namespace dlnb;
+
+static bool contains(const std::string& hay, const std::string& needle) {
+  return hay.find(needle) != std::string::npos;
+}
+
+TEST(allreduce_module_text) {
+  CollectiveProgram p;
+  p.op = CollOp::AllReduce;
+  p.dtype = DType::BF16;
+  p.in_count = 128;
+  p.num_replicas = 4;
+  std::string m = generate_stablehlo(p);
+  CHECK(contains(m, "mhlo.num_replicas = 4 : i32"));
+  CHECK(contains(m, "mhlo.num_partitions = 1 : i32"));
+  CHECK(contains(m, "tensor<128xbf16>"));
+  CHECK(contains(m, "stablehlo.all_reduce"));
+  CHECK(contains(m, "replica_groups = dense<[[0, 1, 2, 3]]> : "
+                    "tensor<1x4xi64>"));
+  CHECK(contains(m, "stablehlo.add"));
+}
+
+TEST(split_becomes_multiple_groups) {
+  // MPI_Comm_split analogue: one module, several replica groups
+  CollectiveProgram p;
+  p.op = CollOp::AllReduce;
+  p.in_count = 8;
+  p.num_replicas = 4;
+  p.groups = {{0, 1}, {2, 3}};
+  std::string m = generate_stablehlo(p);
+  CHECK(contains(m, "dense<[[0, 1], [2, 3]]> : tensor<2x2xi64>"));
+}
+
+TEST(allgather_shapes) {
+  CollectiveProgram p;
+  p.op = CollOp::AllGather;
+  p.in_count = 4;
+  p.num_replicas = 4;
+  CHECK_EQ(p.out_count(), 16);
+  std::string m = generate_stablehlo(p);
+  CHECK(contains(m, "(tensor<4xf32>) -> tensor<16xf32>"));
+  CHECK(contains(m, "all_gather_dim = 0"));
+}
+
+TEST(reduce_scatter_shapes) {
+  CollectiveProgram p;
+  p.op = CollOp::ReduceScatter;
+  p.in_count = 16;
+  p.num_replicas = 4;
+  CHECK_EQ(p.out_count(), 4);
+  std::string m = generate_stablehlo(p);
+  CHECK(contains(m, "(tensor<16xf32>) -> tensor<4xf32>"));
+  CHECK(contains(m, "scatter_dimension = 0"));
+  CHECK(contains(m, "stablehlo.add"));
+}
+
+TEST(all_to_all_split_count_from_group) {
+  CollectiveProgram p;
+  p.op = CollOp::AllToAll;
+  p.in_count = 16;
+  p.num_replicas = 8;
+  p.groups = {{0, 1, 2, 3}, {4, 5, 6, 7}};
+  std::string m = generate_stablehlo(p);
+  CHECK(contains(m, "split_count = 4 : i64"));
+  CHECK(contains(m, "(tensor<16xf32>) -> tensor<16xf32>"));
+}
+
+TEST(collective_permute_pairs) {
+  CollectiveProgram p;
+  p.op = CollOp::CollectivePermute;
+  p.in_count = 8;
+  p.num_replicas = 4;
+  p.pairs = {{0, 1}, {1, 2}, {2, 3}, {3, 0}};
+  std::string m = generate_stablehlo(p);
+  CHECK(contains(m, "source_target_pairs = dense<[[0, 1], [1, 2], [2, 3], "
+                    "[3, 0]]> : tensor<4x2xi64>"));
+}
+
+TEST(f8_dtype_name) {
+  CollectiveProgram p;
+  p.op = CollOp::AllReduce;
+  p.dtype = DType::F8E4M3;
+  p.in_count = 8;
+  p.num_replicas = 2;
+  CHECK(contains(generate_stablehlo(p), "tensor<8xf8E4M3FN>"));
+}
+
+TEST(cache_keys_distinguish) {
+  CollectiveProgram a;
+  a.op = CollOp::AllReduce;
+  a.in_count = 8;
+  a.num_replicas = 4;
+  CollectiveProgram b = a;
+  CHECK_EQ(a.cache_key(), b.cache_key());
+  b.in_count = 16;
+  CHECK(a.cache_key() != b.cache_key());
+  b = a;
+  b.dtype = DType::BF16;
+  CHECK(a.cache_key() != b.cache_key());
+  b = a;
+  b.groups = {{0, 1}, {2, 3}};
+  CHECK(a.cache_key() != b.cache_key());
+  b = a;
+  b.op = CollOp::AllGather;
+  CHECK(a.cache_key() != b.cache_key());
+}
+
+TEST(compile_options_proto_wire_format) {
+  // field 3 (executable_build_options, length-delimited) wrapping
+  // field 4 (num_replicas) and field 5 (num_partitions) varints
+  std::string p = compile_options_proto(4);
+  CHECK_EQ(static_cast<unsigned char>(p[0]), 0x1Au);  // (3<<3)|2
+  CHECK_EQ(static_cast<unsigned char>(p[1]), 4u);     // payload length
+  CHECK_EQ(static_cast<unsigned char>(p[2]), 0x20u);  // (4<<3)|0
+  CHECK_EQ(static_cast<unsigned char>(p[3]), 4u);     // num_replicas = 4
+  CHECK_EQ(static_cast<unsigned char>(p[4]), 0x28u);  // (5<<3)|0
+  CHECK_EQ(static_cast<unsigned char>(p[5]), 1u);     // num_partitions = 1
+  // multi-byte varint
+  std::string big = compile_options_proto(300);
+  CHECK_EQ(static_cast<unsigned char>(big[3]), 0xACu);  // 300 = 0xAC 0x02
+  CHECK_EQ(static_cast<unsigned char>(big[4]), 0x02u);
+}

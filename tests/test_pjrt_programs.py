@@ -1,0 +1,166 @@
+"""Semantic validation of the native PJRT backend's generated programs.
+
+The native tier compiles one StableHLO module per (collective, dtype,
+shape, groups) (native/include/dlnb/stablehlo_gen.hpp).  These tests have
+`pjrt_probe --emit` produce each program and then compile AND EXECUTE it
+on a multi-device CPU PJRT client — the same replica-mode execution model
+a TPU plugin uses — checking the collective math end to end.  This is the
+device-free proof that the native backend's programs are correct XLA.
+
+Also cross-checks the hand-encoded CompileOptionsProto wire bytes by
+feeding them to the real compile path.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+PROBE = REPO / "native" / "build" / "bin" / "pjrt_probe"
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("cmake") is None, reason="cmake not available")
+
+
+@pytest.fixture(scope="session")
+def probe(native_devices):
+    if not PROBE.exists():
+        subprocess.run(["cmake", "-S", str(REPO / "native"), "-B",
+                        str(REPO / "native" / "build"), "-G", "Ninja"],
+                       check=True, capture_output=True)
+        subprocess.run(["ninja", "-C", str(REPO / "native" / "build"),
+                        "pjrt_probe"], check=True, capture_output=True)
+    return PROBE
+
+
+@pytest.fixture(scope="session")
+def native_devices():
+    """8 CPU devices (conftest sets the XLA flags before jax import)."""
+    import jax
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    return devs
+
+
+def emit(probe, op, **kw):
+    cmd = [str(probe), "--emit", op]
+    for k, v in kw.items():
+        cmd += [f"--{k}", str(v)]
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+def run_module(mlir, num_replicas, per_device_inputs):
+    import jax
+    from jax._src import xla_bridge
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jaxlib import _jax
+
+    client = xla_bridge.get_backend("cpu")
+    devs = client.local_devices()[:num_replicas]
+    opts = _jax.CompileOptions()
+    opts.num_replicas = num_replicas
+    exe = client.compile_and_load(mlir, devs, opts)
+
+    jdevs = jax.devices()[:num_replicas]
+    mesh = Mesh(np.array(jdevs), ("x",))
+    sh = NamedSharding(mesh, P("x"))
+    shards = [jax.device_put(v, d) for v, d in zip(per_device_inputs, jdevs)]
+    n = per_device_inputs[0].shape[0]
+    arr = jax.make_array_from_single_device_arrays(
+        (n * num_replicas,), sh, shards)
+    res = exe.execute_sharded([arr])
+    out = res.consume_with_handlers([lambda bufs: [np.asarray(b)
+                                                   for b in bufs]])
+    return out[0]
+
+
+def test_allreduce_world(probe):
+    mlir = emit(probe, "all_reduce", count=8, replicas=4)
+    outs = run_module(mlir, 4,
+                      [np.full(8, i + 1, np.float32) for i in range(4)])
+    for o in outs:
+        np.testing.assert_allclose(o, 10.0)
+
+
+def test_allreduce_split_groups(probe):
+    """One module, two replica groups — the comm-split idiom."""
+    mlir = emit(probe, "all_reduce", count=4, replicas=4, groups="0,1;2,3")
+    outs = run_module(mlir, 4,
+                      [np.full(4, i + 1, np.float32) for i in range(4)])
+    np.testing.assert_allclose(outs[0], 3.0)   # 1+2
+    np.testing.assert_allclose(outs[2], 7.0)   # 3+4
+
+
+def test_allgather(probe):
+    mlir = emit(probe, "all_gather", count=4, replicas=4)
+    outs = run_module(mlir, 4,
+                      [np.full(4, float(i), np.float32) for i in range(4)])
+    np.testing.assert_allclose(outs[1][::4], [0.0, 1.0, 2.0, 3.0])
+
+
+def test_reduce_scatter(probe):
+    mlir = emit(probe, "reduce_scatter", count=16, replicas=4)
+    outs = run_module(mlir, 4,
+                      [np.arange(16, dtype=np.float32) for _ in range(4)])
+    # device d gets sum over replicas of block d: 4 * arange-block
+    np.testing.assert_allclose(outs[2], 4 * np.arange(16)[8:12])
+
+
+def test_all_to_all(probe):
+    mlir = emit(probe, "all_to_all", count=16, replicas=4)
+    outs = run_module(
+        mlir, 4,
+        [np.arange(16, dtype=np.float32) + 100 * i for i in range(4)])
+    np.testing.assert_allclose(outs[1][::4], [4.0, 104.0, 204.0, 304.0])
+
+
+def test_ring_permute(probe):
+    mlir = emit(probe, "collective_permute", count=4, replicas=4,
+                pairs="0>1;1>2;2>3;3>0")
+    outs = run_module(mlir, 4,
+                      [np.full(4, float(i), np.float32) for i in range(4)])
+    assert [o[0] for o in outs] == [3.0, 0.0, 1.0, 2.0]
+
+
+def test_bf16_allreduce(probe):
+    import jax.numpy as jnp
+    mlir = emit(probe, "all_reduce", count=8, replicas=4, dtype="bfloat16")
+    outs = run_module(mlir, 4,
+                      [jnp.full(8, i + 1, jnp.bfloat16) for i in range(4)])
+    assert float(outs[0][0]) == 10.0
+
+
+def test_options_proto_matches_real_parser(probe):
+    """Feed the C++-emitted CompileOptionsProto bytes to XLA's REAL proto
+    parser and confirm the fields land where the hand-encoder intended —
+    this catches any drift between our field numbers and
+    xla/pjrt/proto/compile_options.proto."""
+    from jaxlib import _jax
+
+    out = subprocess.run([str(probe), "--options_proto", "3"],
+                         capture_output=True, text=True, timeout=60)
+    proto = bytes.fromhex(out.stdout.strip())
+    opts = _jax.CompileOptions.ParseFromString(proto)
+    assert opts.num_replicas == 3
+    assert opts.num_partitions == 1
+
+
+def test_probe_reports_cleanly(probe):
+    """Probe mode must exit 0 and emit valid JSON whether or not a TPU
+    plugin is usable in this environment."""
+    import json
+    out = subprocess.run([str(probe)], capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    assert "available" in rep
+    if rep["available"]:
+        assert rep.get("allreduce_ok") is True
+        assert rep.get("cache_hits", 0) >= 1  # second run hit the cache
