@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dlnetbench_tpu import ops
 from dlnetbench_tpu.models import layers as Lyr
 from dlnetbench_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP, make_grid_mesh
 
@@ -59,6 +60,7 @@ class SpmdConfig:
     num_microbatches: int = 2
     lr: float = 0.1
     dtype: str = "float32"       # bfloat16 on real TPU
+    attention_impl: str = "auto"   # ops.attention dispatch: auto | flash | xla
 
     @property
     def head_dim(self) -> int:
@@ -203,8 +205,9 @@ def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions):
     k = jnp.dot(y, lp["wk"]).reshape(mb, s_full, hkv_loc, dh)
     v = jnp.dot(y, lp["wv"]).reshape(mb, s_full, hkv_loc, dh)
     q, k = Lyr.rope(q, k, positions)
-    att = Lyr.attention(q, k, v, causal=True).reshape(mb, s_full, d // tp
-                                                      if tp > 1 else d)
+    att = ops.attention(q, k, v, causal=True,
+                        impl=cfg.attention_impl).reshape(
+        mb, s_full, d // tp if tp > 1 else d)
     out = jnp.dot(att, lp["wo"])                              # partial sums
     if tp > 1:  # SP: reduce partials and scatter back to sequence shards
         out = lax.psum_scatter(out, AXIS_TP, scatter_dimension=1, tiled=True)

@@ -19,6 +19,7 @@ import math
 import jax
 import jax.numpy as jnp
 
+from dlnetbench_tpu import ops
 from dlnetbench_tpu.core.model_card import ModelCard
 from dlnetbench_tpu.models import layers as L
 
@@ -40,6 +41,7 @@ class TransformerConfig:
     dtype: str = "bfloat16"
     remat: bool = False      # jax.checkpoint each block: recompute activations
                              # in backward instead of storing S x S residuals
+    attention_impl: str = "auto"   # ops.attention dispatch: auto | flash | xla
 
     @classmethod
     def from_card(cls, card: ModelCard, *, seq_len: int | None = None,
@@ -143,7 +145,8 @@ def _block(cfg: TransformerConfig, x, lp, positions):
     v = jnp.dot(y, lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
     if not cfg.max_positions:  # RoPE family
         q, k = L.rope(q, k, positions)
-    att = L.attention(q, k, v, causal=True).reshape(b, s, d)
+    att = ops.attention(q, k, v, causal=True,
+                        impl=cfg.attention_impl).reshape(b, s, d)
     x = x + jnp.dot(att, lp["wo"])
 
     if cfg.gated:

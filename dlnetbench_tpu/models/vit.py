@@ -12,6 +12,7 @@ import math
 import jax
 import jax.numpy as jnp
 
+from dlnetbench_tpu import ops
 from dlnetbench_tpu.core.model_card import ModelCard
 from dlnetbench_tpu.models import layers as L
 
@@ -26,6 +27,7 @@ class ViTConfig:
     num_layers: int
     num_classes: int
     dtype: str = "bfloat16"
+    attention_impl: str = "auto"   # ops.attention dispatch: auto | flash | xla
 
     @classmethod
     def from_card(cls, card: ModelCard, *, num_layers: int | None = None,
@@ -105,7 +107,8 @@ def _block(cfg: ViTConfig, x, lp):
     q = jnp.dot(y, lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
     k = jnp.dot(y, lp["wk"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
     v = jnp.dot(y, lp["wv"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
-    att = L.attention(q, k, v, causal=False).reshape(b, s, d)
+    att = ops.attention(q, k, v, causal=False,
+                        impl=cfg.attention_impl).reshape(b, s, d)
     x = x + jnp.dot(att, lp["wo"])
     y = L.layernorm(x, lp["norm2"], lp["norm2_b"])
     return x + L.gelu_mlp(y, lp["w_in"], lp["b_in"], lp["w_out"], lp["b_out"])

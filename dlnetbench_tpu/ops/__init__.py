@@ -1,0 +1,43 @@
+"""Hot-op kernels (Pallas where it pays).
+
+The reference has no kernel layer at all — its "compute" is ``usleep``
+(reference cpp/data_parallel/dp.cpp:93).  The rebuild's real-compute tier
+does real math, so the FLOP-dominant op — attention — gets a TPU-native
+blockwise (flash) kernel here: online-softmax tiles sized to VMEM, MXU
+matmuls with fp32 accumulation, and a custom VJP so long sequences never
+materialize the S x S score matrix in HBM.
+
+``attention`` is the dispatcher the model families call: it routes to the
+Pallas kernel when the backend and shapes support it and otherwise falls
+back to the plain-XLA einsum implementation (models/layers.py), which is
+also the numerical reference in tests.
+"""
+from __future__ import annotations
+
+import jax
+
+from dlnetbench_tpu.models import layers as _L
+from dlnetbench_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_supported,
+)
+
+__all__ = ["attention", "flash_attention", "flash_supported"]
+
+
+def attention(q, k, v, causal: bool, impl: str = "auto"):
+    """q: [B, S, Hq, Dh], k/v: [B, S, Hkv, Dh] -> [B, S, Hq, Dh].
+
+    impl: "flash" (Pallas kernel, error if unsupported shape),
+    "xla" (einsum reference), or "auto" (flash on TPU when the shape
+    qualifies, xla otherwise — CPU interpret-mode flash is for tests).
+    """
+    if impl == "xla":
+        return _L.attention(q, k, v, causal=causal)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal)
+    if impl != "auto":
+        raise ValueError(f"unknown attention impl {impl!r}")
+    if jax.default_backend() == "tpu" and flash_supported(q, k, v):
+        return flash_attention(q, k, v, causal=causal)
+    return _L.attention(q, k, v, causal=causal)

@@ -1,0 +1,388 @@
+"""Blockwise (flash) attention as a Pallas TPU kernel, with custom VJP.
+
+Design (TPU-first, not a port — the reference has no kernels at all):
+
+* The S x S score matrix never exists in HBM.  The grid walks
+  (batch, q_head, q_block, kv_block) with the kv_block axis innermost;
+  VMEM scratch carries the online-softmax state (running max ``m``,
+  running sum ``l``, fp32 accumulator) across kv steps, and the output
+  block is written once, on the last kv step for that q row block.
+* Causality is exploited at block granularity: kv blocks entirely above
+  the diagonal are skipped with ``pl.when`` (no MXU work issued), and the
+  straddling blocks are masked in-register.
+* GQA maps q head ``h`` to kv head ``h // group`` purely in the
+  ``BlockSpec`` index maps — no materialized KV broadcast.
+* Backward is the standard flash-attention recomputation split into a
+  dq kernel (grid minor axis = kv blocks) and a dk/dv kernel (grid minor
+  axis = q blocks), both reusing the saved logsumexp; dk/dv are produced
+  per q-head and group-summed by the wrapper, which keeps every output
+  block written by exactly one grid lane.
+* Head dims that are not lane-aligned (e.g. gpt2's 64) are zero-padded
+  to 128 in the wrapper; padding columns contribute nothing to scores and
+  are sliced off the outputs, so numerics are unchanged.
+
+On non-TPU backends the same kernels run under ``interpret=True`` so the
+whole path is unit-testable on the CPU mesh (tests/test_flash_attention.py
+checks fwd+grad against the einsum reference in models/layers.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_F32 = jnp.float32
+_LANES = 128                 # TPU lane width; head dim padded to this
+_SUBLANES = 8                # fp32 sublane tile: row vectors (lse, D) are
+                             # stored (B, H, 8, S) so blocks are (8, block_q)
+_NEG_INF = -1e30             # finite "-inf": keeps masked rows NaN-free
+_BLOCK_CANDIDATES = (512, 256, 128)
+
+
+def _pick_block(seq_len: int) -> int | None:
+    for b in _BLOCK_CANDIDATES:
+        if seq_len % b == 0 and seq_len >= b:
+            return b
+    return None
+
+
+def flash_supported(q, k, v) -> bool:
+    """Shape gate for the "auto" dispatcher: sequence divisible into
+    lane-aligned blocks and a head dim we can pad to one lane tile."""
+    del v
+    b, s, hq, dh = q.shape
+    hkv = k.shape[2]
+    return (_pick_block(s) is not None and dh <= _LANES
+            and hq % hkv == 0)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _mask_causal(s, i, j, block_q: int, block_k: int):
+    """Mask score block ``s`` at grid position (q block i, kv block j)."""
+    qi = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    ki = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qi >= ki, s, _NEG_INF)
+
+
+# ------------------------------------------------------------------ fwd
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref,
+                *, scale: float, causal: bool, block_q: int, block_k: int):
+    i = pl.program_id(2)      # q block
+    j = pl.program_id(3)      # kv block
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # kv block j touches q block i iff its first key is <= the last query
+    q_end = i * block_q + block_q - 1
+    work = (j * block_k <= q_end) if causal else (j >= 0)
+    # last kv block that does work for this q block
+    last_j = jnp.minimum(nk - 1, q_end // block_k) if causal else nk - 1
+
+    @pl.when(work)
+    def _step():
+        q = q_ref[0, 0].astype(_F32) * scale              # [bq, dh]
+        k = k_ref[0, 0]                                   # [bk, dh]
+        s = jax.lax.dot_general(
+            q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=_F32)                  # [bq, bk]
+        if causal:
+            s = _mask_causal(s, i, j, block_q, block_k)
+
+        m_prev = m_ref[:, :1]                             # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)        # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                   # [bq, 1]
+        p = jnp.exp(s - m_new)                            # [bq, bk]
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=_F32)                  # [bq, dh]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == last_j)
+    def _emit():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse = m_ref[:, 0] + jnp.log(l[:, 0])               # [bq]
+        lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[2:])
+
+
+def _fwd(q, k, v, *, causal: bool, block_q: int, block_k: int):
+    b, s, hq, dh = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    scale = 1.0 / (dh ** 0.5)    # scale by the REAL head dim, pre-padding
+
+    dh_p = _LANES
+    qt = _to_bhsd(q, dh_p)       # [B, Hq, S, dh_p]
+    kt = _to_bhsd(k, dh_p)
+    vt = _to_bhsd(v, dh_p)
+
+    nq, nk = s // block_q, s // block_k
+    grid = (b, hq, nq, nk)
+    kv_spec = pl.BlockSpec((1, 1, block_k, dh_p),
+                           lambda bi, h, i, j: (bi, h // group, j, 0),
+                           memory_space=pltpu.VMEM)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, dh_p),
+                         lambda bi, h, i, j: (bi, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            kv_spec, kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, dh_p),
+                         lambda bi, h, i, j: (bi, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, _SUBLANES, block_q),
+                         lambda bi, h, i, j: (bi, h, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, s, dh_p), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, _SUBLANES, s), _F32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, dh_p), _F32),
+            pltpu.VMEM((block_q, _LANES), _F32),
+            pltpu.VMEM((block_q, _LANES), _F32),
+        ],
+        interpret=_interpret(),
+    )(qt, kt, vt)
+    return _from_bhsd(out, dh), lse
+
+
+# ------------------------------------------------------------------ bwd
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
+               dq_acc,
+               *, scale: float, causal: bool, block_q: int, block_k: int):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_end = i * block_q + block_q - 1
+    work = (j * block_k <= q_end) if causal else (j >= 0)
+    last_j = jnp.minimum(nk - 1, q_end // block_k) if causal else nk - 1
+
+    @pl.when(work)
+    def _step():
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            (q_ref[0, 0].astype(_F32) * scale).astype(k.dtype), k,
+            (((1,), (1,)), ((), ())), preferred_element_type=_F32)
+        if causal:
+            s = _mask_causal(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0, 0][:, None])           # [bq, bk]
+        dp = jax.lax.dot_general(
+            do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=_F32)                  # [bq, bk]
+        ds = p * (dp - dcap_ref[0, 0, 0][:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=_F32)
+
+    @pl.when(j == last_j)
+    def _emit():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale: float, causal: bool, block_q: int, block_k: int):
+    j = pl.program_id(2)      # kv block (outer)
+    i = pl.program_id(3)      # q block (inner / minor)
+    nq = pl.num_programs(3)
+
+    # first q block whose last query reaches this kv block
+    first_i = (j * block_k) // block_q if causal else 0
+    work = (i >= first_i)
+
+    @pl.when(i == first_i)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(work)
+    def _step():
+        k = k_ref[0, 0]
+        q = q_ref[0, 0]
+        s = jax.lax.dot_general(
+            (q.astype(_F32) * scale).astype(k.dtype), k,
+            (((1,), (1,)), ((), ())), preferred_element_type=_F32)
+        if causal:
+            s = _mask_causal(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0, 0][:, None])           # [bq, bk]
+        do = do_ref[0, 0]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=_F32)                  # [bk, dh]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=_F32)                  # [bq, bk]
+        ds = p * (dp - dcap_ref[0, 0, 0][:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=_F32)                  # [bk, dh]
+
+    @pl.when(i == nq - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, out, lse, do, *, causal: bool,
+              block_q: int, block_k: int):
+    b, s, hq, dh = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    scale = 1.0 / (dh ** 0.5)
+    dh_p = _LANES
+
+    qt, kt, vt = (_to_bhsd(x, dh_p) for x in (q, k, v))
+    dot = _to_bhsd(do, dh_p)
+    # D_i = rowsum(dO * O): cheap elementwise, plain XLA
+    dcap = jnp.sum(dot.astype(_F32) * _to_bhsd(out, dh_p).astype(_F32),
+                   axis=-1)                               # [B, Hq, S]
+    dcap = jnp.broadcast_to(dcap[:, :, None, :],
+                            (b, hq, _SUBLANES, s))        # sublane-replicated
+
+    nq, nk = s // block_q, s // block_k
+    q_spec = pl.BlockSpec((1, 1, block_q, dh_p),
+                          lambda bi, h, i, j: (bi, h, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, 1, block_k, dh_p),
+                           lambda bi, h, i, j: (bi, h // group, j, 0),
+                           memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, _SUBLANES, block_q),
+                            lambda bi, h, i, j: (bi, h, 0, i),
+                            memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, hq, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, s, dh_p), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dh_p), _F32)],
+        interpret=_interpret(),
+    )(qt, kt, vt, dot, lse, dcap)
+
+    # dk/dv per q-head; inner (minor) axis walks q blocks
+    q_spec_t = pl.BlockSpec((1, 1, block_q, dh_p),
+                            lambda bi, h, j, i: (bi, h, i, 0),
+                            memory_space=pltpu.VMEM)
+    kv_spec_t = pl.BlockSpec((1, 1, block_k, dh_p),
+                             lambda bi, h, j, i: (bi, h // group, j, 0),
+                             memory_space=pltpu.VMEM)
+    kv_out_t = pl.BlockSpec((1, 1, block_k, dh_p),
+                            lambda bi, h, j, i: (bi, h, j, 0),
+                            memory_space=pltpu.VMEM)
+    row_spec_t = pl.BlockSpec((1, 1, _SUBLANES, block_q),
+                              lambda bi, h, j, i: (bi, h, 0, i),
+                              memory_space=pltpu.VMEM)
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, hq, nk, nq),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
+                  row_spec_t, row_spec_t],
+        out_specs=[kv_out_t, kv_out_t],
+        out_shape=[jax.ShapeDtypeStruct((b, hq, s, dh_p), k.dtype),
+                   jax.ShapeDtypeStruct((b, hq, s, dh_p), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, dh_p), _F32),
+                        pltpu.VMEM((block_k, dh_p), _F32)],
+        interpret=_interpret(),
+    )(qt, kt, vt, dot, lse, dcap)
+
+    # sum the q-head group into each kv head (GQA)
+    dk = dk_h.reshape(b, hkv, group, s, dh_p).sum(axis=2)
+    dv = dv_h.reshape(b, hkv, group, s, dh_p).sum(axis=2)
+    return (_from_bhsd(dq, dh),
+            _from_bhsd(dk, dh).astype(k.dtype),
+            _from_bhsd(dv, dh).astype(v.dtype))
+
+
+# ------------------------------------------------------- layout helpers
+
+def _to_bhsd(x, dh_p: int):
+    """[B, S, H, Dh] -> [B, H, S, dh_p] with zero-padded head dim."""
+    x = jnp.swapaxes(x, 1, 2)
+    dh = x.shape[-1]
+    if dh < dh_p:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, dh_p - dh)))
+    return x
+
+
+def _from_bhsd(x, dh: int):
+    """[B, H, S, dh_p] -> [B, S, H, Dh], dropping head-dim padding."""
+    return jnp.swapaxes(x[..., :dh], 1, 2)
+
+
+# ------------------------------------------------------------ public op
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int | None = None, block_k: int | None = None):
+    """Blockwise attention; same contract as models/layers.py::attention.
+
+    q: [B, S, Hq, Dh], k/v: [B, S, Hkv, Dh] with Hq % Hkv == 0.
+    """
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k)
+    return out
+
+
+def _resolve_blocks(q, k, block_q, block_k):
+    s, dh = q.shape[1], q.shape[3]
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % hkv or dh > _LANES:
+        raise ValueError(
+            f"flash_attention: unsupported shape (Hq={hq} % Hkv={hkv} != 0 "
+            f"or head dim {dh} > {_LANES}); use ops.attention(..., impl='auto')")
+    bq = block_q or _pick_block(s)
+    bk = block_k or _pick_block(s)
+    if bq is None or bk is None or s % bq or s % bk:
+        raise ValueError(
+            f"flash_attention: seq_len {s} not divisible into blocks "
+            f"{_BLOCK_CANDIDATES}; use ops.attention(..., impl='auto')")
+    return bq, bk
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    bq, bk = _resolve_blocks(q, k, block_q, block_k)
+    out, lse = _fwd(q, k, v, causal=causal, block_q=bq, block_k=bk)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    bq, bk = _resolve_blocks(q, k, block_q, block_k)
+    return _bwd_impl(q, k, v, out, lse, g, causal=causal,
+                     block_q=bq, block_k=bk)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
