@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 BATCH = 2
-SEQ = 1024
+SEQ = 2048     # long enough that the Pallas flash-attention path engages
 LAYERS = 4
 VOCAB = 32768
 
@@ -31,7 +31,7 @@ def main() -> int:
     from dlnetbench_tpu.core.model_card import ModelCard, load_model_card
     from dlnetbench_tpu.core import roofline
     from dlnetbench_tpu.models import transformer as tfm
-    from dlnetbench_tpu.utils.timing import time_callable
+    from dlnetbench_tpu.utils.timing import time_pipelined
 
     dev = jax.devices()[0]
     # "TPU v5 lite" -> tpu_v5e, "TPU v5p"/"TPU v4"/"TPU v6 lite" likewise
@@ -60,7 +60,10 @@ def main() -> int:
     params2, loss = train_step(params, tokens)  # compile
     jax.block_until_ready(params2)
 
-    samples = time_callable(train_step, params, tokens, reps=10)
+    # three pipelined rounds (each fences once); median guards against a
+    # slow round from tunnel or host jitter
+    samples = [time_pipelined(train_step, params, tokens, iters=5)
+               for _ in range(3)]
     step_s = statistics.median(samples)
 
     # analytic FLOPs: fwd + ~2x bwd = 3x forward (reference bwd/fwd=2 model)

@@ -145,7 +145,8 @@ def plot_pareto(df, *, x="runtime", y=None, group_by="model",
     runtime-energy Pareto) and ``barrier_time`` otherwise.
     """
     if y is None:
-        y = "energy" if "energy" in df.columns else "barrier_time"
+        y = next((c for c in ("energy", "energy_consumed")
+                  if c in df.columns), "barrier_time")
     _require_cols(df, [x, y, group_by, *config_cols])
     ax = _get_ax(ax)
     styles = styles or StyleMap()

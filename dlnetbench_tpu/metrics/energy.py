@@ -1,0 +1,180 @@
+"""Energy sampling — the rebuild's ``power_profiler`` equivalent.
+
+The reference optionally links a vendor power profiler
+(``-DPROXY_ENERGY_PROFILING -lpower_profiler``, reference
+Makefile.flags.mk:119-124) sampling at ``POWER_SAMPLING_RATE_MS 5``
+(dp.cpp:67) and emits per-rank ``energy_consumed`` arrays
+(plots/parser.py:172) that feed the runtime-energy Pareto analysis.
+
+TPU chips expose no public per-chip energy counter through JAX/PJRT, so
+this is a *host-side* pluggable sampler chain, best available source wins:
+
+  * RaplSampler   — Linux RAPL cumulative counters
+                    (/sys/class/powercap/intel-rapl*/energy_uj) with
+                    wraparound handling.  Real measured joules for the
+                    CPU-mesh runs and the host share of TPU runs.
+  * HwmonSampler  — /sys/class/hwmon power_input (uW) integrated by a
+                    5 ms background thread (the reference's sampling rate).
+  * none          — energy is simply absent from the emitted record (the
+                    reference behaves the same when built without the
+                    profiler).
+
+``run_proxy`` brackets each timed run with ``read_joules()`` and emits the
+per-run deltas as ``energy_consumed``, keeping the reference's record
+schema so the Pareto plots work unchanged.
+"""
+from __future__ import annotations
+
+import glob
+import threading
+import time
+
+POWER_SAMPLING_RATE_MS = 5   # reference dp.cpp:67
+
+
+class RaplSampler:
+    """Cumulative joules from Linux RAPL package domains."""
+
+    def __init__(self, root: str = "/sys/class/powercap"):
+        packages, psys = [], []
+        for path in sorted(glob.glob(f"{root}/intel-rapl:*")):
+            # top-level zones only: subzones (intel-rapl:0:0) are already
+            # included in their parent's counter
+            if path.rsplit("/", 1)[-1].count(":") != 1:
+                continue
+            try:
+                with open(f"{path}/energy_uj") as f:
+                    float(f.read())
+                try:
+                    with open(f"{path}/name") as f:
+                        zone = f.read().strip()
+                except OSError:
+                    zone = "package-?"
+                try:
+                    with open(f"{path}/max_energy_range_uj") as f:
+                        rng = float(f.read())
+                except OSError:
+                    rng = 0.0   # unknown range: drop wrapped samples
+                entry = (f"{path}/energy_uj", rng)
+                # psys already contains the packages — never sum both
+                (psys if zone == "psys" else packages).append(entry)
+            except (OSError, ValueError):
+                continue
+        self._domains = psys if psys else packages
+        self._last: list[float] = []
+        self._acc = 0.0
+        if self._domains:
+            self._last = [self._read_raw(i)
+                          for i in range(len(self._domains))]
+
+    @property
+    def available(self) -> bool:
+        return bool(self._domains)
+
+    def _read_raw(self, i: int) -> float:
+        with open(self._domains[i][0]) as f:
+            return float(f.read())
+
+    def read_joules(self) -> float:
+        """Monotonic cumulative joules across packages (wraparound-safe)."""
+        for i, (_, rng) in enumerate(self._domains):
+            cur = self._read_raw(i)
+            delta = cur - self._last[i]
+            if delta < 0:  # counter wrapped; drop the sample if the
+                delta = delta + rng if rng > 0 else 0.0  # range is unknown
+            self._acc += delta
+            self._last[i] = cur
+        return self._acc / 1e6
+
+
+class HwmonSampler:
+    """Integrate instantaneous /sys/class/hwmon power (uW) in a background
+    thread at the reference's 5 ms sampling period."""
+
+    def __init__(self, root: str = "/sys/class/hwmon"):
+        # channels from ONE hwmon device only — summing across devices
+        # double-counts when aggregate (battery/ACPI) and component (CPU
+        # package) sensors coexist.  DLNB_HWMON_DEVICE selects by name.
+        import os
+        want = os.environ.get("DLNB_HWMON_DEVICE", "")
+        by_dev: dict[str, list[str]] = {}
+        names: dict[str, str] = {}
+        for path in sorted(glob.glob(f"{root}/hwmon*/power*_input")):
+            dev = path.rsplit("/", 2)[-2]
+            try:
+                with open(path) as f:
+                    float(f.read())
+                by_dev.setdefault(dev, []).append(path)
+                try:
+                    with open(f"{root}/{dev}/name") as f:
+                        names[dev] = f.read().strip()
+                except OSError:
+                    names[dev] = dev
+            except (OSError, ValueError):
+                continue
+        if want:
+            # explicit selection: no match means unavailable, never a
+            # silent fallback to some other sensor
+            chosen = next((d for d, n in names.items() if want in n), None)
+            if chosen is None and by_dev:
+                import sys
+                print(f"[energy] DLNB_HWMON_DEVICE={want!r} matches none of "
+                      f"{sorted(names.values())}; hwmon sampling disabled",
+                      file=sys.stderr)
+        else:
+            chosen = next(iter(by_dev), None)
+        self._inputs = by_dev.get(chosen, []) if chosen else []
+        self._joules = 0.0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        if self._inputs:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    @property
+    def available(self) -> bool:
+        return bool(self._inputs)
+
+    def _loop(self):
+        prev = time.monotonic()
+        while not self._stop.is_set():
+            time.sleep(POWER_SAMPLING_RATE_MS / 1e3)
+            now = time.monotonic()
+            watts = 0.0
+            for path in self._inputs:
+                try:
+                    with open(path) as f:
+                        watts += float(f.read()) / 1e6
+                except (OSError, ValueError):
+                    continue
+            with self._lock:
+                self._joules += watts * (now - prev)
+            prev = now
+
+    def read_joules(self) -> float:
+        with self._lock:
+            return self._joules
+
+    def close(self):
+        self._stop.set()
+
+
+_CACHED = None
+_PROBED = False
+
+
+def detect_sampler():
+    """Best available host energy source, or None (cached per process)."""
+    global _CACHED, _PROBED
+    if _PROBED:
+        return _CACHED
+    _PROBED = True
+    rapl = RaplSampler()
+    if rapl.available:
+        _CACHED = rapl
+        return _CACHED
+    hw = HwmonSampler()
+    if hw.available:
+        _CACHED = hw
+        return _CACHED
+    return None

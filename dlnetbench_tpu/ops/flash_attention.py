@@ -8,8 +8,10 @@ Design (TPU-first, not a port — the reference has no kernels at all):
   running sum ``l``, fp32 accumulator) across kv steps, and the output
   block is written once, on the last kv step for that q row block.
 * Causality is exploited at block granularity: kv blocks entirely above
-  the diagonal are skipped with ``pl.when`` (no MXU work issued), and the
-  straddling blocks are masked in-register.
+  the diagonal are skipped with ``pl.when`` (no MXU work issued) and their
+  HBM->VMEM DMA is elided by clamping the BlockSpec index maps to the last
+  working block (same-index revisits copy nothing); straddling blocks are
+  masked in-register.
 * GQA maps q head ``h`` to kv head ``h // group`` purely in the
   ``BlockSpec`` index maps — no materialized KV broadcast.
 * Backward is the standard flash-attention recomputation split into a
@@ -137,8 +139,15 @@ def _fwd(q, k, v, *, causal: bool, block_q: int, block_k: int):
 
     nq, nk = s // block_q, s // block_k
     grid = (b, hq, nq, nk)
-    kv_spec = pl.BlockSpec((1, 1, block_k, dh_p),
-                           lambda bi, h, i, j: (bi, h // group, j, 0),
+
+    def kv_index(bi, h, i, j):
+        if causal:
+            # clamp skipped above-diagonal steps to the previous block so
+            # no DMA is issued for fully-masked KV (same-index revisit)
+            j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+        return (bi, h // group, j, 0)
+
+    kv_spec = pl.BlockSpec((1, 1, block_k, dh_p), kv_index,
                            memory_space=pltpu.VMEM)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -272,11 +281,16 @@ def _bwd_impl(q, k, v, out, lse, do, *, causal: bool,
                             (b, hq, _SUBLANES, s))        # sublane-replicated
 
     nq, nk = s // block_q, s // block_k
+
+    def kv_index(bi, h, i, j):
+        if causal:  # no DMA for fully-masked KV blocks (see _fwd)
+            j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+        return (bi, h // group, j, 0)
+
     q_spec = pl.BlockSpec((1, 1, block_q, dh_p),
                           lambda bi, h, i, j: (bi, h, i, 0),
                           memory_space=pltpu.VMEM)
-    kv_spec = pl.BlockSpec((1, 1, block_k, dh_p),
-                           lambda bi, h, i, j: (bi, h // group, j, 0),
+    kv_spec = pl.BlockSpec((1, 1, block_k, dh_p), kv_index,
                            memory_space=pltpu.VMEM)
     row_spec = pl.BlockSpec((1, 1, _SUBLANES, block_q),
                             lambda bi, h, i, j: (bi, h, 0, i),
@@ -293,8 +307,13 @@ def _bwd_impl(q, k, v, out, lse, do, *, causal: bool,
     )(qt, kt, vt, dot, lse, dcap)
 
     # dk/dv per q-head; inner (minor) axis walks q blocks
+    def qi_index(bi, h, j, i):
+        if causal:  # skip DMA of q blocks strictly above this kv diagonal
+            i = jnp.maximum(i, (j * block_k) // block_q)
+        return i
+
     q_spec_t = pl.BlockSpec((1, 1, block_q, dh_p),
-                            lambda bi, h, j, i: (bi, h, i, 0),
+                            lambda bi, h, j, i: (bi, h, qi_index(bi, h, j, i), 0),
                             memory_space=pltpu.VMEM)
     kv_spec_t = pl.BlockSpec((1, 1, block_k, dh_p),
                              lambda bi, h, j, i: (bi, h // group, j, 0),
@@ -303,7 +322,7 @@ def _bwd_impl(q, k, v, out, lse, do, *, causal: bool,
                             lambda bi, h, j, i: (bi, h, j, 0),
                             memory_space=pltpu.VMEM)
     row_spec_t = pl.BlockSpec((1, 1, _SUBLANES, block_q),
-                              lambda bi, h, j, i: (bi, h, 0, i),
+                              lambda bi, h, j, i: (bi, h, 0, qi_index(bi, h, j, i)),
                               memory_space=pltpu.VMEM)
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,

@@ -53,6 +53,7 @@ class ProxyConfig:
     time_scale: float = 1.0        # shrink burn durations for dev machines
     measure_comm_only: bool = True
     measure_compute_only: bool = True
+    measure_energy: bool = True    # reference PROXY_ENERGY_PROFILING
 
 
 @dataclasses.dataclass
@@ -94,7 +95,8 @@ class ProxyResult:
         return sum(vals) / len(vals) if vals else 0.0
 
 
-def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig) -> ProxyResult:
+def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
+              energy_sampler=None) -> ProxyResult:
     # warmup (also compiles); reference dp.cpp:234-244
     warmup_s = time_callable(bundle.full, reps=max(cfg.warmup, 1))
 
@@ -106,8 +108,24 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig) -> ProxyResult:
         while True:
             bundle.full()
 
+    if energy_sampler is None and cfg.measure_energy:
+        from dlnetbench_tpu.metrics.energy import detect_sampler
+        energy_sampler = detect_sampler()
+
     timers: dict[str, list] = {}
-    full_s = time_callable(bundle.full, reps=runs)
+    if energy_sampler is not None:
+        # One bracket around the whole measured phase, amortized to a
+        # per-run mean (reference energy_consumed arrays,
+        # plots/parser.py:172).  Per-run brackets would fold the
+        # transfer-fence host spin (utils/timing.py) into each sample on
+        # the tunnel backend; amortizing keeps that harness overhead a
+        # constant offset that cancels when configs are compared.
+        e0 = energy_sampler.read_joules()
+        full_s = time_callable(bundle.full, reps=runs)
+        per_run_j = max(0.0, energy_sampler.read_joules() - e0) / runs
+        timers["energy_consumed"] = [per_run_j] * runs
+    else:
+        full_s = time_callable(bundle.full, reps=runs)
     timers["runtimes"] = [t * 1e6 for t in full_s]
 
     if cfg.measure_compute_only and bundle.compute is not None:

@@ -32,13 +32,16 @@ def _needs_transfer_fence() -> bool:
     return True
 
 
-def _transfer_fence(res) -> None:
+def _transfer_fence(res) -> bool:
     """Force completion of everything queued before ``res`` by pulling one
     element of each device shard of one leaf to the host (the slice ops
     queue after the program; their transfers cannot complete earlier).
     Per-shard so multi-device programs without a final collective are fully
     fenced even where block_until_ready is a no-op."""
-    leaf = jax.tree.leaves(res)[0]
+    leaves = jax.tree.leaves(res)
+    if not leaves:  # fn returned None/empty pytree: nothing to fence
+        return False
+    leaf = leaves[0]
     shards = getattr(leaf, "addressable_shards", None)
     datas = [s.data for s in shards] if shards else [leaf]
     # Pipeline the per-shard round-trips: enqueue every one-element slice,
@@ -53,6 +56,7 @@ def _transfer_fence(res) -> None:
             pass
     for o in ones:
         o.item()
+    return True
 
 
 def tunnel_rtt_s() -> float:
@@ -84,10 +88,31 @@ def time_callable(fn, *args, reps: int = 1, **kwargs) -> list[float]:
         t0 = time.perf_counter()
         res = fn(*args, **kwargs)
         jax.block_until_ready(res)
-        if fence_transfer:
-            _transfer_fence(res)
-        out.append(max(0.0, time.perf_counter() - t0 - rtt))
+        # only subtract the RTT when a fence round-trip actually happened
+        fenced = _transfer_fence(res) if fence_transfer else False
+        out.append(max(0.0,
+                       time.perf_counter() - t0 - (rtt if fenced else 0.0)))
     return out
+
+
+def time_pipelined(fn, *args, iters: int = 20, **kwargs) -> float:
+    """Amortized per-iteration seconds: enqueue ``iters`` calls back-to-back
+    and fence ONCE.  Per-rep fencing (time_callable) pays the tunnel RTT on
+    every rep, which swamps millisecond-scale kernels with RTT jitter;
+    here the RTT is paid (and subtracted) once, so the resolution is
+    ~RTT/iters.  Use for throughput-style measurement; use time_callable
+    when per-run samples are needed (the proxy harness).  Caller need not
+    pre-warm: the first call is fenced out of the timed region."""
+    res = fn(*args, **kwargs)
+    jax.block_until_ready(res)
+    fenced_warm = _transfer_fence(res)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        res = fn(*args, **kwargs)
+    jax.block_until_ready(res)
+    fenced = _transfer_fence(res) if fenced_warm else False
+    el = time.perf_counter() - t0 - (tunnel_rtt_s() if fenced else 0.0)
+    return max(0.0, el / iters)
 
 
 def median_us(samples_s: list[float]) -> float:

@@ -1,0 +1,114 @@
+"""Energy sampling chain (metrics/energy.py) and its harness wiring."""
+from __future__ import annotations
+
+import pandas as pd
+
+from dlnetbench_tpu.metrics import energy as E
+from dlnetbench_tpu.metrics.emit import result_to_record
+from dlnetbench_tpu.metrics.parser import records_to_dataframe
+from dlnetbench_tpu.proxies.base import ProxyConfig, StepBundle, run_proxy
+
+
+def _write(path, lines):
+    path.write_text("".join(f"{line}\n" for line in lines))
+
+
+class FakeSampler:
+    """Deterministic cumulative counter: 2 J per read."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def read_joules(self):
+        self.calls += 1
+        return 2.0 * self.calls
+
+
+def test_rapl_sampler_counts_and_wraps(tmp_path):
+    pkg = tmp_path / "intel-rapl:0"
+    pkg.mkdir()
+    (pkg / "energy_uj").write_text("1000000")
+    (pkg / "max_energy_range_uj").write_text("2000000")
+    sub = tmp_path / "intel-rapl:0:0"   # subzone must be ignored
+    sub.mkdir()
+    (sub / "energy_uj").write_text("999999999")
+
+    s = E.RaplSampler(root=str(tmp_path))
+    assert s.available
+    assert s.read_joules() == 0.0
+    (pkg / "energy_uj").write_text("1500000")
+    assert abs(s.read_joules() - 0.5) < 1e-9
+    # wraparound: counter drops, range is added back
+    (pkg / "energy_uj").write_text("500000")
+    assert abs(s.read_joules() - 1.5) < 1e-9
+
+
+def test_rapl_psys_preempts_packages(tmp_path):
+    """psys already contains the package domains: when present, only psys
+    is summed (double-count guard)."""
+    for zone, name, energy in [("intel-rapl:0", "package-0", "100"),
+                               ("intel-rapl:1", "psys", "200")]:
+        d = tmp_path / zone
+        d.mkdir()
+        (d / "name").write_text(name)
+        (d / "energy_uj").write_text(energy)
+        (d / "max_energy_range_uj").write_text("1000000000")
+    s = E.RaplSampler(root=str(tmp_path))
+    s.read_joules()
+    (tmp_path / "intel-rapl:0" / "energy_uj").write_text("1000100")
+    (tmp_path / "intel-rapl:1" / "energy_uj").write_text("1000200")
+    assert abs(s.read_joules() - 1.0) < 1e-9  # psys delta only, not both
+
+
+def test_rapl_unknown_range_drops_wrapped_sample(tmp_path):
+    d = tmp_path / "intel-rapl:0"
+    d.mkdir()
+    (d / "energy_uj").write_text("500000")   # no max_energy_range_uj file
+    s = E.RaplSampler(root=str(tmp_path))
+    (d / "energy_uj").write_text("100")      # counter wrapped
+    assert s.read_joules() == 0.0            # dropped, not +inf
+    (d / "energy_uj").write_text("1000100")
+    assert abs(s.read_joules() - 1.0) < 1e-9
+
+
+def test_rapl_unavailable_when_no_domains(tmp_path):
+    assert not E.RaplSampler(root=str(tmp_path)).available
+    assert not E.HwmonSampler(root=str(tmp_path)).available
+
+
+def test_run_proxy_emits_energy_consumed():
+    bundle = StepBundle(full=lambda: None, compute=None, comm=None,
+                        global_meta={"proxy": "t", "world_size": 1})
+    cfg = ProxyConfig(warmup=1, runs=3)
+    res = run_proxy("t", bundle, cfg, energy_sampler=FakeSampler())
+    # one bracket over 3 runs of a 2 J/read counter: 2 J total / 3 runs
+    want = [2.0 / 3] * 3
+    assert res.timers_us["energy_consumed"] == want
+    assert len(res.timers_us["runtimes"]) == 3
+
+    rec = result_to_record(res)
+    assert rec["ranks"][0]["energy_consumed"] == want
+    df = records_to_dataframe([rec])
+    assert list(df["energy_consumed"]) == want
+
+
+def test_no_sampler_no_energy_column():
+    bundle = StepBundle(full=lambda: None, compute=None, comm=None,
+                        global_meta={"proxy": "t", "world_size": 1})
+    cfg = ProxyConfig(warmup=1, runs=2, measure_energy=False)
+    res = run_proxy("t", bundle, cfg)
+    assert "energy_consumed" not in res.timers_us
+
+
+def test_pareto_uses_energy_consumed_column():
+    from dlnetbench_tpu.analysis.plots import plot_pareto
+    import matplotlib
+    matplotlib.use("Agg")
+    df = pd.DataFrame({
+        "runtime": [10.0, 20.0, 30.0, 40.0],
+        "energy_consumed": [4.0, 3.0, 2.0, 5.0],
+        "model": ["m"] * 4,
+        "run": [0, 1, 2, 3],
+    })
+    ax = plot_pareto(df)
+    assert "energy_consumed" in ax.get_ylabel()

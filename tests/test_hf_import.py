@@ -1,0 +1,75 @@
+"""HF-config -> architecture-card mapping (hf_import.py).
+
+Config dicts below mirror the public HF configs of the registry models;
+mapping them must reproduce the committed cards field-for-field (reference
+python/download_models.py caches exactly these configs).
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from dlnetbench_tpu.core.model_card import load_model_card
+from dlnetbench_tpu import hf_import
+
+
+GPT2_L = {"model_type": "gpt2", "n_embd": 1280, "n_head": 20, "n_layer": 36,
+          "n_positions": 1024, "n_inner": None, "vocab_size": 50257}
+
+LLAMA3_8B = {"model_type": "llama", "hidden_size": 4096,
+             "num_attention_heads": 32, "num_key_value_heads": 8,
+             "intermediate_size": 14336, "max_position_embeddings": 8192,
+             "num_hidden_layers": 32, "vocab_size": 128256}
+
+MIXTRAL = {"model_type": "mixtral", "hidden_size": 4096,
+           "num_attention_heads": 32, "num_key_value_heads": 8,
+           "intermediate_size": 14336, "max_position_embeddings": 32768,
+           "num_hidden_layers": 32, "vocab_size": 32000,
+           "num_local_experts": 8, "num_experts_per_tok": 2}
+
+VIT_B = {"model_type": "vit", "hidden_size": 768, "num_attention_heads": 12,
+         "intermediate_size": 3072, "num_hidden_layers": 12,
+         "image_size": 224, "patch_size": 16, "num_labels": 1000}
+
+
+@pytest.mark.parametrize("name,cfg", [
+    ("gpt2_l", GPT2_L), ("llama3_8b", LLAMA3_8B),
+    ("mixtral_8x7b", MIXTRAL), ("vit_b", VIT_B),
+])
+def test_mapping_reproduces_committed_card(name, cfg):
+    got = hf_import.card_from_hf_config(name, cfg)
+    want = load_model_card(name)
+    assert got == want
+
+
+def test_gpt2_default_inner_is_4x():
+    card = hf_import.card_from_hf_config("gpt2_l", GPT2_L)
+    assert card.ff_dim == 4 * 1280 and card.tied_embeddings
+
+
+def test_unknown_model_type_raises():
+    with pytest.raises(ValueError, match="model_type"):
+        hf_import.card_from_hf_config("x", {"model_type": "mamba"})
+    with pytest.raises(KeyError):
+        hf_import.fetch_card("not_a_model")
+
+
+def test_card_json_roundtrip(tmp_path):
+    """import_model (offline fallback) writes a card that load_model_card
+    parses back to the identical dataclass, for every registry model."""
+    for name in hf_import.REGISTRY:
+        hf_import.import_model(name, tmp_path)
+        assert load_model_card(name, tmp_path) == load_model_card(name)
+
+
+def test_cli_list_and_all(tmp_path, capsys):
+    assert hf_import.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "meta-llama/Meta-Llama-3-8B" in out and "gpt2-large" in out
+    assert hf_import.main(["--all", "--out_dir", str(tmp_path)]) == 0
+    written = sorted(p.stem for p in tmp_path.glob("*.json"))
+    assert written == sorted(hf_import.REGISTRY)
+    # moe block survives the roundtrip as nested JSON
+    raw = json.loads((tmp_path / "mixtral_8x7b.json").read_text())
+    assert raw["moe_params"]["num_experts_per_tok"] == 2
