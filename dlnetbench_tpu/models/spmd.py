@@ -39,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dlnetbench_tpu import ops
 from dlnetbench_tpu.models import layers as Lyr
+from dlnetbench_tpu.ops import sequence_parallel as SP
 from dlnetbench_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP, make_grid_mesh
 
 _F32 = jnp.float32
@@ -61,6 +62,16 @@ class SpmdConfig:
     lr: float = 0.1
     dtype: str = "float32"       # bfloat16 on real TPU
     attention_impl: str = "auto"   # ops.attention dispatch: auto | flash | xla
+    # How attention handles the sequence sharding on the tp axis:
+    #   megatron  gather the sequence, shard the heads (2 collectives per
+    #             block: all_gather in, psum_scatter out) — the reference's
+    #             hybrid_3d TP pattern re-expressed (hybrid_3d.cpp:142-148)
+    #   ring      keep the sequence sharded; rotate KV around the axis with
+    #             ppermute, online-softmax merge (ops/sequence_parallel.py)
+    #             — heads replicated, attention weights replicated over tp
+    #   ulysses   all_to_all to head-sharding and back; full-sequence local
+    #             attention in between (flash kernel eligible)
+    sp_mode: str = "megatron"
 
     @property
     def head_dim(self) -> int:
@@ -71,13 +82,20 @@ class SpmdConfig:
         return jnp.dtype(self.dtype)
 
     def validate(self, dp: int, pp: int, tp: int) -> None:
+        # ring keeps all heads local, so head divisibility only binds the
+        # modes that shard heads over tp (megatron statically, ulysses via
+        # its all_to_all)
+        heads_sharded = self.sp_mode in ("megatron", "ulysses")
         checks = [
+            (self.sp_mode in ("megatron", "ring", "ulysses"),
+             f"sp_mode {self.sp_mode!r}"),
             (self.num_layers % pp == 0, "layers % pp"),
             (self.batch % (dp * self.num_microbatches) == 0,
              "batch % (dp*microbatches)"),
             (self.seq_len % tp == 0, "seq_len % tp (sp sharding)"),
-            (self.num_heads % tp == 0, "heads % tp"),
-            (self.num_kv_heads % tp == 0, "kv_heads % tp"),
+            (not heads_sharded or self.num_heads % tp == 0, "heads % tp"),
+            (not heads_sharded or self.num_kv_heads % tp == 0,
+             "kv_heads % tp"),
             (self.num_experts % tp == 0, "experts % tp (ep sharding)"),
             (self.vocab_size % tp == 0, "vocab % tp (parallel head)"),
         ]
@@ -118,16 +136,23 @@ def init_params(key, cfg: SpmdConfig) -> dict:
     }
 
 
-def param_specs() -> dict:
-    """PartitionSpec per leaf: layer stack over pp; Megatron TP on qkv/o;
-    experts over tp (ep); parallel head over tp on vocab."""
+def param_specs(sp_mode: str = "megatron") -> dict:
+    """PartitionSpec per leaf: layer stack over pp; Megatron TP on qkv/o
+    (megatron mode) or attention weights replicated over tp (ring/ulysses,
+    which shard activations, not weights); experts over tp (ep); parallel
+    head over tp on vocab."""
+    if sp_mode == "megatron":
+        wq = wk = wv = P(AXIS_PP, None, AXIS_TP)   # column parallel
+        wo = P(AXIS_PP, AXIS_TP, None)             # row parallel
+    else:
+        wq = wk = wv = wo = P(AXIS_PP, None, None)
     return {
         "embed": P(),                              # replicated
         "layers": {
-            "wq": P(AXIS_PP, None, AXIS_TP),       # column parallel
-            "wk": P(AXIS_PP, None, AXIS_TP),
-            "wv": P(AXIS_PP, None, AXIS_TP),
-            "wo": P(AXIS_PP, AXIS_TP, None),       # row parallel
+            "wq": wq,
+            "wk": wk,
+            "wv": wv,
+            "wo": wo,
             "norm1": P(AXIS_PP, None),
             "norm2": P(AXIS_PP, None),
             "w_router": P(AXIS_PP, None, None),
@@ -191,26 +216,48 @@ def _moe_block(cfg: SpmdConfig, tp: int, y, lp):
 
 
 def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions):
-    """One decoder block under TP+SP; x: [mb, S/tp, d] sequence-sharded."""
+    """One decoder block under TP+SP; x: [mb, S/tp, d] sequence-sharded.
+
+    ``positions``: the GLOBAL positions matching the sequence length rope
+    sees — the full [S] in megatron mode (rope runs after the gather),
+    this shard's [S/tp] slice in ring/ulysses mode (rope runs locally).
+    """
     mb, s_loc, d = x.shape
-    h_loc = cfg.num_heads // tp
-    hkv_loc = cfg.num_kv_heads // tp
     dh = cfg.head_dim
 
     y = Lyr.rmsnorm(x, lp["norm1"])
-    if tp > 1:  # SP: gather the full sequence to enter attention
+    if cfg.sp_mode == "megatron" and tp > 1:
+        # gather the full sequence, shard the heads (Megatron SP)
+        h_loc = cfg.num_heads // tp
+        hkv_loc = cfg.num_kv_heads // tp
         y = lax.all_gather(y, AXIS_TP, axis=1, tiled=True)   # [mb, S, d]
-    s_full = y.shape[1]
-    q = jnp.dot(y, lp["wq"]).reshape(mb, s_full, h_loc, dh)
-    k = jnp.dot(y, lp["wk"]).reshape(mb, s_full, hkv_loc, dh)
-    v = jnp.dot(y, lp["wv"]).reshape(mb, s_full, hkv_loc, dh)
-    q, k = Lyr.rope(q, k, positions)
-    att = ops.attention(q, k, v, causal=True,
-                        impl=cfg.attention_impl).reshape(
-        mb, s_full, d // tp if tp > 1 else d)
-    out = jnp.dot(att, lp["wo"])                              # partial sums
-    if tp > 1:  # SP: reduce partials and scatter back to sequence shards
+        s_full = y.shape[1]
+        q = jnp.dot(y, lp["wq"]).reshape(mb, s_full, h_loc, dh)
+        k = jnp.dot(y, lp["wk"]).reshape(mb, s_full, hkv_loc, dh)
+        v = jnp.dot(y, lp["wv"]).reshape(mb, s_full, hkv_loc, dh)
+        q, k = Lyr.rope(q, k, positions)
+        att = ops.attention(q, k, v, causal=True,
+                            impl=cfg.attention_impl).reshape(
+            mb, s_full, d // tp)
+        out = jnp.dot(att, lp["wo"])                          # partial sums
+        # reduce partials and scatter back to sequence shards
         out = lax.psum_scatter(out, AXIS_TP, scatter_dimension=1, tiled=True)
+    else:
+        # sequence stays sharded: project this shard with ALL heads
+        # (attention weights replicated over tp in these modes)
+        q = jnp.dot(y, lp["wq"]).reshape(mb, s_loc, cfg.num_heads, dh)
+        k = jnp.dot(y, lp["wk"]).reshape(mb, s_loc, cfg.num_kv_heads, dh)
+        v = jnp.dot(y, lp["wv"]).reshape(mb, s_loc, cfg.num_kv_heads, dh)
+        q, k = Lyr.rope(q, k, positions)
+        if tp > 1 and cfg.sp_mode == "ring":
+            att = SP.ring_attention(q, k, v, AXIS_TP, causal=True)
+        elif tp > 1 and cfg.sp_mode == "ulysses":
+            att = SP.ulysses_attention(q, k, v, AXIS_TP, causal=True,
+                                       impl=cfg.attention_impl)
+        else:   # tp == 1: plain local attention
+            att = ops.attention(q, k, v, causal=True,
+                                impl=cfg.attention_impl)
+        out = jnp.dot(att.reshape(mb, s_loc, d), lp["wo"])
     x = x + out
 
     y = Lyr.rmsnorm(x, lp["norm2"])
@@ -245,10 +292,9 @@ def make_train_step(mesh: Mesh, cfg: SpmdConfig):
     dp, pp, tp = (mesh.devices.shape[mesh.axis_names.index(a)]
                   for a in (AXIS_DP, AXIS_PP, AXIS_TP))
     cfg.validate(dp, pp, tp)
-    specs = param_specs()
+    specs = param_specs(cfg.sp_mode)
     mb_size = cfg.batch // (dp * cfg.num_microbatches)
     m = cfg.num_microbatches
-    positions = jnp.arange(cfg.seq_len)
 
     def local_loss(params_loc, tokens_loc):
         """Per-device pipeline forward; tokens_loc: [B/dp, S+1]."""
@@ -257,6 +303,12 @@ def make_train_step(mesh: Mesh, cfg: SpmdConfig):
         s_loc = cfg.seq_len // tp
         inputs = tokens_loc[:, :-1].reshape(m, mb_size, cfg.seq_len)
         targets = tokens_loc[:, 1:].reshape(m, mb_size, cfg.seq_len)
+        # rope positions: full sequence in megatron mode (rope follows the
+        # gather), this shard's global slice in ring/ulysses mode
+        if cfg.sp_mode == "megatron":
+            positions = jnp.arange(cfg.seq_len)
+        else:
+            positions = tp_idx * s_loc + jnp.arange(s_loc)
 
         def run_stage(x):
             def body(carry, lp):

@@ -1,0 +1,125 @@
+"""Sequence-parallel attention for the real-compute tier: ring + Ulysses.
+
+The reference has NO sequence/context parallelism anywhere (SURVEY.md §2.5,
+§5.7) — its proxy tier only ever scales message sizes by ``seq_len``.  The
+rebuild makes long context first-class twice over: schedule-level proxies
+(proxies/ring_attention.py, proxies/ulysses.py) and, here, the *real math*
+running inside ``shard_map`` on a mesh axis that shards the sequence:
+
+* ``ring_attention`` — blockwise online-softmax attention where KV shards
+  rotate around the ring axis via ``lax.ppermute`` (the natural idiom on an
+  ICI torus) while fp32 accumulators (running max / sum / output) merge one
+  KV block per step.  The full S x S score matrix and the full-sequence KV
+  never exist on any device: HBM stays O(S/n) per device, which is the
+  whole point at 32k+ tokens.  Causality skips nothing (every ring step is
+  a collective) but masks blocks from future shards to zero contribution.
+* ``ulysses_attention`` — two ``lax.all_to_all`` reshards per call
+  (sequence-sharded -> head-sharded and back); between them every device
+  holds the FULL sequence for its head subset, so the local attention can
+  use the Pallas flash kernel (ops.attention "auto" dispatch).
+
+Both are pure jnp + collectives, so ``jax.grad`` differentiates through
+them (``ppermute``/``all_to_all`` transpose to their inverses), giving
+correct distributed gradients with no custom VJP.  Tested on the virtual
+CPU mesh against full attention on the gathered sequence
+(tests/test_sequence_parallel_ops.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlnetbench_tpu import ops
+
+_F32 = jnp.float32
+_NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    """Grouped (GQA) scores: q [B, Sq, Hq, Dh], k [B, Sk, Hkv, Dh]
+    -> [B, Hkv, G, Sq, Sk] fp32."""
+    b, sq, hq, dh = q.shape
+    hkv = k.shape[2]
+    qg = q.reshape(b, sq, hkv, hq // hkv, dh)
+    return jnp.einsum("bqhgd,bkhd->bhgqk", qg * scale, k,
+                      preferred_element_type=_F32)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Ring attention inside ``shard_map``; all inputs sequence-sharded.
+
+    q: [B, S/n, Hq, Dh], k/v: [B, S/n, Hkv, Dh] — this device's shard of
+    the sequence, all heads resident.  Returns [B, S/n, Hq, Dh].
+    """
+    b, s_loc, hq, dh = q.shape
+    hkv = k.shape[2]
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    scale = 1.0 / (dh ** 0.5)
+    q_pos = me * s_loc + jnp.arange(s_loc)                  # global rows
+
+    # fp32 online-softmax state, grouped layout [B, Hkv, G, Sq(, Dh)]
+    g = hq // hkv
+    m0 = jnp.full((b, hkv, g, s_loc), _NEG_INF, _F32)
+    l0 = jnp.zeros((b, hkv, g, s_loc), _F32)
+    acc0 = jnp.zeros((b, hkv, g, s_loc, dh), _F32)
+    shift = [(i, (i + 1) % n) for i in range(n)]            # ring step
+
+    def merge_block(k_cur, v_cur, m, l, acc, t):
+        """Fold one KV block (originally from shard (me - t) mod n) into
+        the online-softmax state."""
+        src = (me - t) % n                                  # shard origin
+        s = _block_scores(q, k_cur, scale)                  # [B,Hkv,G,Sq,Sk]
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]         # [Sq, Sk]
+            s = jnp.where(mask[None, None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])                   # [B,Hkv,G,Sq,Sk]
+        l = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhgqk,bkhd->bhgqd", p.astype(v_cur.dtype), v_cur,
+                        preferred_element_type=_F32)
+        return m_new, l, acc * alpha[..., None] + pv
+
+    def body(carry, t):
+        k_cur, v_cur, m, l, acc = carry
+        m, l, acc = merge_block(k_cur, v_cur, m, l, acc, t)
+        # rotate KV one hop around the ring (overlappable with the next
+        # block's compute by XLA's async collective scheduling)
+        k_nxt = lax.ppermute(k_cur, axis_name, shift)
+        v_nxt = lax.ppermute(v_cur, axis_name, shift)
+        return (k_nxt, v_nxt, m, l, acc), None
+
+    # n-1 (compute, rotate) steps, then the last block unrotated — the
+    # nth hop would only feed a discarded carry (pure wasted ICI traffic)
+    (k_last, v_last, m, l, acc), _ = lax.scan(
+        body, (k, v, m0, l0, acc0), jnp.arange(n - 1))
+    m, l, acc = merge_block(k_last, v_last, m, l, acc, n - 1)
+    out = acc / l[..., None]                                # [B,Hkv,G,Sq,Dh]
+    return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(
+        b, s_loc, hq, dh).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      impl: str = "auto"):
+    """Ulysses (DeepSpeed-style) inside ``shard_map``: all-to-all from
+    sequence-sharded to head-sharded, full-sequence local attention (flash
+    kernel via ``impl``), all-to-all back.
+
+    q: [B, S/n, Hq, Dh] -> returns [B, S/n, Hq, Dh].  Requires both head
+    counts divisible by the axis size (lax.all_to_all enforces it).
+    """
+    def seq_to_heads(x):
+        # [B, S/n, H, Dh] -> [B, S, H/n, Dh]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = ops.attention(qh, kh, vh, causal=causal, impl=impl)
+    return heads_to_seq(out)

@@ -9,10 +9,6 @@ from dlnetbench_tpu.metrics.parser import records_to_dataframe
 from dlnetbench_tpu.proxies.base import ProxyConfig, StepBundle, run_proxy
 
 
-def _write(path, lines):
-    path.write_text("".join(f"{line}\n" for line in lines))
-
-
 class FakeSampler:
     """Deterministic cumulative counter: 2 J per read."""
 
