@@ -48,6 +48,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--out", default=None, help="append JSON record to file")
     p.add_argument("--no_topology", action="store_true",
                    help="skip the startup fabric-topology graph")
+    p.add_argument("--tag", action="append", default=[], metavar="KEY=VALUE",
+                   help="attach a variable to the emitted record (the "
+                        "analysis layer hoists it to a DataFrame column; "
+                        "the sweep driver tags each grid point this way — "
+                        "the role of sbatchman job.variables in the "
+                        "reference, plots/parser.py:238)")
 
 
 def _cfg(args) -> ProxyConfig:
@@ -141,6 +147,14 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(f"proxy {args.proxy!r} is not implemented yet ({e})")
     except ValueError as e:
         parser.error(str(e))  # configuration-invariant violations
+    if args.tag:
+        variables = {}
+        for tag in args.tag:
+            key, sep, value = tag.partition("=")
+            if not sep:
+                parser.error(f"--tag wants KEY=VALUE, got {tag!r}")
+            variables[key] = value
+        bundle.global_meta["variables"] = variables
     result = run_proxy(args.proxy, bundle, cfg)
     emit_result(result, path=args.out)
     return 0

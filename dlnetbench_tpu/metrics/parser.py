@@ -79,7 +79,13 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                     "platform": mesh.get("platform"),
                     "device_kind": mesh.get("device_kind"),
                 }
-                for k, v in g.items():
+                # sweep/job variables (reference: sbatchman job.variables,
+                # plots/parser.py:238) hoisted to plain columns.  Globals
+                # win over same-named (string-typed) tags, and neither may
+                # clobber the structural columns already in the row.
+                for k, v in {**g.get("variables", {}), **g}.items():
+                    if k in row:
+                        continue
                     if isinstance(v, list):
                         row[k] = tuple(v)  # hashable, groupby-safe
                     elif not isinstance(v, dict):

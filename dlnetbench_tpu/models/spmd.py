@@ -88,21 +88,22 @@ class SpmdConfig:
         heads_sharded = self.sp_mode in ("megatron", "ulysses")
         checks = [
             (self.sp_mode in ("megatron", "ring", "ulysses"),
-             f"sp_mode {self.sp_mode!r}"),
-            (self.num_layers % pp == 0, "layers % pp"),
+             f"unknown sp_mode {self.sp_mode!r}"),
+            (self.num_layers % pp == 0, "layers % pp != 0"),
             (self.batch % (dp * self.num_microbatches) == 0,
-             "batch % (dp*microbatches)"),
-            (self.seq_len % tp == 0, "seq_len % tp (sp sharding)"),
-            (not heads_sharded or self.num_heads % tp == 0, "heads % tp"),
+             "batch % (dp*microbatches) != 0"),
+            (self.seq_len % tp == 0, "seq_len % tp != 0 (sp sharding)"),
+            (not heads_sharded or self.num_heads % tp == 0,
+             "heads % tp != 0"),
             (not heads_sharded or self.num_kv_heads % tp == 0,
-             "kv_heads % tp"),
-            (self.num_experts % tp == 0, "experts % tp (ep sharding)"),
-            (self.vocab_size % tp == 0, "vocab % tp (parallel head)"),
+             "kv_heads % tp != 0"),
+            (self.num_experts % tp == 0, "experts % tp != 0 (ep sharding)"),
+            (self.vocab_size % tp == 0, "vocab % tp != 0 (parallel head)"),
         ]
         for ok, what in checks:
             if not ok:
                 raise ValueError(f"SpmdConfig invalid for mesh "
-                                 f"({dp},{pp},{tp}): {what} != 0")
+                                 f"({dp},{pp},{tp}): {what}")
 
 
 # --------------------------------------------------------------------- #
