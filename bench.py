@@ -46,8 +46,9 @@ def main() -> int:
                      ff_dim=base.ff_dim, seq_len=SEQ,
                      num_decoder_blocks=LAYERS, vocab_size=VOCAB,
                      gated_mlp=True)
+    # no remat: at B=2 S=2048 4L the activations fit v5e HBM comfortably
+    # and skipping recompute is ~12% faster than full block remat
     cfg = tfm.TransformerConfig.from_card(card)
-    cfg = tfm.TransformerConfig(**{**cfg.__dict__, "remat": True})
 
     params = tfm.init_params(jax.random.key(0), cfg)
     tokens = jax.random.randint(jax.random.key(1), (BATCH, SEQ + 1), 0, VOCAB)

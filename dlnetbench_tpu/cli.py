@@ -118,6 +118,14 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     cfg = _cfg(args)
 
+    # validate tags before any expensive backend/bundle work
+    variables = {}
+    for tag in args.tag:
+        key, sep, value = tag.partition("=")
+        if not sep or not key:
+            parser.error(f"--tag wants KEY=VALUE, got {tag!r}")
+        variables[key] = value
+
     # Some environments pre-import jax and pin the platform from
     # sitecustomize, so the JAX_PLATFORMS env var alone is not reliable —
     # honor it (and --platform) through jax.config before any backend use.
@@ -147,13 +155,7 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(f"proxy {args.proxy!r} is not implemented yet ({e})")
     except ValueError as e:
         parser.error(str(e))  # configuration-invariant violations
-    if args.tag:
-        variables = {}
-        for tag in args.tag:
-            key, sep, value = tag.partition("=")
-            if not sep:
-                parser.error(f"--tag wants KEY=VALUE, got {tag!r}")
-            variables[key] = value
+    if variables:
         bundle.global_meta["variables"] = variables
     result = run_proxy(args.proxy, bundle, cfg)
     emit_result(result, path=args.out)

@@ -49,15 +49,17 @@ def expand_grid(axes: dict[str, list[str]]) -> list[dict[str, str]]:
 def point_command(proxy: str, point: dict[str, str],
                   passthrough: list[str]) -> tuple[list[str], dict[str, str]]:
     """(argv, env-overrides) for one grid point."""
-    argv = [sys.executable, "-m", "dlnetbench_tpu.cli", proxy]
+    argv = [sys.executable, "-m", "dlnetbench_tpu.cli", proxy] + passthrough
     env: dict[str, str] = {}
+    # axis flags go AFTER the passthrough/fixed flags: argparse keeps the
+    # last occurrence, so the swept value always wins — the record's tag
+    # and the actual run can never disagree
     for key, value in point.items():
         if key.startswith("env:"):
             env[key[4:]] = value
         else:
             argv += [f"--{key}", value]
         argv += ["--tag", f"{key.removeprefix('env:')}={value}"]
-    argv += passthrough
     return argv, env
 
 
@@ -121,10 +123,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="continue past failed points")
     args = p.parse_args(argv)
 
-    try:
-        axes = dict(_parse_axis(s) for s in args.axis)
-    except ValueError as e:
-        p.error(str(e))
+    axes: dict[str, list[str]] = {}
+    for spec in args.axis:
+        try:
+            key, vals = _parse_axis(spec)
+        except ValueError as e:
+            p.error(str(e))
+        if key in axes:
+            p.error(f"--axis {key!r} given twice; merge the value lists")
+        axes[key] = vals
     passthrough = ["--model", args.model, "--out", args.out] + passthrough
     failed = run_sweep(args.proxy, axes, passthrough, dry_run=args.dry_run,
                        keep_going=args.keep_going)

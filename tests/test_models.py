@@ -102,3 +102,30 @@ def test_vit_card_guard():
         vitm.ViTConfig.from_card(card)
     with pytest.raises(ValueError, match="ViT card"):
         tfm.TransformerConfig.from_card(load_model_card("vit_b"))
+
+
+def test_remat_policies_agree():
+    """remat off / full / dots must give the same loss and gradients; an
+    unknown policy string is rejected at construction."""
+    from dlnetbench_tpu.models import transformer as tfm
+    cfg0 = tfm.TransformerConfig(
+        vocab_size=64, embed_dim=32, num_heads=4, num_kv_heads=2, ff_dim=64,
+        num_layers=2, seq_len=16, gated=True, max_positions=0,
+        dtype="float32")
+    params = tfm.init_params(jax.random.key(0), cfg0)
+    tokens = jax.random.randint(jax.random.key(1), (2, 17), 0, 64)
+
+    def lg(cfg):
+        return jax.value_and_grad(tfm.loss_fn)(params, tokens, cfg)
+
+    l0, g0 = lg(cfg0)
+    for policy in ("full", "dots"):
+        cfg = tfm.TransformerConfig(
+            **{**cfg0.__dict__, "remat": True, "remat_policy": policy})
+        l1, g1 = lg(cfg)
+        assert jnp.allclose(l0, l1, rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            assert jnp.allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        tfm.TransformerConfig(**{**cfg0.__dict__, "remat_policy": "dot"})

@@ -22,12 +22,22 @@ def test_point_command_splits_env_and_flags():
     argv, env = sweep.point_command(
         "dp", {"num_buckets": "4", "env:XLA_FLAGS": "--foo"}, ["--extra"])
     assert argv[:4] == [sys.executable, "-m", "dlnetbench_tpu.cli", "dp"]
-    assert ["--num_buckets", "4"] == argv[4:6]
+    # passthrough first, swept flags AFTER it (last occurrence wins in
+    # argparse, so a colliding fixed flag can never shadow the axis)
+    assert argv[4] == "--extra"
+    nb = argv.index("--num_buckets")
+    assert argv[nb + 1] == "4" and nb > 4
     assert env == {"XLA_FLAGS": "--foo"}
     # both axes become --tag entries, env: prefix stripped
     tags = [argv[i + 1] for i, a in enumerate(argv) if a == "--tag"]
     assert set(tags) == {"num_buckets=4", "XLA_FLAGS=--foo"}
-    assert argv[-1] == "--extra"
+
+
+def test_duplicate_axis_rejected(capsys):
+    with pytest.raises(SystemExit):
+        sweep.main(["dp", "--model", "m", "--out", "/dev/null",
+                    "--axis", "num_buckets=2", "--axis", "num_buckets=4"])
+    assert "given twice" in capsys.readouterr().err
 
 
 def test_axis_parsing_errors():
