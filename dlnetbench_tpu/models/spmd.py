@@ -166,6 +166,15 @@ def param_specs(sp_mode: str = "megatron") -> dict:
     }
 
 
+def param_shardings(mesh: Mesh, sp_mode: str = "megatron") -> dict:
+    """NamedSharding per parameter — e.g. a checkpoint-restore template
+    (utils/checkpoint.py) that lands each shard on its mesh device."""
+    from jax.sharding import NamedSharding
+    return jax.tree.map(lambda sp: NamedSharding(mesh, sp),
+                        param_specs(sp_mode),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
 def _replicated_axes(spec: P) -> tuple:
     """Mesh axes (excluding dp, which every grad is already mean-reduced
     over) that a parameter is replicated across — its gradient must be

@@ -1,0 +1,70 @@
+"""Stall detection for training/benchmark loops.
+
+The reference has no failure detection at all — a hung collective just
+hangs the job until the scheduler kills it (SURVEY.md §5.3).  On TPU the
+same failure mode exists (a mis-grouped collective deadlocks the program),
+and because dispatch is async the host often sits in a fence with no
+signal.  ``StepWatchdog`` is the missing tripwire: arm it around each step
+(or wrap the step function) and a daemon timer fires ``on_stall`` if the
+section outlives its deadline — by default printing a loud diagnostic with
+the stalled section name and elapsed time to stderr, once per arming.
+
+The watchdog observes; it does not kill.  Recovery policy (abort, requeue,
+checkpoint-restart via utils/checkpoint.py) belongs to the caller.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+
+class StepWatchdog:
+    """Deadline monitor for repeated sections.
+
+    >>> wd = StepWatchdog(deadline_s=300, name="train_step")
+    >>> for batch in data:
+    ...     with wd:
+    ...         params, loss = step(params, batch)
+
+    or ``step = wd.wrap(step)``.  ``stalls`` counts deadline overruns.
+    """
+
+    def __init__(self, deadline_s: float, on_stall=None, name: str = "step"):
+        self.deadline_s = float(deadline_s)
+        self.name = name
+        self.stalls = 0
+        self._on_stall = on_stall or self._default_on_stall
+        # per-thread stack of armed timers: nested sections and a shared
+        # watchdog across threads each disarm exactly their own timer
+        self._local = threading.local()
+
+    def _default_on_stall(self, name: str, elapsed_s: float) -> None:
+        print(f"[watchdog] section {name!r} exceeded its {self.deadline_s:.1f}s "
+              f"deadline ({elapsed_s:.1f}s elapsed) — likely a hung "
+              f"collective or device stall", file=sys.stderr, flush=True)
+
+    def _fire(self, armed_at: float) -> None:
+        self.stalls += 1
+        self._on_stall(self.name, time.monotonic() - armed_at)
+
+    def __enter__(self) -> "StepWatchdog":
+        armed_at = time.monotonic()
+        timer = threading.Timer(self.deadline_s, self._fire, args=(armed_at,))
+        timer.daemon = True
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(timer)
+        timer.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._local.stack.pop().cancel()
+
+    def wrap(self, fn):
+        """Return ``fn`` with every call armed."""
+        def wrapped(*args, **kwargs):
+            with self:
+                return fn(*args, **kwargs)
+        return wrapped

@@ -1,0 +1,40 @@
+"""Stall watchdog (utils/watchdog.py)."""
+from __future__ import annotations
+
+import time
+
+from dlnetbench_tpu.utils.watchdog import StepWatchdog
+
+
+def test_fast_section_does_not_fire():
+    fired = []
+    wd = StepWatchdog(0.5, on_stall=lambda n, e: fired.append((n, e)))
+    for _ in range(3):
+        with wd:
+            pass
+    time.sleep(0.7)  # past the deadline of every (disarmed) section
+    assert fired == [] and wd.stalls == 0
+
+
+def test_stalled_section_fires_once_per_arming():
+    fired = []
+    wd = StepWatchdog(0.05, on_stall=lambda n, e: fired.append((n, e)),
+                      name="collective")
+    with wd:
+        time.sleep(0.15)
+    assert wd.stalls == 1
+    assert fired[0][0] == "collective" and fired[0][1] >= 0.05
+
+
+def test_wrap_and_default_message(capsys):
+    wd = StepWatchdog(0.05, name="train_step")
+
+    @wd.wrap
+    def slow():
+        time.sleep(0.12)
+        return 42
+
+    assert slow() == 42
+    assert wd.stalls == 1
+    err = capsys.readouterr().err
+    assert "train_step" in err and "deadline" in err
