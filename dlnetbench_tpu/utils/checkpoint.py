@@ -20,13 +20,24 @@ from pathlib import Path
 import jax
 
 
-def _manager(ckpt_dir: Path | str, keep: int = 3):
+def _manager(ckpt_dir: Path | str, keep: int = 3, create: bool = True):
     import orbax.checkpoint as ocp
     return ocp.CheckpointManager(
         Path(ckpt_dir).absolute(),
         options=ocp.CheckpointManagerOptions(max_to_keep=keep,
-                                             create=True),
+                                             create=create),
     )
+
+
+def _template(params_template, shardings):
+    """ShapeDtypeStruct pytree for a StandardRestore."""
+    if shardings is None:
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            params_template)
+    return jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        params_template, shardings)
 
 
 def save_checkpoint(ckpt_dir: Path | str, step: int, params,
@@ -41,14 +52,16 @@ def save_checkpoint(ckpt_dir: Path | str, step: int, params,
 
 
 def latest_step(ckpt_dir: Path | str) -> int | None:
-    """Most recent checkpointed step, or None if no checkpoint exists."""
+    """Most recent checkpointed step, or None if no checkpoint exists.
+    Read-only: never creates the directory."""
     d = Path(ckpt_dir)
     if not d.exists():
         return None
-    mgr = _manager(d)
-    step = mgr.latest_step()
-    mgr.close()
-    return step
+    mgr = _manager(d, create=False)
+    try:
+        return mgr.latest_step()
+    finally:
+        mgr.close()
 
 
 def restore_checkpoint(ckpt_dir: Path | str, params_template,
@@ -62,20 +75,20 @@ def restore_checkpoint(ckpt_dir: Path | str, params_template,
     default device uncommitted.
     """
     import orbax.checkpoint as ocp
-    mgr = _manager(ckpt_dir)
-    step = step if step is not None else mgr.latest_step()
-    if step is None:
+    if not Path(ckpt_dir).exists():
         raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
-    if shardings is None:
-        template = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-            params_template)
-    else:
-        template = jax.tree.map(
-            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
-            params_template, shardings)
-    restored = mgr.restore(step, args=ocp.args.StandardRestore(template))
-    mgr.close()
+    mgr = _manager(ckpt_dir, create=False)
+    try:
+        step = step if step is not None else mgr.latest_step()
+        if step is None or step not in mgr.all_steps():
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} under {ckpt_dir} "
+                f"(available: {sorted(mgr.all_steps())})")
+        restored = mgr.restore(
+            step, args=ocp.args.StandardRestore(_template(params_template,
+                                                          shardings)))
+    finally:
+        mgr.close()
     return restored, step
 
 
@@ -97,18 +110,19 @@ def train_with_checkpointing(step_fn, params, batch, *, num_steps: int,
         start = 0
         existing = mgr.latest_step()
         if existing is not None:
-            params, start = restore_checkpoint(ckpt_dir, params,
-                                               shardings=shardings)
-            start += 1  # the saved step already completed
+            params = mgr.restore(
+                existing,
+                args=ocp.args.StandardRestore(_template(params, shardings)))
+            start = existing + 1  # the saved step already completed
             if log:
-                log(f"resumed from step {start - 1}")
+                log(f"resumed from step {existing}")
         losses = []
         for step in range(start, num_steps):
             params, loss = step_fn(params, batch)
-            losses.append(float(loss))
+            losses.append(loss)  # device scalar: no host sync in the loop
             if (step + 1) % save_every == 0 or step == num_steps - 1:
                 mgr.save(step, args=ocp.args.StandardSave(params))
         mgr.wait_until_finished()
     finally:
         mgr.close()
-    return params, losses, start
+    return params, [float(l) for l in losses], start

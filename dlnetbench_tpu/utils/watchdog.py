@@ -38,6 +38,7 @@ class StepWatchdog:
         # per-thread stack of armed timers: nested sections and a shared
         # watchdog across threads each disarm exactly their own timer
         self._local = threading.local()
+        self._stall_lock = threading.Lock()
 
     def _default_on_stall(self, name: str, elapsed_s: float) -> None:
         print(f"[watchdog] section {name!r} exceeded its {self.deadline_s:.1f}s "
@@ -45,7 +46,8 @@ class StepWatchdog:
               f"collective or device stall", file=sys.stderr, flush=True)
 
     def _fire(self, armed_at: float) -> None:
-        self.stalls += 1
+        with self._stall_lock:  # Timer threads may fire concurrently
+            self.stalls += 1
         self._on_stall(self.name, time.monotonic() - armed_at)
 
     def __enter__(self) -> "StepWatchdog":

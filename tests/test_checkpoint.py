@@ -35,7 +35,11 @@ def test_keep_limit_prunes_old_steps(tmp_path):
     for s in range(5):
         ckpt.save_checkpoint(tmp_path / "c", s, params, keep=2)
     assert ckpt.latest_step(tmp_path / "c") == 4
-    with pytest.raises(Exception):
+    # steps 0..2 were pruned by keep=2 — only 3 and 4 remain on disk
+    steps_on_disk = sorted(int(p.name) for p in (tmp_path / "c").iterdir()
+                           if p.name.isdigit())
+    assert steps_on_disk == [3, 4]
+    with pytest.raises(FileNotFoundError, match="step 0|no checkpoint|0"):
         ckpt.restore_checkpoint(tmp_path / "c", params, step=0)
 
 

@@ -1,0 +1,102 @@
+"""Multi-host runtime support (parallel/multihost.py), single-controller
+degradations + hybrid-mesh axis layout on the 8-device CPU mesh."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dlnetbench_tpu.models import spmd
+from dlnetbench_tpu.parallel import multihost as mh
+
+
+def test_single_process_degradations(eight_devices):
+    mh.initialize()            # no-op, must not raise
+    assert not mh.is_multihost()
+    mh.barrier()               # no-op
+    meta = mh.host_metadata()
+    assert len(meta) == 1 and meta[0]["process"] == 0
+    assert len(meta[0]["local_device_ids"]) >= 8
+
+
+def test_hybrid_mesh_axis_layout(eight_devices):
+    mesh = mh.make_hybrid_mesh(dcn={"dp": 2}, ici={"pp": 2, "tp": 2})
+    assert mesh.axis_names == ("dp", "pp", "tp")
+    assert mesh.devices.shape == (2, 2, 2)
+    # dcn size-1 axes are kept so shard_map specs stay stable
+    mesh1 = mh.make_hybrid_mesh(dcn={"dp": 1}, ici={"tp": 4})
+    assert mesh1.axis_names == ("dp", "tp")
+    assert mesh1.devices.shape == (1, 4)
+
+
+def test_training_step_on_hybrid_mesh(eight_devices):
+    """The SPMD step runs unchanged on a hybrid-constructed mesh (same axis
+    names) — dp would ride DCN, pp/tp ICI on a real pod."""
+    mesh = mh.make_hybrid_mesh(dcn={"dp": 2}, ici={"pp": 2, "tp": 2})
+    cfg = spmd.SpmdConfig(batch=8, num_microbatches=2)
+    step = spmd.make_train_step(mesh, cfg)
+    import jax
+    params = spmd.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1),
+                                (cfg.batch, cfg.seq_len + 1), 0,
+                                cfg.vocab_size)
+    _, loss = step(params, tokens)
+    assert np.isfinite(float(loss))
+
+
+def test_bad_axis_size_rejected(eight_devices):
+    with pytest.raises(ValueError):
+        mh.make_hybrid_mesh(dcn={"dp": 0}, ici={"tp": 4})
+
+
+@pytest.mark.slow
+def test_two_process_distributed_runtime(tmp_path):
+    """Genuine 2-process bootstrap over the loopback coordinator: each
+    process clears the pre-pinned backend, joins via initialize(), sees the
+    global 2-device world, passes a barrier, gathers both hosts' metadata,
+    and psums across processes."""
+    import subprocess, sys, os, textwrap
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent("""
+        import sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        # cross-process CPU *computation* collectives need gloo
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+        from dlnetbench_tpu.parallel import multihost as mh
+        mh.initialize(coordinator_address=f"127.0.0.1:{port}",
+                      num_processes=n, process_id=pid)
+        assert mh.is_multihost() and jax.process_count() == n
+        mh.barrier()
+        meta = mh.host_metadata()
+        assert [m["process"] for m in meta] == [0, 1], meta
+        # cross-process psum over the global 2-device mesh
+        import jax.numpy as jnp
+        from jax import lax, shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        nd = len(jax.devices())      # spans BOTH processes
+        assert nd > len(jax.local_devices()), (nd, jax.local_devices())
+        mesh = Mesh(jax.devices(), ("w",))
+        fn = shard_map(lambda x: lax.psum(x, "w"), mesh=mesh,
+                       in_specs=P("w"), out_specs=P(), check_vma=False)
+        total = jax.jit(fn)(jnp.arange(float(nd)))
+        # the result is replicated across BOTH processes: read the local
+        # replica (float() on a non-fully-addressable array raises)
+        local = float(total.addressable_data(0)[0])
+        assert local == nd * (nd - 1) / 2, local
+        print(f"OK {pid}")
+    """))
+    import socket
+    with socket.socket() as s:   # a free loopback port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {**os.environ, "PYTHONPATH": "/root/repo"}
+    env.pop("XLA_FLAGS", None)   # 1 local device per process is enough
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i), "2", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        assert f"OK {i}" in out
