@@ -48,6 +48,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--out", default=None, help="append JSON record to file")
     p.add_argument("--no_topology", action="store_true",
                    help="skip the startup fabric-topology graph")
+    p.add_argument("--profile", action="store_true",
+                   help="after the timed runs, trace one schedule iteration "
+                        "with the JAX profiler and attach per-collective "
+                        "device-op durations to the record (the cross-check "
+                        "for the decomposition timers, SURVEY.md 7.3)")
     p.add_argument("--tag", action="append", default=[], metavar="KEY=VALUE",
                    help="attach a variable to the emitted record (the "
                         "analysis layer hoists it to a DataFrame column; "
@@ -158,6 +163,9 @@ def main(argv: list[str] | None = None) -> int:
     if variables:
         bundle.global_meta["variables"] = variables
     result = run_proxy(args.proxy, bundle, cfg)
+    if args.profile:
+        from dlnetbench_tpu.metrics.profiling import profile_collectives
+        result.global_meta["profile"] = profile_collectives(bundle.full)
     emit_result(result, path=args.out)
     return 0
 

@@ -1,0 +1,95 @@
+"""Profiler-trace-derived per-collective timing.
+
+SURVEY.md §7.3 hard-part 1: the reference times each collective by
+bracketing a host-blocking call — on TPU, fencing every collective would
+destroy the compute/comm overlap being measured.  The harness therefore
+measures by schedule *decomposition* (proxies/base.py); this module is the
+independent cross-check channel: run ONE schedule iteration under the JAX
+profiler, parse the Chrome-trace it emits, and report per-collective
+device-op durations (count / total / mean per collective kind).  The two
+channels bound the truth from different sides — decomposition gives
+end-to-end exposed cost including queueing, the trace gives pure device
+occupancy of each collective op.
+
+Works on every backend (CPU-mesh traces name ops ``psum.N`` etc.; TPU
+traces ``all-reduce.N`` / ``collective-permute.N`` / fusions) with no
+TensorFlow dependency — the trace.json.gz is stdlib-parseable.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import tempfile
+from pathlib import Path
+
+import jax
+
+# HLO/op-name fragments -> collective kind (lowercased substring match)
+COLLECTIVE_PATTERNS: dict[str, tuple[str, ...]] = {
+    "allreduce": ("all-reduce", "all_reduce", "allreduce", "psum"),
+    "allgather": ("all-gather", "all_gather", "allgather"),
+    "reduce_scatter": ("reduce-scatter", "reduce_scatter", "psum-scatter",
+                       "psum_scatter"),
+    "alltoall": ("all-to-all", "all_to_all", "alltoall"),
+    "permute": ("collective-permute", "collective_permute", "ppermute"),
+    "send_recv": ("send-done", "recv-done", "send.", "recv."),
+}
+# reduce_scatter names contain "psum" -> check more specific kinds first
+_KIND_ORDER = ("reduce_scatter", "allgather", "alltoall", "permute",
+               "send_recv", "allreduce")
+
+
+def classify_op(name: str) -> str | None:
+    """Collective kind for a trace-event name, or None."""
+    n = name.lower()
+    if n.startswith("end: "):   # async completion markers, not the op
+        return None
+    for kind in _KIND_ORDER:
+        if any(p in n for p in COLLECTIVE_PATTERNS[kind]):
+            return kind
+    return None
+
+
+def load_trace_events(trace_dir: str | Path) -> list[dict]:
+    """All complete ('X') events from the newest trace.json.gz under
+    ``trace_dir`` (the layout jax.profiler.trace writes)."""
+    paths = sorted(glob.glob(f"{trace_dir}/**/*.trace.json.gz",
+                             recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    with gzip.open(paths[-1]) as f:
+        trace = json.load(f)
+    return [e for e in trace.get("traceEvents", [])
+            if e.get("ph") == "X" and "dur" in e]
+
+
+def collective_stats(events: list[dict]) -> dict[str, dict]:
+    """Per-collective-kind device-occupancy summary (durations in us)."""
+    by_kind: dict[str, list[float]] = {}
+    for e in events:
+        kind = classify_op(e.get("name", ""))
+        if kind is not None:
+            by_kind.setdefault(kind, []).append(float(e["dur"]))
+    return {
+        kind: {
+            "count": len(durs),
+            "total_us": sum(durs),
+            "mean_us": sum(durs) / len(durs),
+            "max_us": max(durs),
+        }
+        for kind, durs in sorted(by_kind.items())
+    }
+
+
+def profile_collectives(fn, *args, trace_dir: str | Path | None = None,
+                        **kwargs) -> dict[str, dict]:
+    """Run ``fn`` once under the profiler; return ``collective_stats``.
+
+    ``fn`` should be compiled already (profile the steady state, not
+    tracing/compilation).  ``trace_dir`` defaults to a fresh temp dir.
+    """
+    d = str(trace_dir) if trace_dir else tempfile.mkdtemp(prefix="dlnb_prof_")
+    with jax.profiler.trace(d):
+        jax.block_until_ready(fn(*args, **kwargs))
+    return collective_stats(load_trace_events(d))

@@ -86,20 +86,23 @@ def make_hybrid_mesh(dcn: dict[str, int], ici: dict[str, int],
     shape = tuple(dcn.values()) + tuple(ici.values())
     devices = list(devices) if devices is not None else jax.devices()
     if any(n <= 0 for n in shape):
-        raise ValueError(f"axis sizes must be positive: {{**dcn, **ici}}")
-    try:
+        raise ValueError(f"axis sizes must be positive: { {**dcn, **ici} }")
+    if is_multihost() and any(n > 1 for n in dcn.values()):
+        # per-axis factorization: DCN axes replicate across slices
+        # (mesh_shape 1 there), ICI axes live within a slice.  Failures
+        # here (wrong slice count, unknown topology) must surface — a
+        # silently mis-laid mesh would measure the wrong fabric.
         from jax.experimental import mesh_utils
-        if is_multihost() and any(n > 1 for n in dcn.values()):
-            # per-axis factorization: DCN axes replicate across slices
-            # (mesh_shape 1 there), ICI axes live within a slice
-            grid = mesh_utils.create_hybrid_device_mesh(
-                mesh_shape=(1,) * len(dcn) + tuple(ici.values()),
-                dcn_mesh_shape=tuple(dcn.values()) + (1,) * len(ici),
-                devices=devices)
-        else:
-            grid = mesh_utils.create_device_mesh(shape, devices=devices)
-    except Exception:
-        grid = np.asarray(devices[: int(np.prod(shape))]).reshape(shape)
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1,) * len(dcn) + tuple(ici.values()),
+            dcn_mesh_shape=tuple(dcn.values()) + (1,) * len(ici),
+            devices=devices)
+    else:
+        # single-host: same validated, ICI-friendly construction as every
+        # other mesh maker (raises when too few devices; extra devices
+        # beyond the mesh size are deliberately left unused)
+        from dlnetbench_tpu.parallel.mesh import _device_grid
+        grid = _device_grid(shape, devices)
     return Mesh(grid, names)
 
 
@@ -125,7 +128,11 @@ def host_metadata() -> list[dict]:
         return [local]
     from jax.experimental import multihost_utils
     payload = json.dumps(local).encode()
-    buf = np.zeros(512, np.uint8)
+    # agree on a buffer size first so a long hostname / big device list on
+    # one host can't crash it mid-collective while peers block
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.array([len(payload)], np.int32)))
+    buf = np.zeros(int(lens.max()), np.uint8)
     buf[:len(payload)] = np.frombuffer(payload, np.uint8)
     gathered = np.asarray(multihost_utils.process_allgather(buf))
     return [json.loads(bytes(row).rstrip(b"\x00").decode())

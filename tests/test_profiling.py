@@ -1,0 +1,77 @@
+"""Profiler-trace collective extraction (metrics/profiling.py)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlnetbench_tpu.metrics import profiling as prof
+
+
+def test_classify_op():
+    assert prof.classify_op("all-reduce.3") == "allreduce"
+    assert prof.classify_op("psum.7") == "allreduce"
+    assert prof.classify_op("reduce-scatter.2") == "reduce_scatter"
+    assert prof.classify_op("psum-scatter.1") == "reduce_scatter"
+    assert prof.classify_op("all-gather.5") == "allgather"
+    assert prof.classify_op("all-to-all") == "alltoall"
+    assert prof.classify_op("collective-permute.9") == "permute"
+    assert prof.classify_op("fusion.12") is None
+    assert prof.classify_op("end: psum.7") is None   # completion marker
+
+
+def test_collective_stats_aggregation():
+    events = [
+        {"ph": "X", "name": "psum.7", "dur": 10.0},
+        {"ph": "X", "name": "psum.7", "dur": 30.0},
+        {"ph": "X", "name": "all-gather.1", "dur": 5.0},
+        {"ph": "X", "name": "broadcast_multiply_fusion", "dur": 99.0},
+    ]
+    stats = prof.collective_stats(events)
+    assert stats["allreduce"] == {"count": 2, "total_us": 40.0,
+                                  "mean_us": 20.0, "max_us": 30.0}
+    assert stats["allgather"]["count"] == 1
+    assert "fusion" not in str(stats)
+
+
+def test_missing_trace_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        prof.load_trace_events(tmp_path)
+
+
+@pytest.mark.slow
+def test_profile_real_schedule(eight_devices, tmp_path):
+    """Trace a real shard_map program on the CPU mesh: the psum and the
+    ppermute must both surface with nonzero device time."""
+    mesh = Mesh(jax.devices()[:4], ("x",))
+
+    def step(a):
+        b = lax.ppermute(a, "x", [(i, (i + 1) % 4) for i in range(4)])
+        return lax.psum(a * b, "x")
+
+    fn = jax.jit(shard_map(step, mesh=mesh, in_specs=P("x"),
+                           out_specs=P(), check_vma=False))
+    x = jnp.arange(16.0)
+    jax.block_until_ready(fn(x))   # compile outside the trace
+    stats = prof.profile_collectives(fn, x, trace_dir=tmp_path)
+    assert stats["allreduce"]["count"] >= 1
+    assert stats["permute"]["count"] >= 1
+    assert stats["allreduce"]["total_us"] > 0
+
+
+@pytest.mark.slow
+def test_cli_profile_flag(eight_devices, tmp_path, capsys):
+    from dlnetbench_tpu.cli import main
+    import json
+    out = tmp_path / "rec.jsonl"
+    rc = main(["dp", "--model", "gpt2_l_16_bfloat16", "--num_buckets", "2",
+               "--platform", "cpu", "-r", "1", "-w", "1",
+               "--size_scale", "1e-5", "--time_scale", "1e-4",
+               "--no_topology", "--profile", "--out", str(out)])
+    assert rc == 0
+    rec = json.loads(out.read_text().strip())
+    assert rec["global"]["profile"]["allreduce"]["count"] >= 1
