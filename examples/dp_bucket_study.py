@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""End-to-end DP bucket-count study — the reference's headline workflow
+(sweep -> parse -> plot) on one dev box.
+
+The reference's analogous loop is: sbatchman submits a job grid over NCCL
+knobs, parser.py walks the completed jobs into DataFrames, plot_dp.py
+draws runtime scaling and barrier scatter (reference plots/plot_dp.py:29,
+:80).  Here the same loop runs locally on the virtual CPU mesh:
+
+    python examples/dp_bucket_study.py --out_dir /tmp/dp_study
+
+sweeps the dp proxy over bucket counts, ingests the tagged records, prints
+the per-bucket exposed-communication table, and writes scaling + barrier
++ Pareto PNGs.  Swap ``--platform cpu`` out and raise the scales to run
+the identical study on a TPU slice.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+# runnable from a clone without installation
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out_dir", type=Path, default=Path("/tmp/dp_study"))
+    ap.add_argument("--model", default="gpt2_l_16_bfloat16")
+    ap.add_argument("--buckets", default="2,4,8")
+    ap.add_argument("--devices", type=int, default=8)
+    args = ap.parse_args()
+
+    args.out_dir.mkdir(parents=True, exist_ok=True)
+    records = args.out_dir / "records.jsonl"
+    records.unlink(missing_ok=True)
+
+    # 1. sweep (each point is a fresh subprocess; see dlnetbench_tpu/sweep.py)
+    import os
+    if not os.environ.get("XLA_FLAGS"):   # empty counts as unset
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={args.devices}")
+    # sweep points are subprocesses: make the package importable for them
+    # regardless of cwd / installation
+    repo = str(Path(__file__).resolve().parent.parent)
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, os.environ.get("PYTHONPATH")) if p)
+    from dlnetbench_tpu import sweep
+    rc = sweep.main([
+        "dp", "--model", args.model, "--out", str(records),
+        "--axis", f"num_buckets={args.buckets}", "--",
+        "--platform", "cpu", "-r", "3", "-w", "1",
+        "--size_scale", "1e-5", "--time_scale", "1e-4", "--no_topology"])
+    if rc != 0:
+        return rc
+
+    # 2. ingest (reference plots/parser.py:213-256 shape: rank x run rows)
+    from dlnetbench_tpu.metrics.parser import get_metrics_dataframe
+    df = get_metrics_dataframe(records, "dp")
+    summary = (df.groupby("num_buckets")[["runtime", "barrier_time"]]
+               .mean().sort_index())
+    print("\nmean per bucket count (us):")
+    print(summary.to_string(float_format=lambda v: f"{v:12.1f}"))
+
+    # 3. plots (reference plots/plot_dp.py, plots_pareto_energy.py)
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from dlnetbench_tpu.analysis import plots
+
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for nb, sub in df.groupby("num_buckets"):
+        ax.plot(sub.groupby("run")["runtime"].mean(), marker="o",
+                label=f"{nb} buckets")
+    ax.set_xlabel("run"), ax.set_ylabel("runtime (us)"), ax.legend()
+    fig.savefig(args.out_dir / "runtime_by_bucket.png", dpi=120)
+
+    ax = plots.plot_barrier_scatter_by_bucket(df)
+    ax.figure.savefig(args.out_dir / "barrier_by_bucket.png", dpi=120)
+
+    ax = plots.plot_pareto(df, x="runtime", group_by="num_buckets",
+                           y="barrier_time")
+    ax.figure.savefig(args.out_dir / "pareto.png", dpi=120)
+
+    print(f"\nwrote {args.out_dir}/{{runtime_by_bucket,barrier_by_bucket,"
+          f"pareto}}.png")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

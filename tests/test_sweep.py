@@ -82,3 +82,20 @@ def test_real_two_point_sweep(tmp_path):
     # the proxy's int typing (globals win over the string tag)
     assert sorted(df["num_buckets"].unique()) == [2, 4]
     assert (df.groupby("num_buckets")["run"].count() > 0).all()
+
+
+@pytest.mark.slow
+def test_example_study_end_to_end(tmp_path):
+    """examples/dp_bucket_study.py must run the whole sweep->parse->plot
+    loop and write the three PNGs."""
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, "examples/dp_bucket_study.py",
+         "--out_dir", str(tmp_path), "--buckets", "2,4", "--devices", "4"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "XLA_FLAGS": ""},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for png in ("runtime_by_bucket", "barrier_by_bucket", "pareto"):
+        assert (tmp_path / f"{png}.png").stat().st_size > 0
+    assert "mean per bucket count" in proc.stdout
