@@ -67,6 +67,17 @@ def _cfg(args) -> ProxyConfig:
                        size_scale=args.size_scale, time_scale=args.time_scale)
 
 
+def _add_pipeline(p: argparse.ArgumentParser) -> None:
+    """Flags shared by the three pipeline (hybrid) proxies."""
+    _add_common(p)
+    p.add_argument("--num_stages", type=int, required=True)
+    p.add_argument("--num_microbatches", type=int, required=True)
+    p.add_argument("--schedule", choices=["gpipe", "1f1b"],
+                   default="gpipe",
+                   help="pipeline schedule (gpipe = reference parity; "
+                        "1f1b = interleaved fwd/bwd, rebuild extra)")
+
+
 def _devices(args):
     import jax
     devs = jax.devices()
@@ -90,22 +101,16 @@ def main(argv: list[str] | None = None) -> int:
                         help="0 = whole world (no replicas)")
 
     p_2d = sub.add_parser("hybrid_2d", help="DP + GPipe pipeline")
-    _add_common(p_2d)
-    p_2d.add_argument("--num_stages", type=int, required=True)
-    p_2d.add_argument("--num_microbatches", type=int, required=True)
+    _add_pipeline(p_2d)
     p_2d.add_argument("--dp", type=int, default=0, help="0 = infer from devices")
 
     p_3d = sub.add_parser("hybrid_3d", help="DP + PP + tensor parallel")
-    _add_common(p_3d)
-    p_3d.add_argument("--num_stages", type=int, required=True)
-    p_3d.add_argument("--num_microbatches", type=int, required=True)
+    _add_pipeline(p_3d)
     p_3d.add_argument("--tp", type=int, required=True)
     p_3d.add_argument("--dp", type=int, default=0)
 
     p_moe = sub.add_parser("hybrid_3d_moe", help="DP + PP + expert parallel")
-    _add_common(p_moe)
-    p_moe.add_argument("--num_stages", type=int, required=True)
-    p_moe.add_argument("--num_microbatches", type=int, required=True)
+    _add_pipeline(p_moe)
     p_moe.add_argument("--num_expert_shards", type=int, required=True)
     p_moe.add_argument("--dp", type=int, default=0)
 
@@ -188,18 +193,21 @@ def _build_bundle(args, parser, stats, cfg, devices):
             bundle = proxy_mod.build(stats, card, cfg,
                                      num_stages=args.num_stages,
                                      num_microbatches=args.num_microbatches,
+                                     schedule=args.schedule,
                                      dp=args.dp, devices=devices)
         elif args.proxy == "hybrid_3d":
             from dlnetbench_tpu.proxies import hybrid_3d as proxy_mod
             bundle = proxy_mod.build(stats, card, cfg,
                                      num_stages=args.num_stages,
                                      num_microbatches=args.num_microbatches,
+                                     schedule=args.schedule,
                                      tp=args.tp, dp=args.dp, devices=devices)
         elif args.proxy == "hybrid_3d_moe":
             from dlnetbench_tpu.proxies import hybrid_3d_moe as proxy_mod
             bundle = proxy_mod.build(stats, card, cfg,
                                      num_stages=args.num_stages,
                                      num_microbatches=args.num_microbatches,
+                                     schedule=args.schedule,
                                      num_expert_shards=args.num_expert_shards,
                                      dp=args.dp, devices=devices)
         elif args.proxy == "ring_attention":

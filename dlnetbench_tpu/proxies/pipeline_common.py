@@ -51,8 +51,16 @@ def _infer_dp(world: int, num_stages: int, tp: int, dp: int,
 def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
           mode: str, num_stages: int, num_microbatches: int,
           tp: int = 1, num_expert_shards: int = 1, dp: int = 0,
-          devices=None, dtype=jnp.float32) -> StepBundle:
+          schedule: str = "gpipe", devices=None,
+          dtype=jnp.float32) -> StepBundle:
+    """``schedule``: "gpipe" (all-fwd-then-all-bwd, the reference's only
+    schedule, hybrid_2d.cpp:106-161) or "1f1b" (rebuild extra: pp-1
+    forward warmup ticks, then interleaved fwd/bwd pairs, then backward
+    cooldown — the up and down pipe hops of a steady-state pair ride the
+    bidirectional links together instead of in two serial phases)."""
     assert mode in ("2d", "3d", "moe")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     devices = devices if devices is not None else jax.devices()
     world = len(devices)
     inner = num_expert_shards if mode == "moe" else tp
@@ -86,6 +94,7 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         ex_elems = scaled_elems(moe.expert_sync_elems, cfg.size_scale)
 
     act = sharded_zeros(mesh, P(), (pipe_elems,), dtype)
+    act2 = sharded_zeros(mesh, P(), (pipe_elems,), dtype)  # 1f1b down-hop
     grad_shard = sharded_zeros(mesh, P(), (dp_elems,), dtype)
     tp_buf = sharded_zeros(mesh, P(), (max(tp_elems, 1),), dtype)
     a2a_buf = sharded_zeros(mesh, P(), (max(a2a_elems, num_expert_shards),),
@@ -116,7 +125,7 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                 outs.append(a)
         return outs
 
-    def step(state, act_b, grad_b, tp_b, a2a_b, ne_b, ex_b, *,
+    def step(state, act_b, act2_b, grad_b, tp_b, a2a_b, ne_b, ex_b, *,
              with_compute: bool, with_comm: bool):
         def burn_(s, iters):
             return burnlib.burn(s, iters) if with_compute else s
@@ -124,20 +133,55 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         bufs = {"tp": tp_b, "a2a": a2a_b}
         outs = []
         cur = act_b
-        # phase 1: all microbatches forward (hybrid_2d.cpp:106-133)
-        for _ in range(num_microbatches):
+
+        def fwd_tick(state, cur):
             state = burn_(state, fwd_iters)
             if with_comm:
                 cur = col.shift_up(col.tie(cur, state), AXIS_PP)
             state = col.tie(state, cur)
             outs.extend(inner_comms(state, bufs, with_comm))
-        # phase 2: all microbatches backward, mirrored (hybrid_2d.cpp:135-161)
-        for _ in range(num_microbatches):
+            return state, cur
+
+        def bwd_tick(state, cur):
             state = burn_(state, bwd_iters)
             if with_comm:
                 cur = col.shift_down(col.tie(cur, state), AXIS_PP)
             state = col.tie(state, cur)
             outs.extend(inner_comms(state, bufs, with_comm))
+            return state, cur
+
+        if schedule == "gpipe":
+            # phase 1: all microbatches forward (hybrid_2d.cpp:106-133);
+            # phase 2: all backward, mirrored (hybrid_2d.cpp:135-161)
+            for _ in range(num_microbatches):
+                state, cur = fwd_tick(state, cur)
+            for _ in range(num_microbatches):
+                state, cur = bwd_tick(state, cur)
+        else:  # 1f1b: warmup fwd, steady interleave, cooldown bwd
+            warm = min(num_stages - 1, num_microbatches)
+            cur_b = act2_b
+            for _ in range(warm):
+                state, cur = fwd_tick(state, cur)
+            for _ in range(num_microbatches - warm):
+                # steady pair: the up-hop of microbatch i and the down-hop
+                # of microbatch i-(pp-1) are issued on INDEPENDENT carries
+                # (neither burn nor the other hop depends on them until the
+                # tick ends), so XLA can ride both directions of the
+                # bidirectional links together — the property that makes
+                # 1F1B's comm pattern differ from GPipe's two serial phases
+                state = burn_(state, fwd_iters)
+                up = col.shift_up(col.tie(cur, state), AXIS_PP) \
+                    if with_comm else cur
+                outs.extend(inner_comms(state, bufs, with_comm))
+                state = burn_(state, bwd_iters)
+                down = col.shift_down(col.tie(cur_b, state), AXIS_PP) \
+                    if with_comm else cur_b
+                outs.extend(inner_comms(state, bufs, with_comm))
+                cur, cur_b = up, down
+                state = col.tie(col.tie(state, cur), cur_b)
+            for _ in range(warm):
+                state, cur_b = bwd_tick(state, cur_b)
+            outs.append(cur_b)
         # phase 3: gradient sync
         if with_comm:
             if mode == "moe":
@@ -157,11 +201,11 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         fn = shard_map(
             functools.partial(step, with_compute=with_compute,
                               with_comm=with_comm),
-            mesh=mesh, in_specs=tuple(P() for _ in range(7)),
+            mesh=mesh, in_specs=tuple(P() for _ in range(8)),
             out_specs=P(), check_vma=False)
         jitted = jax.jit(fn)
-        return lambda: jitted(state0, act, grad_shard, tp_buf, a2a_buf,
-                              ne_in, ex_in)
+        return lambda: jitted(state0, act, act2, grad_shard, tp_buf,
+                              a2a_buf, ne_in, ex_in)
 
     # per-collective comm-only variants
     def make_var(body, *bufs):
@@ -170,17 +214,31 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         jitted = jax.jit(fn)
         return lambda: jitted(*bufs)
 
-    def pp_body(a):
+    def pp_body(a, a2=None):
         outs = []
-        for _ in range(num_microbatches):
-            a = col.shift_up(a, AXIS_PP)
-            outs.append(a)
-        for _ in range(num_microbatches):
-            a = col.shift_down(a, AXIS_PP)
-            outs.append(a)
+        if schedule == "gpipe":
+            for _ in range(num_microbatches):
+                a = col.shift_up(a, AXIS_PP)
+                outs.append(a)
+            for _ in range(num_microbatches):
+                a = col.shift_down(a, AXIS_PP)
+                outs.append(a)
+        else:  # 1f1b: steady pairs on independent carries (overlappable)
+            warm = min(num_stages - 1, num_microbatches)
+            for _ in range(warm):
+                a = col.shift_up(a, AXIS_PP)
+                outs.append(a)
+            for _ in range(num_microbatches - warm):
+                a = col.shift_up(a, AXIS_PP)
+                a2 = col.shift_down(a2, AXIS_PP)
+                outs += [a, a2]
+            for _ in range(warm):
+                a2 = col.shift_down(a2, AXIS_PP)
+                outs.append(a2)
         return col.fence(*outs)
 
-    variants = {"pp_comm": make_var(pp_body, act)}
+    pp_bufs = (act,) if schedule == "gpipe" else (act, act2)
+    variants = {"pp_comm": make_var(pp_body, *pp_bufs)}
     if mode == "moe":
         def ep_body(a):
             a = a.reshape(num_expert_shards, -1)
@@ -220,6 +278,7 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         "dp": dp, "num_stages": num_stages, "tp": tp,
         "num_expert_shards": num_expert_shards if mode == "moe" else 0,
         "num_microbatches": num_microbatches,
+        "schedule": schedule,
         "layers_per_stage": sched.layers_per_stage,
         "pipe_msg_bytes": int(pipe_elems * itemsize),
         "schedule_pipe_msg_bytes": int(sched.pipe_msg_elems

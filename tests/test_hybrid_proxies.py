@@ -99,3 +99,32 @@ def test_moe_requires_moe_card(eight_devices):
         hybrid_3d_moe.build(stats, card, CFG, num_stages=4,
                             num_microbatches=2, num_expert_shards=2,
                             devices=eight_devices)
+
+
+@pytest.mark.parametrize("mode_build,kw", [
+    (hybrid_2d.build, {}),
+    (hybrid_3d.build, {"tp": 2}),
+    (hybrid_3d_moe.build, {"num_expert_shards": 2}),
+])
+def test_1f1b_schedule_runs(eight_devices, mode_build, kw):
+    """1F1B (rebuild extra — the reference only has GPipe) must run end to
+    end with the same microbatch totals and tag the record."""
+    model = ("mixtral_8x7b" if mode_build is hybrid_3d_moe.build
+             else "llama3_8b")
+    stats = _stats(f"{model}_16_bfloat16")
+    card = load_model_card(model)
+    bundle = mode_build(stats, card, CFG, num_stages=2, num_microbatches=4,
+                        schedule="1f1b", **kw)
+    assert bundle.global_meta["schedule"] == "1f1b"
+    res = run_proxy(bundle.global_meta["proxy"], bundle, CFG)
+    assert len(res.timers_us["runtimes"]) == CFG.runs
+    assert all(t > 0 for t in res.timers_us["runtimes"])
+    assert "pp_comm_time" in res.timers_us
+
+
+def test_unknown_schedule_rejected(eight_devices):
+    stats = _stats("llama3_8b_16_bfloat16")
+    card = load_model_card("llama3_8b")
+    with pytest.raises(ValueError, match="schedule"):
+        hybrid_2d.build(stats, card, CFG, num_stages=2, num_microbatches=4,
+                        schedule="zb")
