@@ -94,7 +94,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         ex_elems = scaled_elems(moe.expert_sync_elems, cfg.size_scale)
 
     act = sharded_zeros(mesh, P(), (pipe_elems,), dtype)
-    act2 = sharded_zeros(mesh, P(), (pipe_elems,), dtype)  # 1f1b down-hop
+    # second carry only exists for 1f1b's independent down-hop; gpipe runs
+    # feed a 1-element dummy (like ne_in/ex_in) and never touch it
+    act2 = sharded_zeros(mesh, P(), (pipe_elems,), dtype) \
+        if schedule == "1f1b" else None
     grad_shard = sharded_zeros(mesh, P(), (dp_elems,), dtype)
     tp_buf = sharded_zeros(mesh, P(), (max(tp_elems, 1),), dtype)
     a2a_buf = sharded_zeros(mesh, P(), (max(a2a_elems, num_expert_shards),),
@@ -158,10 +161,30 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
             for _ in range(num_microbatches):
                 state, cur = bwd_tick(state, cur)
         else:  # 1f1b: warmup fwd, steady interleave, cooldown bwd
+            # Unlike the GPipe ticks (blocking send: inner comms tie on the
+            # hop, matching the reference's serial recv/compute/send +
+            # allreduce order), every 1f1b hop is async (native tier:
+            # slot-indexed Isend) — inner comms depend only on the burn,
+            # and the next tick ties on the hop landing.
             warm = min(num_stages - 1, num_microbatches)
             cur_b = act2_b
+
+            def fwd_tick_async(state, cur):
+                state = burn_(state, fwd_iters)
+                if with_comm:
+                    cur = col.shift_up(col.tie(cur, state), AXIS_PP)
+                outs.extend(inner_comms(state, bufs, with_comm))
+                return col.tie(state, cur), cur
+
+            def bwd_tick_async(state, cur):
+                state = burn_(state, bwd_iters)
+                if with_comm:
+                    cur = col.shift_down(col.tie(cur, state), AXIS_PP)
+                outs.extend(inner_comms(state, bufs, with_comm))
+                return col.tie(state, cur), cur
+
             for _ in range(warm):
-                state, cur = fwd_tick(state, cur)
+                state, cur = fwd_tick_async(state, cur)
             for _ in range(num_microbatches - warm):
                 # steady pair: the up-hop of microbatch i and the down-hop
                 # of microbatch i-(pp-1) are issued on INDEPENDENT carries
@@ -180,7 +203,7 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                 cur, cur_b = up, down
                 state = col.tie(col.tie(state, cur), cur_b)
             for _ in range(warm):
-                state, cur_b = bwd_tick(state, cur_b)
+                state, cur_b = bwd_tick_async(state, cur_b)
             outs.append(cur_b)
         # phase 3: gradient sync
         if with_comm:
@@ -196,6 +219,7 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
     zero = jnp.zeros((1,), dtype)
     ne_in = ne_buf if ne_buf is not None else zero
     ex_in = ex_buf if ex_buf is not None else zero
+    act2_in = act2 if act2 is not None else zero
 
     def make(with_compute, with_comm):
         fn = shard_map(
@@ -204,7 +228,7 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
             mesh=mesh, in_specs=tuple(P() for _ in range(8)),
             out_specs=P(), check_vma=False)
         jitted = jax.jit(fn)
-        return lambda: jitted(state0, act, act2, grad_shard, tp_buf,
+        return lambda: jitted(state0, act, act2_in, grad_shard, tp_buf,
                               a2a_buf, ne_in, ex_in)
 
     # per-collective comm-only variants
@@ -237,7 +261,7 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                 outs.append(a2)
         return col.fence(*outs)
 
-    pp_bufs = (act,) if schedule == "gpipe" else (act, act2)
+    pp_bufs = (act,) if schedule == "gpipe" else (act, act2_in)
     variants = {"pp_comm": make_var(pp_body, *pp_bufs)}
     if mode == "moe":
         def ep_body(a):

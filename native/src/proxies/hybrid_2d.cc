@@ -9,6 +9,7 @@ int main(int argc, char** argv) {
   args.required_int("num_stages", "pipeline stages")
       .required_int("num_microbatches", "microbatches per iteration")
       .optional_int("dp", 0, "data-parallel degree (0 = infer from world)");
+  add_schedule_arg(args);
   args.parse(argc, argv);
 
   try {
@@ -20,6 +21,7 @@ int main(int argc, char** argv) {
 
     HybridSpec spec;
     spec.pipe = pipeline_schedule(env.stats, card, stages, mbs, dp, 1);
+    set_schedule(spec, args);
 
     Json meta = Json::object();
     meta["proxy"] = "hybrid_2d";

@@ -13,6 +13,7 @@ int main(int argc, char** argv) {
       .required_int("num_microbatches", "microbatches per iteration")
       .required_int("tp", "tensor-parallel degree")
       .optional_int("dp", 0, "data-parallel degree (0 = infer from world)");
+  add_schedule_arg(args);
   args.parse(argc, argv);
 
   try {
@@ -26,6 +27,7 @@ int main(int argc, char** argv) {
 
     HybridSpec spec;
     spec.pipe = pipeline_schedule(env.stats, card, stages, mbs, dp, tp);
+    set_schedule(spec, args);
 
     Json meta = Json::object();
     meta["proxy"] = "hybrid_3d";

@@ -16,6 +16,7 @@ int main(int argc, char** argv) {
       .required_int("num_microbatches", "microbatches per iteration")
       .required_int("num_expert_shards", "expert-parallel degree")
       .optional_int("dp", 0, "data-parallel degree (0 = infer from world)");
+  add_schedule_arg(args);
   args.parse(argc, argv);
 
   try {
@@ -34,6 +35,7 @@ int main(int argc, char** argv) {
     MoESchedule moe = moe_schedule(env.stats, card, stages, mbs, ep, dp);
     HybridSpec spec;
     spec.pipe = moe.pipe;
+    set_schedule(spec, args);
     spec.is_moe = true;
     spec.ep = ep;
     spec.a2a_elems = moe.a2a_elems;

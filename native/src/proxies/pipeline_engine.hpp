@@ -26,6 +26,10 @@ namespace dlnb {
 
 struct HybridSpec {
   PipelineSchedule pipe;
+  // "gpipe" (reference parity) or "1f1b" (rebuild extra: per-stage warmup
+  // of S-1-stage forwards, steady fwd/bwd pairs with slot-indexed Isend so
+  // opposite-direction hops are in flight together, backward cooldown)
+  std::string schedule = "gpipe";
   // MoE extras (zero/unused unless is_moe)
   bool is_moe = false;
   i64 ep = 1;
@@ -41,6 +45,7 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
   const auto& p = spec.pipe;
   meta["num_stages"] = p.grid.pp;
   meta["num_microbatches"] = p.num_microbatches;
+  meta["schedule"] = spec.schedule;
   meta["dp"] = p.grid.dp;
   meta["layers_per_stage"] = p.layers_per_stage;
   meta["pipe_msg_bytes"] = static_cast<i64>(
@@ -132,57 +137,89 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
     }
   };
 
-  run = run_measured(env.cfg, *world, ts, [&](TimerSet& t) {
-    // ---- phase 1: all microbatches forward (hybrid_2d.cpp:106-133) ----
-    for (int mb = 0; mb < M; ++mb) {
-      if (S == 1) {
-        burn_us(p.fwd_us_per_stage_mb, env.cfg.time_scale);
-      } else if (first) {
-        burn_us(p.fwd_us_per_stage_mb, env.cfg.time_scale);
-        auto sc = t.scoped("pp_comm");
-        pp_comm->Send(act_out.data(), pipe_elems, stage + 1);
-      } else if (last) {
-        {
-          auto sc = t.scoped("pp_comm");
-          pp_comm->Recv(act_in.data(), pipe_elems, stage - 1);
-        }
-        burn_us(p.fwd_us_per_stage_mb, env.cfg.time_scale);
-      } else {
-        {
-          auto sc = t.scoped("pp_comm");
-          pp_comm->Recv(act_in.data(), pipe_elems, stage - 1);
-        }
-        burn_us(p.fwd_us_per_stage_mb, env.cfg.time_scale);
-        auto sc = t.scoped("pp_comm");
-        pp_comm->Send(act_out.data(), pipe_elems, stage + 1);
-      }
-      axis_traffic(t);
+  // 1f1b uses slot-indexed Isend (slot 0 = up, slot 1 = down) so the two
+  // directions can be in flight together; the slot is drained (untimed)
+  // right before reuse, and each direction has its own out buffer
+  // (allocated only when 1f1b actually runs).
+  Tensor act_out2(spec.schedule == "1f1b" ? pipe_elems : 0, env.dtype);
+  bool up_pending = false, down_pending = false;
+
+  auto fwd_mb = [&](TimerSet& t) {
+    if (S == 1) {
+      burn_us(p.fwd_us_per_stage_mb, env.cfg.time_scale);
+      return;
     }
-    // ---- phase 2: all microbatches backward, mirrored
-    //      (hybrid_2d.cpp:135-161) ----
-    for (int mb = 0; mb < M; ++mb) {
-      if (S == 1) {
-        burn_us(p.bwd_us_per_stage_mb, env.cfg.time_scale);
-      } else if (last) {
-        burn_us(p.bwd_us_per_stage_mb, env.cfg.time_scale);
+    if (!first) {
+      auto sc = t.scoped("pp_comm");
+      pp_comm->Recv(act_in.data(), pipe_elems, stage - 1);
+    }
+    burn_us(p.fwd_us_per_stage_mb, env.cfg.time_scale);
+    if (!last) {
+      if (spec.schedule == "gpipe") {
         auto sc = t.scoped("pp_comm");
-        pp_comm->Send(act_out.data(), pipe_elems, stage - 1);
-      } else if (first) {
-        {
-          auto sc = t.scoped("pp_comm");
-          pp_comm->Recv(act_in.data(), pipe_elems, stage + 1);
-        }
-        burn_us(p.bwd_us_per_stage_mb, env.cfg.time_scale);
+        pp_comm->Send(act_out.data(), pipe_elems, stage + 1);
       } else {
-        {
-          auto sc = t.scoped("pp_comm");
-          pp_comm->Recv(act_in.data(), pipe_elems, stage + 1);
-        }
-        burn_us(p.bwd_us_per_stage_mb, env.cfg.time_scale);
+        if (up_pending) pp_comm->Wait(0);
+        auto sc = t.scoped("pp_comm");
+        pp_comm->Isend(act_out.data(), pipe_elems, stage + 1, 0, /*tag=*/0);
+        up_pending = true;
+      }
+    }
+  };
+  auto bwd_mb = [&](TimerSet& t) {
+    if (S == 1) {
+      burn_us(p.bwd_us_per_stage_mb, env.cfg.time_scale);
+      return;
+    }
+    if (!last) {
+      auto sc = t.scoped("pp_comm");
+      pp_comm->Recv(act_in.data(), pipe_elems, stage + 1);
+    }
+    burn_us(p.bwd_us_per_stage_mb, env.cfg.time_scale);
+    if (!first) {
+      if (spec.schedule == "gpipe") {
         auto sc = t.scoped("pp_comm");
         pp_comm->Send(act_out.data(), pipe_elems, stage - 1);
+      } else {
+        if (down_pending) pp_comm->Wait(1);
+        auto sc = t.scoped("pp_comm");
+        pp_comm->Isend(act_out2.data(), pipe_elems, stage - 1, 1, /*tag=*/0);
+        down_pending = true;
       }
-      axis_traffic(t);
+    }
+  };
+
+  run = run_measured(env.cfg, *world, ts, [&](TimerSet& t) {
+    if (spec.schedule == "gpipe") {
+      // ---- phase 1: all microbatches forward (hybrid_2d.cpp:106-133),
+      //      phase 2: all backward, mirrored (hybrid_2d.cpp:135-161) ----
+      for (int mb = 0; mb < M; ++mb) {
+        fwd_mb(t);
+        axis_traffic(t);
+      }
+      for (int mb = 0; mb < M; ++mb) {
+        bwd_mb(t);
+        axis_traffic(t);
+      }
+    } else {
+      // ---- 1f1b: per-stage warmup, steady pairs, cooldown ----
+      const int warm = std::min(S - 1 - stage, M);
+      for (int i = 0; i < warm; ++i) {
+        fwd_mb(t);
+        axis_traffic(t);
+      }
+      for (int i = 0; i < M - warm; ++i) {
+        fwd_mb(t);
+        axis_traffic(t);
+        bwd_mb(t);
+        axis_traffic(t);
+      }
+      for (int i = 0; i < warm; ++i) {
+        bwd_mb(t);
+        axis_traffic(t);
+      }
+      if (up_pending) { pp_comm->Wait(0); up_pending = false; }
+      if (down_pending) { pp_comm->Wait(1); down_pending = false; }
     }
     // ---- phase 3: gradient sync ----
     if (spec.is_moe) {
@@ -215,6 +252,19 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
   extra["dp_id"] = c.dp_id;
   if (has_axis) extra[spec.is_moe ? "ep_id" : "tp_id"] = c.tp_id;
   return extra;
+}
+
+// Shared --schedule flag registration + validated assignment (keeps the
+// three proxy mains in lockstep).
+inline void add_schedule_arg(Args& args) {
+  args.optional_str("schedule", "gpipe",
+                    "pipeline schedule: gpipe (reference parity) or 1f1b");
+}
+
+inline void set_schedule(HybridSpec& spec, const Args& args) {
+  spec.schedule = args.str("schedule");
+  if (spec.schedule != "gpipe" && spec.schedule != "1f1b")
+    throw std::runtime_error("unknown schedule: " + spec.schedule);
 }
 
 // Infer dp from world when not given (matches the Python tier's _infer_dp).

@@ -153,3 +153,20 @@ def test_loop_mode_runs_forever(native_bin):
            "--base_path", str(REPO)]
     with pytest.raises(subprocess.TimeoutExpired):
         subprocess.run(cmd, capture_output=True, timeout=3)
+
+
+def test_native_1f1b_schedule(native_bin):
+    """1F1B (slot-indexed Isend, per-stage warmup) emits a valid record
+    with the schedule tagged and the same pp entry totals as GPipe."""
+    from dlnetbench_tpu.metrics.parser import validate_record
+
+    recs = {}
+    for sch in ("gpipe", "1f1b"):
+        rec = run_proxy(native_bin, "hybrid_2d", "--num_stages", 4,
+                        "--num_microbatches", 8, "--schedule", sch,
+                        model="llama3_8b_16_bfloat16", world=8)
+        validate_record(rec)
+        assert rec["global"]["schedule"] == sch
+        recs[sch] = rec
+    for a, b in zip(recs["gpipe"]["ranks"], recs["1f1b"]["ranks"]):
+        assert len(a["pp_comm"]) == len(b["pp_comm"])  # same hop totals
