@@ -42,6 +42,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="force a jax platform (e.g. 'cpu'); combine with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N "
                         "for a virtual N-device mesh on a dev box")
+    p.add_argument("--buffer_dtype", default="float32",
+                   choices=["float32", "bfloat16", "float8", "stats"],
+                   help="device-buffer element type; 'stats' follows the "
+                        "stat file's Dtype field (the reference's "
+                        "compile-time PROXY_FLOAT8 / bf16 selection, "
+                        "data_types.hpp:36-79, made a runtime switch). "
+                        "float32 default keeps CPU-mesh runs universal")
     p.add_argument("--size_scale", type=float, default=1.0)
     p.add_argument("--time_scale", type=float, default=1.0)
     p.add_argument("--stats_dir", default=None)
@@ -159,12 +166,23 @@ def main(argv: list[str] | None = None) -> int:
         from dlnetbench_tpu.utils.topology import print_topology
         print_topology(devices, stream=sys.stderr)
 
+    import jax.numpy as jnp
+    dtype_name = stats.dtype if args.buffer_dtype == "stats" \
+        else args.buffer_dtype
+    jnp_dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                  "float8": jnp.float8_e4m3fn}
+    if dtype_name not in jnp_dtypes:
+        parser.error(f"stat file dtype {dtype_name!r} has no device buffer "
+                     f"mapping; supported: {sorted(jnp_dtypes)}")
+    dtype = jnp_dtypes[dtype_name]
+
     try:
-        bundle = _build_bundle(args, parser, stats, cfg, devices)
+        bundle = _build_bundle(args, parser, stats, cfg, devices, dtype)
     except ImportError as e:
         parser.error(f"proxy {args.proxy!r} is not implemented yet ({e})")
     except ValueError as e:
         parser.error(str(e))  # configuration-invariant violations
+    bundle.global_meta["buffer_dtype"] = dtype_name
     if variables:
         bundle.global_meta["variables"] = variables
     result = run_proxy(args.proxy, bundle, cfg)
@@ -175,33 +193,36 @@ def main(argv: list[str] | None = None) -> int:
     return 0
 
 
-def _build_bundle(args, parser, stats, cfg, devices):
+def _build_bundle(args, parser, stats, cfg, devices, dtype):
+    kw = {"dtype": dtype}
     if args.proxy == "dp":
         from dlnetbench_tpu.parallel.mesh import make_flat_mesh
         from dlnetbench_tpu.proxies import dp as proxy_mod
         mesh = make_flat_mesh(devices=devices)
-        return proxy_mod.build(stats, args.num_buckets, cfg, mesh=mesh)
+        return proxy_mod.build(stats, args.num_buckets, cfg, mesh=mesh, **kw)
     else:
         card = load_model_card(arch_name_from_stats_name(args.model))
         if args.proxy == "fsdp":
             from dlnetbench_tpu.proxies import fsdp as proxy_mod
             bundle = proxy_mod.build(stats, args.num_units, cfg,
                                      devices=devices,
-                                     sharding_factor=args.sharding_factor or None)
+                                     sharding_factor=args.sharding_factor or None,
+                                     **kw)
         elif args.proxy == "hybrid_2d":
             from dlnetbench_tpu.proxies import hybrid_2d as proxy_mod
             bundle = proxy_mod.build(stats, card, cfg,
                                      num_stages=args.num_stages,
                                      num_microbatches=args.num_microbatches,
                                      schedule=args.schedule,
-                                     dp=args.dp, devices=devices)
+                                     dp=args.dp, devices=devices, **kw)
         elif args.proxy == "hybrid_3d":
             from dlnetbench_tpu.proxies import hybrid_3d as proxy_mod
             bundle = proxy_mod.build(stats, card, cfg,
                                      num_stages=args.num_stages,
                                      num_microbatches=args.num_microbatches,
                                      schedule=args.schedule,
-                                     tp=args.tp, dp=args.dp, devices=devices)
+                                     tp=args.tp, dp=args.dp, devices=devices,
+                                     **kw)
         elif args.proxy == "hybrid_3d_moe":
             from dlnetbench_tpu.proxies import hybrid_3d_moe as proxy_mod
             bundle = proxy_mod.build(stats, card, cfg,
@@ -209,15 +230,15 @@ def _build_bundle(args, parser, stats, cfg, devices):
                                      num_microbatches=args.num_microbatches,
                                      schedule=args.schedule,
                                      num_expert_shards=args.num_expert_shards,
-                                     dp=args.dp, devices=devices)
+                                     dp=args.dp, devices=devices, **kw)
         elif args.proxy == "ring_attention":
             from dlnetbench_tpu.proxies import ring_attention as proxy_mod
             bundle = proxy_mod.build(stats, card, cfg, sp=args.sp,
-                                     dp=args.dp, devices=devices)
+                                     dp=args.dp, devices=devices, **kw)
         elif args.proxy == "ulysses":
             from dlnetbench_tpu.proxies import ulysses as proxy_mod
             bundle = proxy_mod.build(stats, card, cfg, sp=args.sp,
-                                     dp=args.dp, devices=devices)
+                                     dp=args.dp, devices=devices, **kw)
         else:  # pragma: no cover
             parser.error(f"unknown proxy {args.proxy}")
         return bundle

@@ -102,3 +102,25 @@ def test_cli_dp(tmp_path, eight_devices, capsys):
     rec = json.loads(out.read_text().strip())
     assert rec["section"] == "dp" and rec["global"]["world_size"] == 2
     assert len(rec["ranks"][0]["runtimes"]) == 2
+
+
+def test_cli_buffer_dtype_stats(eight_devices, tmp_path):
+    """--buffer_dtype stats follows the stat file's Dtype (the reference's
+    compile-time bf16/fp8 selection as a runtime switch): bfloat16 buffers
+    halve the reported bucket bytes vs float32."""
+    import json
+    from dlnetbench_tpu.cli import main
+
+    recs = {}
+    for bd in ("float32", "stats"):
+        out = tmp_path / f"{bd}.jsonl"
+        rc = main(["dp", "--model", "gpt2_l_16_bfloat16", "--num_buckets",
+                   "2", "--platform", "cpu", "-r", "1", "-w", "1",
+                   "--size_scale", "1e-5", "--time_scale", "1e-4",
+                   "--no_topology", "--buffer_dtype", bd,
+                   "--out", str(out)])
+        assert rc == 0
+        recs[bd] = json.loads(out.read_text().strip())
+    f32 = recs["float32"]["global"]["bucket_bytes"]
+    bf16 = recs["stats"]["global"]["bucket_bytes"]  # stat file is bfloat16
+    assert [b // 2 for b in f32] == list(bf16)
