@@ -1,0 +1,82 @@
+"""Effective collective bandwidth — the north-star report metric.
+
+SURVEY.md §7.2 step 7: validation reports "effective bus GB/s + iter time
+per collective".  Every proxy declares, in its record's
+``global.comm_model``, exactly how many bytes each timed region moves per
+iteration (one or more components of {kind, bytes, group}); this module
+turns that plus the per-rank timer arrays into the standard nccl-tests
+figures:
+
+    algbw = bytes_per_iteration / time
+    busbw = sum_i bytes_i * factor(kind_i, group_i) / time
+
+with the usual correction factors — allreduce 2(n-1)/n, allgather /
+reduce-scatter / all-to-all (n-1)/n, p2p 1 — so numbers are comparable
+across world sizes and against link speed.  Declaring the totals at the
+proxy (which knows its schedule: 2m pipe hops, 4m TP allreduces, 2U-1
+unit gathers, ...) keeps multi-op timers honest; nothing here guesses op
+counts from column names.
+"""
+from __future__ import annotations
+
+
+def bus_factor(kind: str, n: int) -> float:
+    n = max(int(n), 1)
+    if kind == "allreduce":
+        return 2 * (n - 1) / n
+    if kind in ("allgather", "reduce_scatter", "alltoall"):
+        return (n - 1) / n
+    if kind == "p2p":
+        return 1.0
+    raise ValueError(f"unknown collective kind {kind!r}")
+
+
+def effective_bandwidth(records: list[dict]):
+    """JSON run records (metrics/emit.py schema) -> one row per
+    (section, model, rank, run, timer) with time_us, msg_bytes,
+    algbw_gbps, busbw_gbps.  Records without a ``comm_model`` (or timers
+    that never ran / zero times) contribute nothing."""
+    import pandas as pd
+
+    rows = []
+    for rec in records:
+        g = rec.get("global", {})
+        model = g.get("comm_model")
+        if not model:
+            continue
+        for rank_row in rec.get("ranks", []):
+            for timer, components in model.items():
+                times = rank_row.get(timer)
+                if not times:
+                    continue
+                total = sum(c["bytes"] for c in components)
+                bus_total = sum(c["bytes"] * bus_factor(c["kind"],
+                                                        c["group"])
+                                for c in components)
+                for run, t_us in enumerate(times):
+                    if not t_us > 0:
+                        continue
+                    rows.append({
+                        "section": rec.get("section"),
+                        "model": g.get("model"),
+                        "rank": rank_row.get("rank"),
+                        "run": run,
+                        "collective": timer.removesuffix("_time"),
+                        "group_size": max(int(c["group"])
+                                          for c in components),
+                        "msg_bytes": float(total),
+                        "time_us": float(t_us),
+                        "algbw_gbps": total / (t_us * 1e-6) / 1e9,
+                        "busbw_gbps": bus_total / (t_us * 1e-6) / 1e9,
+                    })
+    return pd.DataFrame(rows)
+
+
+def bandwidth_summary(records: list[dict]):
+    """Mean per (section, model, collective): the north-star table."""
+    bw = effective_bandwidth(records)
+    if bw.empty:
+        return bw
+    return (bw.groupby(["section", "model", "collective", "group_size"])
+            [["time_us", "msg_bytes", "algbw_gbps", "busbw_gbps"]]
+            .mean().reset_index())

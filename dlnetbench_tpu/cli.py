@@ -126,14 +126,22 @@ def main(argv: list[str] | None = None) -> int:
     _add_common(p_ring)
     p_ring.add_argument("--sp", type=int, required=True)
     p_ring.add_argument("--dp", type=int, default=0)
+    p_ring.add_argument("--max_layers", type=int, default=0,
+                        help="cap replayed layers (0 = the model's full "
+                             "depth); shortens dev-box runs")
 
     p_uly = sub.add_parser("ulysses", help="Ulysses sequence-parallel proxy")
     _add_common(p_uly)
     p_uly.add_argument("--sp", type=int, required=True)
     p_uly.add_argument("--dp", type=int, default=0)
+    p_uly.add_argument("--max_layers", type=int, default=0,
+                       help="cap replayed layers (0 = full depth)")
 
     args = parser.parse_args(argv)
     cfg = _cfg(args)
+
+    if getattr(args, "max_layers", 0) < 0:
+        parser.error("--max_layers must be >= 0")
 
     # validate tags before any expensive backend/bundle work
     variables = {}
@@ -234,11 +242,15 @@ def _build_bundle(args, parser, stats, cfg, devices, dtype):
         elif args.proxy == "ring_attention":
             from dlnetbench_tpu.proxies import ring_attention as proxy_mod
             bundle = proxy_mod.build(stats, card, cfg, sp=args.sp,
-                                     dp=args.dp, devices=devices, **kw)
+                                     dp=args.dp, devices=devices,
+                                     max_layers=args.max_layers or None,
+                                     **kw)
         elif args.proxy == "ulysses":
             from dlnetbench_tpu.proxies import ulysses as proxy_mod
             bundle = proxy_mod.build(stats, card, cfg, sp=args.sp,
-                                     dp=args.dp, devices=devices, **kw)
+                                     dp=args.dp, devices=devices,
+                                     max_layers=args.max_layers or None,
+                                     **kw)
         else:  # pragma: no cover
             parser.error(f"unknown proxy {args.proxy}")
         return bundle

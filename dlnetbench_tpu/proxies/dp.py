@@ -73,17 +73,22 @@ def build(stats: ModelStats, num_buckets: int, cfg: ProxyConfig,
         jitted = jax.jit(fn)
         return lambda: jitted(state0, tuple(grads))
 
+    bucket_bytes = [int(e * jnp.dtype(dtype).itemsize)
+                    for e in bucket_elems]
     meta = {
         "proxy": "dp",
         "model": stats.name,
         "world_size": world,
         "num_buckets": num_buckets,
-        "bucket_bytes": [int(e * jnp.dtype(dtype).itemsize)
-                         for e in bucket_elems],
+        "bucket_bytes": bucket_bytes,
         "schedule_bucket_bytes": sched.bucket_bytes,
         "fwd_us": sched.fwd_us * cfg.time_scale,
         "bwd_us_per_bucket": sched.bwd_us_per_bucket * cfg.time_scale,
         "burn_ns_per_iter": cal.ns_per_iter,
+        # bytes each timed region moves per iteration (analysis/bandwidth.py)
+        "comm_model": {"barrier_time": [
+            {"kind": "allreduce", "group": world,
+             "bytes": sum(bucket_bytes)}]},
         "mesh": describe_mesh(mesh),
         "size_scale": cfg.size_scale,
         "time_scale": cfg.time_scale,

@@ -156,6 +156,26 @@ def build(stats: ModelStats, num_units: int, cfg: ProxyConfig,
         "fwd_us_per_unit": sched.fwd_us_per_unit * cfg.time_scale,
         "bwd_us_per_unit": sched.bwd_us_per_unit * cfg.time_scale,
         "burn_ns_per_iter": cal.ns_per_iter,
+        # bytes per iteration per timed region (analysis/bandwidth.py):
+        # allgather = (N fwd + N-1 bwd prefetch) gathers of a full unit;
+        # reduce_scatter = N scatters (+ N cross-replica allreduces of the
+        # shard when hybrid-sharded)
+        "comm_model": {
+            "allgather_time": [
+                {"kind": "allgather", "group": sched.sharding_factor,
+                 "bytes": int((2 * num_units - 1) * shard_elems
+                              * sched.sharding_factor
+                              * jnp.dtype(dtype).itemsize)}],
+            "reduce_scatter_time": [
+                {"kind": "reduce_scatter", "group": sched.sharding_factor,
+                 "bytes": int(num_units * shard_elems
+                              * sched.sharding_factor
+                              * jnp.dtype(dtype).itemsize)}] + (
+                [{"kind": "allreduce", "group": sched.num_replicas,
+                  "bytes": int(num_units * shard_elems
+                               * jnp.dtype(dtype).itemsize)}]
+                if has_replicas else []),
+        },
         "mesh": describe_mesh(mesh),
         "size_scale": cfg.size_scale,
         "time_scale": cfg.time_scale,

@@ -110,6 +110,11 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                            burnlib.DEFAULT_DTYPE) + burnlib.make_state()
 
     a2a_count = moe.a2a_per_direction if moe is not None else 0
+    # per-iteration collective counts — shared by the schedule bodies, the
+    # comm-only variants AND the comm_model declaration (drift-proof)
+    pp_hops = 2 * num_microbatches
+    tp_allreduces = 2 * 2 * num_microbatches       # 2/dir/mb (Megatron)
+    ep_alltoalls = 2 * num_microbatches * a2a_count
 
     def inner_comms(state, bufs, with_comm):
         """Per-microbatch TP allreduces or MoE A2As, after the p2p hop."""
@@ -267,7 +272,7 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         def ep_body(a):
             a = a.reshape(num_expert_shards, -1)
             outs = []
-            for _ in range(2 * num_microbatches * a2a_count):
+            for _ in range(ep_alltoalls):
                 a = col.alltoall(a, AXIS_TP)
                 outs.append(a)
             return col.fence(*outs)
@@ -286,7 +291,7 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         if mode == "3d":
             def tp_body(t):
                 outs = []
-                for _ in range(2 * 2 * num_microbatches):
+                for _ in range(tp_allreduces):
                     t = col.allreduce(t, AXIS_TP)
                     outs.append(t)
                 return col.fence(*outs)
@@ -313,6 +318,27 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         "fwd_us_per_stage_mb": sched.fwd_us_per_stage_mb * cfg.time_scale,
         "bwd_us_per_stage_mb": sched.bwd_us_per_stage_mb * cfg.time_scale,
         "burn_ns_per_iter": cal.ns_per_iter,
+        # bytes each timed region moves per iteration (analysis/bandwidth.py)
+        "comm_model": {
+            "pp_comm_time": [{"kind": "p2p", "group": num_stages,
+                              "bytes": int(pp_hops * pipe_elems * itemsize)}],
+            **({"ep_comm_time": [{"kind": "alltoall",
+                                  "group": num_expert_shards,
+                                  "bytes": int(ep_alltoalls * a2a_elems
+                                               * itemsize)}],
+                "dp_ep_comm_time": [
+                    {"kind": "allreduce", "group": num_expert_shards,
+                     "bytes": int(ne_elems * itemsize)},
+                    {"kind": "allreduce", "group": dp,
+                     "bytes": int(ex_elems * itemsize)}]}
+               if mode == "moe" else
+               {"dp_comm_time": [{"kind": "allreduce", "group": dp,
+                                  "bytes": int(dp_elems * itemsize)}],
+                **({"tp_comm_time": [
+                    {"kind": "allreduce", "group": tp,
+                     "bytes": int(tp_allreduces * tp_elems * itemsize)}]}
+                   if mode == "3d" else {})}),
+        },
         "mesh": describe_mesh(mesh),
         "size_scale": cfg.size_scale,
         "time_scale": cfg.time_scale,

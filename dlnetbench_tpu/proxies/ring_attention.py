@@ -91,10 +91,12 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         jitted = jax.jit(fn)
         return lambda: jitted(state0, kv, grads)
 
+    # one ring pass per layer fwd + one bwd (bwd doubles compute, not
+    # hops); shared by ring_body and the comm_model declaration
+    ring_shifts = layers * 2 * (sp - 1)
+
     def ring_body(kv_b):
-        # one ring pass per layer forward + one backward (backward doubles
-        # compute, not hops) = 2 * layers * (sp-1) shifts, matching step()
-        for _ in range(layers * 2 * (sp - 1)):
+        for _ in range(ring_shifts):
             kv_b = col.ring_shift(kv_b, AXIS_SP)
         return kv_b
 
@@ -114,6 +116,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         "ring_hops_per_layer": sp - 1,
         "attn_us_per_block": sched.attn_us_per_block * cfg.time_scale,
         "burn_ns_per_iter": cal.ns_per_iter,
+        "comm_model": {"ring_comm_time": [
+            {"kind": "p2p", "group": sp,
+             "bytes": int(ring_shifts * kv_elems
+                          * jnp.dtype(dtype).itemsize)}]},
         "mesh": describe_mesh(mesh),
         "size_scale": cfg.size_scale,
         "time_scale": cfg.time_scale,

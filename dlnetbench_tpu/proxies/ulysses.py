@@ -92,8 +92,11 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         jitted = jax.jit(fn)
         return lambda: jitted(state0, acts, grads)
 
+    a2a_total = layers * 4  # 2 per layer fwd + 2 per layer bwd; shared
+                            # by a2a_body and the comm_model declaration
+
     def a2a_body(a):
-        for _ in range(layers * 4):  # 2 fwd + 2 bwd per layer
+        for _ in range(a2a_total):
             a = col.alltoall(a.reshape(sp, -1), AXIS_SP).reshape(-1)
         return a
 
@@ -111,6 +114,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         "schedule_a2a_bytes": int(sched.a2a_elems * stats.bytes_per_element),
         "a2a_per_layer": 4,
         "burn_ns_per_iter": cal.ns_per_iter,
+        "comm_model": {"a2a_comm_time": [
+            {"kind": "alltoall", "group": sp,
+             "bytes": int(a2a_total * a2a_elems
+                          * jnp.dtype(dtype).itemsize)}]},
         "mesh": describe_mesh(mesh),
         "size_scale": cfg.size_scale,
         "time_scale": cfg.time_scale,

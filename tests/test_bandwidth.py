@@ -1,0 +1,98 @@
+"""Effective-bandwidth derivation (analysis/bandwidth.py) from the
+per-proxy comm_model declarations."""
+from __future__ import annotations
+
+import pytest
+
+from dlnetbench_tpu.analysis.bandwidth import (
+    bandwidth_summary,
+    bus_factor,
+    effective_bandwidth,
+)
+
+
+def _record(comm_model, timers):
+    return {"section": "dp", "global": {"model": "m",
+                                        "comm_model": comm_model},
+            "ranks": [{"rank": 0, **timers}]}
+
+
+def test_bus_factors():
+    assert bus_factor("allreduce", 8) == pytest.approx(2 * 7 / 8)
+    assert bus_factor("allgather", 4) == pytest.approx(3 / 4)
+    assert bus_factor("alltoall", 4) == pytest.approx(3 / 4)
+    assert bus_factor("p2p", 16) == 1.0
+    with pytest.raises(ValueError):
+        bus_factor("broadcast", 4)
+
+
+def test_single_component_allreduce():
+    rec = _record({"barrier_time": [
+        {"kind": "allreduce", "group": 8, "bytes": 2000}]},
+        {"barrier_time": [2.0, 4.0]})
+    bw = effective_bandwidth([rec])
+    assert len(bw) == 2
+    r0 = bw.iloc[0]
+    # 2000 B in 2 us = 1 GB/s algbw; busbw scales by 2*(8-1)/8
+    assert r0["algbw_gbps"] == pytest.approx(1.0)
+    assert r0["busbw_gbps"] == pytest.approx(2 * 7 / 8)
+    summary = bandwidth_summary([rec])
+    assert summary.iloc[0]["time_us"] == pytest.approx(3.0)
+
+
+def test_multi_component_two_level_sync():
+    """MoE's dp_ep timer: allreduce over ep plus allreduce over dp —
+    busbw weights each component by its own group factor."""
+    rec = _record({"dp_ep_comm_time": [
+        {"kind": "allreduce", "group": 2, "bytes": 1000},
+        {"kind": "allreduce", "group": 4, "bytes": 3000}]},
+        {"dp_ep_comm_time": [4.0]})
+    bw = effective_bandwidth([rec])
+    r = bw.iloc[0]
+    assert r["msg_bytes"] == 4000
+    expect_bus = (1000 * (2 * 1 / 2) + 3000 * (2 * 3 / 4)) / 4e-6 / 1e9
+    assert r["busbw_gbps"] == pytest.approx(expect_bus)
+    assert r["group_size"] == 4
+
+
+def test_zero_time_and_missing_model_skipped():
+    rec = _record({"barrier_time": [
+        {"kind": "allreduce", "group": 8, "bytes": 100}]},
+        {"barrier_time": [0.0]})
+    assert effective_bandwidth([rec]).empty
+    assert effective_bandwidth([{"section": "x", "global": {},
+                                 "ranks": []}]).empty
+    assert bandwidth_summary([rec]).empty
+
+
+@pytest.mark.parametrize("argv,timers", [
+    # dp's barrier is DERIVED (t_full - t_compute) and needs messages big
+    # enough that exposed comm is nonzero at CPU-mesh speed
+    (["dp", "--num_buckets", "2", "--size_scale", "1e-3"], ["barrier"]),
+    (["fsdp", "--num_units", "4", "--sharding_factor", "4"],
+     ["allgather", "reduce_scatter"]),
+    (["hybrid_3d", "--num_stages", "2", "--num_microbatches", "2",
+      "--tp", "2"], ["pp_comm", "dp_comm", "tp_comm"]),
+    (["hybrid_3d_moe", "--num_stages", "2", "--num_microbatches", "2",
+      "--num_expert_shards", "2"], ["pp_comm", "ep_comm", "dp_ep_comm"]),
+    (["ring_attention", "--sp", "4", "--max_layers", "2"], ["ring_comm"]),
+    (["ulysses", "--sp", "4", "--max_layers", "2"], ["a2a_comm"]),
+])
+def test_real_records_all_proxies(eight_devices, tmp_path, argv, timers):
+    """Every proxy's record must yield nonzero busbw for its declared
+    collectives — the north-star table covers the whole suite."""
+    from dlnetbench_tpu.cli import main
+    from dlnetbench_tpu.metrics.parser import load_records
+    model = ("mixtral_8x7b_16_bfloat16" if argv[0] == "hybrid_3d_moe"
+             else "llama3_8b_16_bfloat16")
+    out = tmp_path / "rec.jsonl"
+    extra = [] if "--size_scale" in argv else ["--size_scale", "1e-5"]
+    rc = main(argv + extra + ["--model", model, "--platform", "cpu",
+                              "-r", "1", "-w", "1",
+                              "--time_scale", "1e-4", "--no_topology",
+                              "--out", str(out)])
+    assert rc == 0
+    summary = bandwidth_summary(load_records(out))
+    got = set(summary["collective"])
+    assert got == set(timers), (got, timers)
+    assert (summary["busbw_gbps"] > 0).all()
