@@ -7,7 +7,7 @@ iteration (one or more components of {kind, bytes, group}); this module
 turns that plus the per-rank timer arrays into the standard nccl-tests
 figures:
 
-    algbw = bytes_per_iteration / time
+    algbw = bytes_per_iteration / time          [GB/s, bytes not bits]
     busbw = sum_i bytes_i * factor(kind_i, group_i) / time
 
 with the usual correction factors — allreduce 2(n-1)/n, allgather /
@@ -34,7 +34,7 @@ def bus_factor(kind: str, n: int) -> float:
 def effective_bandwidth(records: list[dict]):
     """JSON run records (metrics/emit.py schema) -> one row per
     (section, model, rank, run, timer) with time_us, msg_bytes,
-    algbw_gbps, busbw_gbps.  Records without a ``comm_model`` (or timers
+    algbw_GBps, busbw_GBps.  Records without a ``comm_model`` (or timers
     that never ran / zero times) contribute nothing."""
     import pandas as pd
 
@@ -66,8 +66,8 @@ def effective_bandwidth(records: list[dict]):
                                           for c in components),
                         "msg_bytes": float(total),
                         "time_us": float(t_us),
-                        "algbw_gbps": total / (t_us * 1e-6) / 1e9,
-                        "busbw_gbps": bus_total / (t_us * 1e-6) / 1e9,
+                        "algbw_GBps": total / (t_us * 1e-6) / 1e9,
+                        "busbw_GBps": bus_total / (t_us * 1e-6) / 1e9,
                     })
     return pd.DataFrame(rows)
 
@@ -78,5 +78,5 @@ def bandwidth_summary(records: list[dict]):
     if bw.empty:
         return bw
     return (bw.groupby(["section", "model", "collective", "group_size"])
-            [["time_us", "msg_bytes", "algbw_gbps", "busbw_gbps"]]
+            [["time_us", "msg_bytes", "algbw_GBps", "busbw_GBps"]]
             .mean().reset_index())

@@ -85,8 +85,11 @@ def build(stats: ModelStats, num_buckets: int, cfg: ProxyConfig,
         "fwd_us": sched.fwd_us * cfg.time_scale,
         "bwd_us_per_bucket": sched.bwd_us_per_bucket * cfg.time_scale,
         "burn_ns_per_iter": cal.ns_per_iter,
-        # bytes each timed region moves per iteration (analysis/bandwidth.py)
-        "comm_model": {"barrier_time": [
+        # bytes each timed region moves per iteration
+        # (analysis/bandwidth.py).  Mapped to the comm-only variant's
+        # directly-timed program — NOT to barrier_time, which is the
+        # exposed residual (t_full - t_compute) and is not a bandwidth
+        "comm_model": {"comm_time": [
             {"kind": "allreduce", "group": world,
              "bytes": sum(bucket_bytes)}]},
         "mesh": describe_mesh(mesh),

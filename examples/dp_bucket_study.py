@@ -56,12 +56,21 @@ def main() -> int:
         return rc
 
     # 2. ingest (reference plots/parser.py:213-256 shape: rank x run rows)
-    from dlnetbench_tpu.metrics.parser import get_metrics_dataframe
-    df = get_metrics_dataframe(records, "dp")
+    from dlnetbench_tpu.metrics.parser import load_records, records_to_dataframe
+    recs = load_records(records, "dp")
+    df = records_to_dataframe(recs)
     summary = (df.groupby("num_buckets")[["runtime", "barrier_time"]]
                .mean().sort_index())
     print("\nmean per bucket count (us):")
     print(summary.to_string(float_format=lambda v: f"{v:12.1f}"))
+
+    # 2b. effective bandwidth (north-star table, analysis/bandwidth.py)
+    from dlnetbench_tpu.analysis.bandwidth import bandwidth_summary
+    bw = bandwidth_summary(recs)
+    if not bw.empty:
+        print("\neffective bandwidth (comm-only allreduce schedule):")
+        print(bw[["collective", "group_size", "time_us",
+                  "algbw_GBps", "busbw_GBps"]].to_string(index=False))
 
     # 3. plots (reference plots/plot_dp.py, plots_pareto_energy.py)
     import matplotlib
