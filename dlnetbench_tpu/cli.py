@@ -43,7 +43,8 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N "
                         "for a virtual N-device mesh on a dev box")
     p.add_argument("--buffer_dtype", default="float32",
-                   choices=["float32", "bfloat16", "float8", "stats"],
+                   choices=["float32", "bfloat16", "float8", "int8",
+                            "stats"],
                    help="device-buffer element type; 'stats' follows the "
                         "stat file's Dtype field (the reference's "
                         "compile-time PROXY_FLOAT8 / bf16 selection, "
@@ -178,7 +179,7 @@ def main(argv: list[str] | None = None) -> int:
     dtype_name = stats.dtype if args.buffer_dtype == "stats" \
         else args.buffer_dtype
     jnp_dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
-                  "float8": jnp.float8_e4m3fn}
+                  "float8": jnp.float8_e4m3fn, "int8": jnp.int8}
     if dtype_name not in jnp_dtypes:
         parser.error(f"stat file dtype {dtype_name!r} has no device buffer "
                      f"mapping; supported: {sorted(jnp_dtypes)}")

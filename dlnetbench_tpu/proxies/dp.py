@@ -87,8 +87,9 @@ def build(stats: ModelStats, num_buckets: int, cfg: ProxyConfig,
         "burn_ns_per_iter": cal.ns_per_iter,
         # bytes each timed region moves per iteration
         # (analysis/bandwidth.py).  Mapped to the comm-only variant's
-        # directly-timed program — NOT to barrier_time, which is the
-        # exposed residual (t_full - t_compute) and is not a bandwidth
+        # directly-timed program — NOT to barrier_time, whose exposed
+        # residual (t_full - t_compute) shrinks with overlap and would
+        # yield a "bandwidth" unbounded by the physical link
         "comm_model": {"comm_time": [
             {"kind": "allreduce", "group": world,
              "bytes": sum(bucket_bytes)}]},

@@ -64,12 +64,21 @@ def main() -> int:
     print("\nmean per bucket count (us):")
     print(summary.to_string(float_format=lambda v: f"{v:12.1f}"))
 
-    # 2b. effective bandwidth (north-star table, analysis/bandwidth.py)
+    # 2b. effective bandwidth (north-star table, analysis/bandwidth.py),
+    # kept per sweep point — blending bucket counts would erase the axis
+    # the study exists to compare
+    import pandas as pd
     from dlnetbench_tpu.analysis.bandwidth import bandwidth_summary
-    bw = bandwidth_summary(recs)
-    if not bw.empty:
+    per_point = []
+    for rec in recs:
+        s = bandwidth_summary([rec])
+        if not s.empty:
+            s.insert(0, "num_buckets", rec["global"].get("num_buckets"))
+            per_point.append(s)
+    if per_point:
+        bw = pd.concat(per_point).sort_values("num_buckets")
         print("\neffective bandwidth (comm-only allreduce schedule):")
-        print(bw[["collective", "group_size", "time_us",
+        print(bw[["num_buckets", "collective", "group_size", "time_us",
                   "algbw_GBps", "busbw_GBps"]].to_string(index=False))
 
     # 3. plots (reference plots/plot_dp.py, plots_pareto_energy.py)
