@@ -62,8 +62,9 @@ def main() -> int:
     jax.block_until_ready(params2)
 
     # three pipelined rounds (each fences once); median guards against a
-    # slow round from tunnel or host jitter
-    samples = [time_pipelined(train_step, params, tokens, iters=5)
+    # slow round from tunnel or host jitter.  20 iters/round amortizes the
+    # per-dispatch tunnel gap (~20 ms/step at 5 iters, ~4 ms at 20)
+    samples = [time_pipelined(train_step, params, tokens, iters=20)
                for _ in range(3)]
     step_s = statistics.median(samples)
 
