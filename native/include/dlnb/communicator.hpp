@@ -18,6 +18,7 @@
 #pragma once
 
 #include <cstdint>
+#include <cstring>
 #include <string>
 
 #include "dlnb/tensor.hpp"
@@ -70,7 +71,32 @@ class ProxyCommunicator {
   virtual void Wait(int slot) = 0;
   virtual void WaitAll(int num_slots) = 0;
 
+  // ---- ring rotation ----
+  // Every group member simultaneously sends `src` to rank (rank+shift) mod
+  // size and receives its predecessor's block into `dst` — the ppermute /
+  // collective_permute idiom (ring attention's KV rotation).  Blocking.
+  // Default: paired Isend/Irecv on slots 0 and 1 (reserved for the call's
+  // duration); device backends override with a native collective_permute.
+  virtual void RingShift(const void* src, void* dst, std::int64_t count,
+                         int shift = 1) {
+    int n = size(), me = rank();
+    if (n <= 1 || shift % n == 0) {
+      if (dst != src)
+        std::memcpy(dst, src, static_cast<std::size_t>(count) *
+                                  dtype_bytes(dtype()));
+      return;
+    }
+    int to = (me + shift % n + n) % n;
+    int from = (me - shift % n + 2 * n) % n;
+    Isend(src, count, to, 0, kRingShiftTag);
+    Irecv(dst, count, from, 1, kRingShiftTag);
+    WaitAll(2);
+  }
+
   virtual void finalize() {}
+
+ protected:
+  static constexpr int kRingShiftTag = 7001;
 };
 
 }  // namespace dlnb

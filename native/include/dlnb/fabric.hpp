@@ -1,0 +1,51 @@
+// Abstract rank fabric — backend selection point for the native tier.
+//
+// Plays the role of the reference's compile-time backend ladder (reference
+// cpp/data_parallel/dp.cpp:183-224: #ifdef NCCL / oneCCL / MPI communicator
+// construction): a Fabric owns the world, hands each rank its
+// ProxyCommunicator, and arbitrates communicator splits.  Unlike the
+// reference, the backend is a RUNTIME choice (--backend shm|pjrt), so one
+// binary serves both the in-process test fabric and the TPU runtime.
+//
+// Implementations:
+//   * ShmFabric  (shm_backend.hpp)  — threaded rank fabric, the testable
+//     fake (reference `mpi_cpu` role).
+//   * PjrtFabric (pjrt_fabric.hpp) — collectives execute as single
+//     multi-group XLA modules through a CollectiveExecutor (the PJRT
+//     plugin on real TPU devices, or a host reference executor).
+#pragma once
+
+#include <functional>
+#include <memory>
+#include <string>
+
+#include "dlnb/communicator.hpp"
+#include "dlnb/json.hpp"
+#include "dlnb/tensor.hpp"
+
+namespace dlnb {
+
+class Fabric {
+ public:
+  virtual ~Fabric() = default;
+
+  virtual int world_size() const = 0;
+  virtual DType dtype() const = 0;
+  virtual std::string backend() const = 0;  // "shm" | "pjrt"
+
+  virtual std::unique_ptr<ProxyCommunicator> world_comm(int rank) = 0;
+  // Collective split: all world ranks must call with their color
+  // (MPI_Comm_split role, key = world rank — reference comm-color math,
+  // hybrid_3d.cpp:287-300).
+  virtual std::unique_ptr<ProxyCommunicator> split(
+      int world_rank, int color, const std::string& name) = 0;
+
+  // Run body(rank) on world_size threads; rethrows the first rank failure.
+  virtual void launch(const std::function<void(int)>& body) = 0;
+
+  // Enrich the emitted record: backend/platform identity into `meta`,
+  // device fabric description (and compile-cache stats) into `mesh`.
+  virtual void describe(Json& meta, Json& mesh) const = 0;
+};
+
+}  // namespace dlnb

@@ -1,0 +1,653 @@
+// PJRT rank fabric — the native proxies' TPU-runtime backend.
+//
+// The reference wires its vendor backend into every proxy binary
+// (reference cpp/data_parallel/dp.cpp:183-189 builds a CCLCommunicator the
+// hot loop drives; cpp/proxy_classes.hpp:136-253).  This is the TPU
+// equivalent: PjrtFabric implements Fabric, PjrtCommunicator implements
+// the same slot-indexed ProxyCommunicator API, and every collective
+// executes as ONE multi-group XLA module over all devices at once.
+//
+// How the imperative API maps onto the SPMD runtime:
+//
+//   * A communicator split does not create a new execution context: the
+//     full partition of world ranks into colors becomes the module's
+//     `replica_groups` (GroupSet).  Consequence (and constraint — it is
+//     the XLA SPMD model): every world rank must reach the same
+//     collective on the same slot; all colors ride one execution.
+//     Mismatched (op, count) across ranks is detected and aborts.
+//   * Nonblocking slot ops run on per-(rank, slot) worker threads — the
+//     NCCL stream-per-request-index discipline (reference
+//     proxy_classes.hpp:143-147) — and rendezvous with the other ranks'
+//     same-slot workers; the LAST arriver executes the cached module
+//     (ExecRendezvous), so compute/comm overlap is real.
+//   * RingShift (ring attention's KV rotation) compiles to a native
+//     collective_permute with per-group rotation pairs.
+//   * Point-to-point Send/Recv stays on a host mailbox rendezvous: PJRT
+//     exposes no p2p primitive; stage-asymmetric GPipe hops on TPU belong
+//     in whole-step compiled programs (the JAX tier's masked-ppermute
+//     pipelines, SURVEY.md §7.3 hard part 3).  Records carry
+//     `p2p_transport: "host"` so analyses can tell.
+//
+// The executor is pluggable: PluginExecutor drives a real PJRT plugin
+// (libtpu.so); HostExecutor implements identical CollectiveProgram
+// semantics in portable C++ (validated against XLA's execution of the
+// same generated modules by tests/test_pjrt_programs.py), so the entire
+// --backend pjrt path — rendezvous, group math, slot workers, cache keys
+// — runs in CI without a TPU.
+#pragma once
+
+#include <condition_variable>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dlnb/communicator.hpp"
+#include "dlnb/fabric.hpp"
+#include "dlnb/pjrt_backend.hpp"
+#include "dlnb/shm_backend.hpp"
+#include "dlnb/stablehlo_gen.hpp"
+#include "dlnb/tensor.hpp"
+
+namespace dlnb {
+
+// ------------------------------------------------------------- executor
+// Execution core under PjrtFabric: run one compiled collective program
+// across all devices, srcs/dsts indexed by replica id (== world rank).
+class CollectiveExecutor {
+ public:
+  virtual ~CollectiveExecutor() = default;
+  virtual void run(const CollectiveProgram& prog,
+                   const std::vector<const void*>& srcs,
+                   const std::vector<void*>& dsts, DType dtype) = 0;
+  virtual std::string platform() const = 0;
+  virtual std::size_t cache_hits() const = 0;
+  virtual std::size_t cache_misses() const = 0;
+};
+
+// Host reference executor: the same CollectiveProgram semantics computed
+// in portable C++ (replica_groups and all), plus a simulated executable
+// cache so record fields behave identically.  The CI stand-in for the
+// plugin — XLA-vs-host agreement on these semantics is pinned by
+// tests/test_pjrt_programs.py executing the same generated modules.
+class HostExecutor : public CollectiveExecutor {
+ public:
+  void run(const CollectiveProgram& prog,
+           const std::vector<const void*>& srcs,
+           const std::vector<void*>& dsts, DType dtype) override {
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      if (!seen_.insert(prog.cache_key()).second)
+        ++hits_;
+      else
+        ++misses_;
+    }
+    std::vector<std::vector<int>> groups = prog.groups;
+    if (groups.empty()) {
+      groups.emplace_back();
+      for (int r = 0; r < prog.num_replicas; ++r) groups[0].push_back(r);
+    }
+    const std::size_t esz = dtype_bytes(dtype);
+    const std::int64_t n_in = prog.in_count;
+    switch (prog.op) {
+      case CollOp::AllReduce:
+        for (const auto& g : groups)
+          for (std::int64_t i = 0; i < n_in; ++i) {
+            float acc = 0.0f;
+            for (int r : g) acc += load_element(srcs[r], i, dtype);
+            for (int r : g) store_element(dsts[r], i, dtype, acc);
+          }
+        break;
+      case CollOp::AllGather:
+        for (const auto& g : groups)
+          for (std::size_t k = 0; k < g.size(); ++k)
+            for (int r : g)
+              std::memcpy(static_cast<char*>(dsts[r]) + k * n_in * esz,
+                          srcs[g[k]], n_in * esz);
+        break;
+      case CollOp::ReduceScatter: {
+        for (const auto& g : groups) {
+          std::int64_t block = n_in / static_cast<std::int64_t>(g.size());
+          for (std::size_t k = 0; k < g.size(); ++k)
+            for (std::int64_t i = 0; i < block; ++i) {
+              float acc = 0.0f;
+              for (int r : g)
+                acc += load_element(srcs[r], k * block + i, dtype);
+              store_element(dsts[g[k]], i, dtype, acc);
+            }
+        }
+        break;
+      }
+      case CollOp::AllToAll: {
+        for (const auto& g : groups) {
+          std::int64_t block = n_in / static_cast<std::int64_t>(g.size());
+          for (std::size_t p = 0; p < g.size(); ++p)
+            for (std::size_t q = 0; q < g.size(); ++q)
+              std::memcpy(static_cast<char*>(dsts[g[p]]) + q * block * esz,
+                          static_cast<const char*>(srcs[g[q]]) +
+                              p * block * esz,
+                          block * esz);
+        }
+        break;
+      }
+      case CollOp::CollectivePermute: {
+        // replicas that are not a target receive zeros (XLA semantics)
+        std::vector<bool> targeted(prog.num_replicas, false);
+        for (const auto& [s, t] : prog.pairs) targeted[t] = true;
+        for (int r = 0; r < prog.num_replicas; ++r)
+          if (!targeted[r]) std::memset(dsts[r], 0, n_in * esz);
+        for (const auto& [s, t] : prog.pairs)
+          std::memcpy(dsts[t], srcs[s], n_in * esz);
+        break;
+      }
+    }
+  }
+
+  std::string platform() const override { return "host"; }
+  std::size_t cache_hits() const override {
+    std::lock_guard<std::mutex> lk(m_);
+    return hits_;
+  }
+  std::size_t cache_misses() const override {
+    std::lock_guard<std::mutex> lk(m_);
+    return misses_;
+  }
+
+ private:
+  mutable std::mutex m_;
+  std::set<std::string> seen_;
+  std::size_t hits_ = 0, misses_ = 0;
+};
+
+#ifdef DLNB_HAVE_PJRT
+// Real-plugin executor: compile-cache + execute through the PJRT C API.
+class PluginExecutor : public CollectiveExecutor {
+ public:
+  explicit PluginExecutor(const std::string& plugin_path,
+                          std::vector<int> device_indices = {})
+      : ctx_(plugin_path, std::move(device_indices)) {}
+
+  void run(const CollectiveProgram& prog,
+           const std::vector<const void*>& srcs,
+           const std::vector<void*>& dsts, DType dtype) override {
+    PjrtCollectiveRunner{ctx_}.run(prog, srcs, dsts, dtype);
+  }
+
+  int num_devices() const { return ctx_.num_devices(); }
+  std::string platform() const override {
+    return const_cast<PjrtContext&>(ctx_).platform_name();
+  }
+  std::size_t cache_hits() const override { return ctx_.cache_hits(); }
+  std::size_t cache_misses() const override { return ctx_.cache_misses(); }
+
+ private:
+  PjrtContext ctx_;
+};
+#endif  // DLNB_HAVE_PJRT
+
+namespace pjrtfab {
+
+enum class Op : int {
+  Allreduce, Allgather, ReduceScatterBlock, Alltoall, RingShift, Barrier
+};
+
+// All world participants arrive with their (op, count, src, dst); the
+// LAST arriver executes the fused multi-group program exactly once;
+// everyone departs only after execution completed (blocking-collective
+// semantics).  Mismatched op/count/extra across ranks aborts the round.
+class ExecRendezvous {
+ public:
+  explicit ExecRendezvous(int n) : n_(n), srcs_(n), dsts_(n) {}
+
+  using ExecFn = std::function<void(Op, std::int64_t,
+                                    const std::vector<const void*>&,
+                                    const std::vector<void*>&)>;
+
+  void collective(int idx, Op op, std::int64_t count, std::int64_t extra,
+                  const void* src, void* dst, const ExecFn& exec) {
+    std::unique_lock<std::mutex> lk(m_);
+    std::uint64_t my_gen = gen_;
+    srcs_[idx] = src;
+    dsts_[idx] = dst;
+    if (arrived_ == 0) {
+      op_ = op;
+      count_ = count;
+      extra_ = extra;
+    } else if (op_ != op || count_ != count || extra_ != extra) {
+      mismatch_ = true;
+    }
+    if (++arrived_ == n_) {
+      if (!mismatch_ && op_ != Op::Barrier) {
+        lk.unlock();
+        try {
+          exec(op, count, srcs_, dsts_);
+        } catch (...) {
+          lk.lock();
+          error_ = std::current_exception();
+          lk.unlock();
+        }
+        lk.lock();
+      }
+      exec_done_ = true;
+      cv_.notify_all();
+    } else {
+      cv_.wait(lk, [&] {
+        return gen_ == my_gen && arrived_ == n_ && exec_done_;
+      });
+    }
+    bool bad = mismatch_;
+    std::exception_ptr err = error_;
+    if (++departed_ == n_) {
+      arrived_ = 0;
+      departed_ = 0;
+      mismatch_ = false;
+      exec_done_ = false;
+      error_ = nullptr;
+      ++gen_;
+      cv_.notify_all();
+    } else {
+      cv_.wait(lk, [&] { return gen_ != my_gen; });
+    }
+    lk.unlock();
+    if (bad)
+      throw std::runtime_error(
+          "pjrt collective mismatch: world ranks disagree on op/count — "
+          "every rank must reach the same collective (XLA SPMD constraint)");
+    if (err) std::rethrow_exception(err);
+  }
+
+ private:
+  int n_;
+  std::mutex m_;
+  std::condition_variable cv_;
+  std::vector<const void*> srcs_;
+  std::vector<void*> dsts_;
+  int arrived_ = 0;
+  int departed_ = 0;
+  bool exec_done_ = false;
+  bool mismatch_ = false;
+  Op op_ = Op::Barrier;
+  std::int64_t count_ = 0;
+  std::int64_t extra_ = 0;
+  std::exception_ptr error_;
+  std::uint64_t gen_ = 0;
+};
+
+// One communicator split's shared state: the full partition of world
+// ranks into color groups (the module's replica_groups), per-slot
+// rendezvous, and per-group host mailboxes for p2p.
+struct GroupSet {
+  // `colors[r]` = color of world rank r; groups ordered by color,
+  // members ascending world rank (MPI_Comm_split with key = rank).
+  GroupSet(const std::vector<int>& colors, int num_slots) {
+    std::map<int, std::vector<int>> by_color;
+    int world = static_cast<int>(colors.size());
+    for (int r = 0; r < world; ++r) by_color[colors[r]].push_back(r);
+    group_of.resize(world);
+    grank_of.resize(world);
+    for (auto& [c, members] : by_color) {
+      int gi = static_cast<int>(groups.size());
+      for (std::size_t k = 0; k < members.size(); ++k) {
+        group_of[members[k]] = gi;
+        grank_of[members[k]] = static_cast<int>(k);
+      }
+      groups.push_back(members);
+      mailboxes.push_back(std::make_unique<shm::Mailboxes>());
+    }
+    std::size_t gsize = groups[0].size();
+    for (const auto& g : groups)
+      if (g.size() != gsize)
+        throw std::runtime_error(
+            "pjrt split: unequal color-group sizes (replica_groups must be "
+            "uniform)");
+    for (int i = 0; i <= num_slots; ++i)
+      rendezvous.push_back(std::make_unique<ExecRendezvous>(world));
+  }
+
+  int world_size() const { return static_cast<int>(group_of.size()); }
+  int group_size() const { return static_cast<int>(groups[0].size()); }
+
+  std::vector<std::vector<int>> groups;
+  std::vector<int> group_of;   // world rank -> group index
+  std::vector<int> grank_of;   // world rank -> rank within group
+  std::vector<std::unique_ptr<ExecRendezvous>> rendezvous;
+  std::vector<std::unique_ptr<shm::Mailboxes>> mailboxes;
+};
+
+}  // namespace pjrtfab
+
+// Per-rank view of one group set — implements ProxyCommunicator on the
+// PJRT execution model.
+class PjrtCommunicator : public ProxyCommunicator {
+ public:
+  PjrtCommunicator(std::shared_ptr<pjrtfab::GroupSet> set,
+                   CollectiveExecutor* exec, int world_rank, DType dtype,
+                   int num_slots, std::string name)
+      : set_(std::move(set)),
+        exec_(exec),
+        wrank_(world_rank),
+        dtype_(dtype),
+        num_slots_(num_slots),
+        name_(std::move(name)),
+        workers_(num_slots) {}
+
+  ~PjrtCommunicator() override {
+    for (auto& w : workers_) w.stop();
+  }
+
+  int rank() const override { return set_->grank_of[wrank_]; }
+  int size() const override { return set_->group_size(); }
+  std::string name() const override { return name_; }
+  DType dtype() const override { return dtype_; }
+
+  // ---- blocking collectives ----
+  void Allreduce(const void* src, void* dst, std::int64_t count) override {
+    run_collective(num_slots_, pjrtfab::Op::Allreduce, count, 0, src, dst);
+  }
+  void Allgather(const void* src, void* dst, std::int64_t cpr) override {
+    run_collective(num_slots_, pjrtfab::Op::Allgather, cpr, 0, src, dst);
+  }
+  void ReduceScatterBlock(const void* src, void* dst,
+                          std::int64_t cpr) override {
+    run_collective(num_slots_, pjrtfab::Op::ReduceScatterBlock, cpr, 0, src,
+                   dst);
+  }
+  void Alltoall(const void* src, void* dst, std::int64_t cpr) override {
+    run_collective(num_slots_, pjrtfab::Op::Alltoall, cpr, 0, src, dst);
+  }
+  void Barrier() override {
+    run_collective(num_slots_, pjrtfab::Op::Barrier, 0, 0, nullptr, nullptr);
+  }
+  void RingShift(const void* src, void* dst, std::int64_t count,
+                 int shift = 1) override {
+    run_collective(num_slots_, pjrtfab::Op::RingShift, count, shift, src,
+                   dst);
+  }
+
+  // ---- p2p: host mailbox rendezvous (see header comment) ----
+  void Send(const void* src, std::int64_t count, int dst_rank,
+            int tag = 0) override {
+    mailbox().send(rank(), dst_rank, tag, src,
+                   count * dtype_bytes(dtype_));
+  }
+  void Recv(void* dst, std::int64_t count, int src_rank,
+            int tag = 0) override {
+    mailbox().recv(src_rank, rank(), tag, dst,
+                   count * dtype_bytes(dtype_));
+  }
+
+  // ---- nonblocking, slot-indexed ----
+  void Iallreduce(const void* src, void* dst, std::int64_t count,
+                  int slot) override {
+    enqueue(slot, [=] {
+      run_collective(slot, pjrtfab::Op::Allreduce, count, 0, src, dst);
+    });
+  }
+  void Iallgather(const void* src, void* dst, std::int64_t cpr,
+                  int slot) override {
+    enqueue(slot, [=] {
+      run_collective(slot, pjrtfab::Op::Allgather, cpr, 0, src, dst);
+    });
+  }
+  void Isend(const void* src, std::int64_t count, int dst_rank, int slot,
+             int tag = -1) override {
+    int t = tag >= 0 ? tag : 1 + slot;
+    enqueue(slot, [=] {
+      mailbox().send(rank(), dst_rank, t, src, count * dtype_bytes(dtype_));
+    });
+  }
+  void Irecv(void* dst, std::int64_t count, int src_rank, int slot,
+             int tag = -1) override {
+    int t = tag >= 0 ? tag : 1 + slot;
+    enqueue(slot, [=] {
+      mailbox().recv(src_rank, rank(), t, dst, count * dtype_bytes(dtype_));
+    });
+  }
+  void Wait(int slot) override { worker(slot).wait(); }
+  void WaitAll(int num_slots) override {
+    for (int i = 0; i < num_slots && i < num_slots_; ++i) workers_[i].wait();
+  }
+
+ private:
+  shm::Mailboxes& mailbox() {
+    return *set_->mailboxes[set_->group_of[wrank_]];
+  }
+  shm::SlotWorker& worker(int slot) {
+    if (slot < 0 || slot >= num_slots_)
+      throw std::out_of_range("slot " + std::to_string(slot) +
+                              " out of range (num_slots=" +
+                              std::to_string(num_slots_) + ")");
+    return workers_[slot];
+  }
+  void enqueue(int slot, std::function<void()> fn) {
+    worker(slot).enqueue(std::move(fn));
+  }
+
+  // Map the imperative call onto one whole-world program: user count ->
+  // per-replica module in_count per op, color groups -> replica_groups.
+  void run_collective(int slot, pjrtfab::Op op, std::int64_t user_count,
+                      std::int64_t extra, const void* src, void* dst) {
+    const std::int64_t g = set_->group_size();
+    std::int64_t in_count = user_count;
+    CollOp cop = CollOp::AllReduce;
+    switch (op) {
+      case pjrtfab::Op::Allreduce:
+        cop = CollOp::AllReduce;
+        break;
+      case pjrtfab::Op::Allgather:
+        cop = CollOp::AllGather;  // out = in * G
+        break;
+      case pjrtfab::Op::ReduceScatterBlock:
+        cop = CollOp::ReduceScatter;  // src holds G blocks
+        in_count = user_count * g;
+        break;
+      case pjrtfab::Op::Alltoall:
+        cop = CollOp::AllToAll;  // src/dst hold G blocks
+        in_count = user_count * g;
+        break;
+      case pjrtfab::Op::RingShift:
+        cop = CollOp::CollectivePermute;
+        break;
+      case pjrtfab::Op::Barrier:
+        break;
+    }
+    auto* exec = exec_;
+    auto* set = set_.get();
+    DType dt = dtype_;
+    set_->rendezvous[slot]->collective(
+        wrank_, op, user_count, extra, src, dst,
+        [exec, set, cop, in_count, dt, extra](
+            pjrtfab::Op o, std::int64_t, const std::vector<const void*>& srcs,
+            const std::vector<void*>& dsts) {
+          CollectiveProgram prog;
+          prog.op = cop;
+          prog.dtype = dt;
+          prog.in_count = in_count;
+          prog.num_replicas = set->world_size();
+          if (o == pjrtfab::Op::RingShift) {
+            // per-group rotation pairs: member k -> member (k+shift) mod G
+            for (const auto& grp : set->groups) {
+              int G = static_cast<int>(grp.size());
+              int s = ((static_cast<int>(extra) % G) + G) % G;
+              for (int k = 0; k < G; ++k)
+                prog.pairs.emplace_back(grp[k], grp[(k + s) % G]);
+            }
+          } else {
+            prog.groups = set->groups;
+          }
+          exec->run(prog, srcs, dsts, dt);
+        });
+  }
+
+  std::shared_ptr<pjrtfab::GroupSet> set_;
+  CollectiveExecutor* exec_;
+  int wrank_;
+  DType dtype_;
+  int num_slots_;
+  std::string name_;
+  std::vector<shm::SlotWorker> workers_;
+};
+
+// The world: owns the executor, spawns rank threads, arbitrates splits.
+class PjrtFabric : public Fabric {
+ public:
+  PjrtFabric(int world_size, DType dtype,
+             std::unique_ptr<CollectiveExecutor> exec, int num_slots = 32)
+      : world_size_(world_size),
+        dtype_(dtype),
+        num_slots_(num_slots),
+        exec_(std::move(exec)) {
+    if (world_size <= 0) throw std::invalid_argument("world_size must be > 0");
+    world_set_ = std::make_shared<pjrtfab::GroupSet>(
+        std::vector<int>(world_size, 0), num_slots_);
+  }
+
+  int world_size() const override { return world_size_; }
+  DType dtype() const override { return dtype_; }
+  std::string backend() const override { return "pjrt"; }
+  CollectiveExecutor& executor() { return *exec_; }
+
+  std::unique_ptr<ProxyCommunicator> world_comm(int rank) override {
+    return std::make_unique<PjrtCommunicator>(world_set_, exec_.get(), rank,
+                                              dtype_, num_slots_,
+                                              "pjrt_world");
+  }
+
+  std::unique_ptr<ProxyCommunicator> split(
+      int world_rank, int color, const std::string& name) override {
+    std::shared_ptr<pjrtfab::GroupSet> set;
+    std::uint64_t seq;
+    {
+      std::unique_lock<std::mutex> lk(split_m_);
+      if (split_arrived_ == 0) split_colors_.assign(world_size_, 0);
+      split_colors_[world_rank] = color;
+      seq = split_seq_;
+      if (++split_arrived_ == world_size_) {
+        // even on failure the round must complete (reset + bump + notify)
+        // or the other ranks wait forever
+        try {
+          split_sets_[seq] = std::make_shared<pjrtfab::GroupSet>(
+              split_colors_, num_slots_);
+        } catch (...) {
+          split_sets_[seq] = nullptr;
+          split_arrived_ = 0;
+          ++split_seq_;
+          split_cv_.notify_all();
+          throw;
+        }
+        split_arrived_ = 0;
+        ++split_seq_;
+        split_cv_.notify_all();
+      } else {
+        split_cv_.wait(lk, [&] { return split_seq_ > seq; });
+      }
+      set = split_sets_.at(seq);
+    }
+    if (!set)
+      throw std::runtime_error(
+          "pjrt split: group construction failed on another rank");
+    return std::make_unique<PjrtCommunicator>(std::move(set), exec_.get(),
+                                              world_rank, dtype_, num_slots_,
+                                              name);
+  }
+
+  void launch(const std::function<void(int)>& body) override {
+    std::vector<std::thread> threads;
+    std::mutex err_m;
+    std::exception_ptr first_error;
+    threads.reserve(world_size_);
+    for (int r = 0; r < world_size_; ++r)
+      threads.emplace_back([&, r] {
+        try {
+          body(r);
+        } catch (...) {
+          std::lock_guard<std::mutex> lk(err_m);
+          if (!first_error) first_error = std::current_exception();
+        }
+      });
+    for (auto& t : threads) t.join();
+    if (first_error) std::rethrow_exception(first_error);
+  }
+
+  void describe(Json& meta, Json& mesh) const override {
+    meta["backend"] = "pjrt";
+    meta["pjrt_executor"] = exec_->platform();
+    // the plugin's own platform name ("tpu", "cpu", ...) — never guess,
+    // or CPU-plugin runs would be recorded as TPU measurements
+    std::string plat = exec_->platform();
+    meta["device"] = plat == "host" ? "cpu" : plat;
+    meta["p2p_transport"] = "host";
+    meta["cache_hits"] = static_cast<std::int64_t>(exec_->cache_hits());
+    meta["cache_misses"] = static_cast<std::int64_t>(exec_->cache_misses());
+    mesh["platform"] = exec_->platform();
+    mesh["device_kind"] = "pjrt-replica";
+  }
+
+ private:
+  int world_size_;
+  DType dtype_;
+  int num_slots_;
+  std::unique_ptr<CollectiveExecutor> exec_;
+  std::shared_ptr<pjrtfab::GroupSet> world_set_;
+
+  std::mutex split_m_;
+  std::condition_variable split_cv_;
+  std::vector<int> split_colors_;
+  int split_arrived_ = 0;
+  std::uint64_t split_seq_ = 0;
+  std::map<std::uint64_t, std::shared_ptr<pjrtfab::GroupSet>> split_sets_;
+};
+
+// Build the executor for --backend pjrt.  Selection: DLNB_PJRT_EXECUTOR =
+// "plugin" | "host" | "auto" (default).  auto prefers the real plugin
+// when one is present with enough devices, else falls back to the host
+// executor with a stderr note (CI boxes).  `device_indices` is the parsed
+// --devices list (reference -d, utils.hpp:62-71).
+inline std::unique_ptr<CollectiveExecutor> make_pjrt_executor(
+    int world_size, const std::string& plugin_flag,
+    const std::vector<int>& device_indices, std::ostream& diag) {
+  const char* sel_env = std::getenv("DLNB_PJRT_EXECUTOR");
+  std::string sel = sel_env && *sel_env ? sel_env : "auto";
+  if (sel == "host") return std::make_unique<HostExecutor>();
+#ifdef DLNB_HAVE_PJRT
+  std::string plugin =
+      !plugin_flag.empty() ? plugin_flag : default_pjrt_plugin_path();
+  if (!plugin.empty()) {
+    try {
+      auto exec = std::make_unique<PluginExecutor>(plugin, device_indices);
+      if (exec->num_devices() < world_size)
+        throw std::runtime_error(
+            "plugin has " + std::to_string(exec->num_devices()) +
+            " device(s) for world " + std::to_string(world_size));
+      return exec;
+    } catch (const std::exception& e) {
+      if (sel == "plugin")
+        throw std::runtime_error(std::string("pjrt plugin required but "
+                                             "unusable: ") +
+                                 e.what());
+      diag << "pjrt: plugin unusable (" << e.what()
+           << ") — using host executor\n";
+    }
+  } else if (sel == "plugin") {
+    throw std::runtime_error(
+        "pjrt plugin required but none found (set DLNB_PJRT_PLUGIN)");
+  }
+#else
+  (void)plugin_flag;
+  (void)device_indices;
+  if (sel == "plugin")
+    throw std::runtime_error(
+        "pjrt plugin required but this build has no PJRT support "
+        "(DLNB_HAVE_PJRT unset)");
+#endif
+  diag << "pjrt: using host reference executor\n";
+  return std::make_unique<HostExecutor>();
+}
+
+}  // namespace dlnb

@@ -33,6 +33,7 @@
 #include <vector>
 
 #include "dlnb/communicator.hpp"
+#include "dlnb/fabric.hpp"
 #include "dlnb/tensor.hpp"
 
 namespace dlnb {
@@ -422,7 +423,7 @@ class ShmCommunicator : public ProxyCommunicator {
 };
 
 // The world: spawns rank threads and arbitrates group splits.
-class ShmFabric {
+class ShmFabric : public Fabric {
  public:
   ShmFabric(int world_size, DType dtype, int num_slots = 32)
       : world_size_(world_size), dtype_(dtype), num_slots_(num_slots) {
@@ -432,21 +433,19 @@ class ShmFabric {
     world_group_ = std::make_shared<shm::Group>(all, num_slots_);
   }
 
-  int world_size() const { return world_size_; }
-  DType dtype() const { return dtype_; }
+  int world_size() const override { return world_size_; }
+  DType dtype() const override { return dtype_; }
+  std::string backend() const override { return "shm"; }
   int num_slots() const { return num_slots_; }
 
-  std::unique_ptr<ShmCommunicator> world_comm(int rank) {
+  std::unique_ptr<ProxyCommunicator> world_comm(int rank) override {
     return std::make_unique<ShmCommunicator>(world_group_, rank, dtype_,
                                              num_slots_, "shm_world");
   }
 
-  // Collective split: all world ranks must call with their color
-  // (MPI_Comm_split, key = world rank — reference comm-color math,
-  // hybrid_3d.cpp:287-300).  Returns this rank's communicator for its
-  // color group.
-  std::unique_ptr<ShmCommunicator> split(int world_rank, int color,
-                                         const std::string& name) {
+  // Returns this rank's communicator for its color group (see Fabric).
+  std::unique_ptr<ProxyCommunicator> split(int world_rank, int color,
+                                           const std::string& name) override {
     std::uint64_t seq;
     {
       std::unique_lock<std::mutex> lk(split_m_);
@@ -481,8 +480,15 @@ class ShmFabric {
                                              name);
   }
 
+  void describe(Json& meta, Json& mesh) const override {
+    meta["backend"] = "shm";
+    meta["device"] = "cpu";
+    mesh["platform"] = "shm";
+    mesh["device_kind"] = "thread-rank";
+  }
+
   // Run body(rank) on world_size threads; rethrows the first rank failure.
-  void launch(const std::function<void(int)>& body) {
+  void launch(const std::function<void(int)>& body) override {
     std::vector<std::thread> threads;
     std::mutex err_m;
     std::exception_ptr first_error;

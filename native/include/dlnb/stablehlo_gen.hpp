@@ -179,12 +179,19 @@ inline std::string generate_stablehlo(const CollectiveProgram& p) {
 }
 
 // Serialized xla CompileOptionsProto carrying {executable_build_options
-// {num_replicas, num_partitions: 1}} — the options blob
-// PJRT_Client_Compile expects.  Hand-encoded protobuf wire format; field
-// numbers from xla/pjrt/proto/compile_options.proto
-// (executable_build_options = 3; num_replicas = 4, num_partitions = 5).
-inline std::string compile_options_proto(int num_replicas,
-                                         int num_partitions = 1) {
+// {num_replicas, num_partitions: 1, device_assignment?}} — the options
+// blob PJRT_Client_Compile expects.  Hand-encoded protobuf wire format;
+// field numbers from xla/pjrt/proto/compile_options.proto
+// (executable_build_options = 3; num_replicas = 4, num_partitions = 5,
+// device_assignment = 9) and xla_data.proto DeviceAssignmentProto
+// (replica_count = 1, computation_count = 2, computation_devices = 3;
+// ComputationDevice.replica_device_ids = 1).  A non-empty `device_ids`
+// pins replica r to global device id device_ids[r] — the runtime
+// equivalent of the reference's `-d 0,2,3` device-list selection
+// (reference cpp/utils.hpp:62-71).
+inline std::string compile_options_proto(
+    int num_replicas, int num_partitions = 1,
+    const std::vector<int>& device_ids = {}) {
   auto varint = [](std::uint64_t v) {
     std::string out;
     do {
@@ -195,16 +202,32 @@ inline std::string compile_options_proto(int num_replicas,
     } while (v);
     return out;
   };
+  auto length_delimited = [&](int field, const std::string& payload) {
+    std::string out;
+    out += static_cast<char>((field << 3) | 2);
+    out += varint(payload.size());
+    out += payload;
+    return out;
+  };
   std::string build_opts;
   build_opts += static_cast<char>((4 << 3) | 0);  // num_replicas, varint
   build_opts += varint(static_cast<std::uint64_t>(num_replicas));
   build_opts += static_cast<char>((5 << 3) | 0);  // num_partitions, varint
   build_opts += varint(static_cast<std::uint64_t>(num_partitions));
-  std::string out;
-  out += static_cast<char>((3 << 3) | 2);  // executable_build_options, msg
-  out += varint(build_opts.size());
-  out += build_opts;
-  return out;
+  if (!device_ids.empty()) {
+    // repeated int64 replica_device_ids = 1 (packed)
+    std::string ids;
+    for (int id : device_ids) ids += varint(static_cast<std::uint64_t>(id));
+    std::string computation_device = length_delimited(1, ids);
+    std::string assignment;
+    assignment += static_cast<char>((1 << 3) | 0);  // replica_count
+    assignment += varint(static_cast<std::uint64_t>(num_replicas));
+    assignment += static_cast<char>((2 << 3) | 0);  // computation_count
+    assignment += varint(1);
+    assignment += length_delimited(3, computation_device);
+    build_opts += length_delimited(9, assignment);
+  }
+  return length_delimited(3, build_opts);
 }
 
 }  // namespace dlnb

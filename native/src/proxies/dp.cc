@@ -43,7 +43,7 @@ int main(int argc, char** argv) {
 
     return run_proxy_main(
         "dp", env, meta,
-        [&](int r, ShmFabric& fab, TimerSet& ts, RankRun& run) {
+        [&](int r, Fabric& fab, TimerSet& ts, RankRun& run) {
           auto comm = fab.world_comm(r);
           // every rank holds full buckets (allreduce semantics,
           // dp.cpp:227-232); grads zero-init like the reference Tensor

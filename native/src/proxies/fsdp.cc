@@ -49,7 +49,7 @@ int main(int argc, char** argv) {
 
     return run_proxy_main(
         "fsdp", env, meta,
-        [&](int r, ShmFabric& fab, TimerSet& ts, RankRun& run) {
+        [&](int r, Fabric& fab, TimerSet& ts, RankRun& run) {
           // grid colors (reference fsdp.cpp:257-265)
           int unit_color = r / static_cast<int>(sched.sharding_factor);
           int repl_color = r % static_cast<int>(sched.sharding_factor);

@@ -35,7 +35,7 @@ int main(int argc, char** argv) {
 
     return run_proxy_main(
         "hybrid_3d", env, meta,
-        [&](int r, ShmFabric& fab, TimerSet& ts, RankRun& run) {
+        [&](int r, Fabric& fab, TimerSet& ts, RankRun& run) {
           return hybrid_rank_body(spec, env, r, fab, ts, run);
         });
   } catch (const std::exception& e) {

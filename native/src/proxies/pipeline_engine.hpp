@@ -73,7 +73,7 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
 
 // The per-rank body shared by all three hybrid proxies.
 inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
-                             int r, ShmFabric& fab, TimerSet& ts,
+                             int r, Fabric& fab, TimerSet& ts,
                              RankRun& run) {
   const PipelineSchedule& p = spec.pipe;
   Grid3D grid = spec.is_moe
@@ -87,7 +87,7 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
   auto world = fab.world_comm(r);
   auto pp_comm = fab.split(r, static_cast<int>(grid.pp_color(r)), "pp_comm");
   auto dp_comm = fab.split(r, static_cast<int>(grid.dp_color(r)), "dp_comm");
-  std::unique_ptr<ShmCommunicator> axis_comm;
+  std::unique_ptr<ProxyCommunicator> axis_comm;
   // MoE always needs the EP communicator, even at ep=1 (the dispatch/
   // combine all-to-alls and the non-expert sync still run, degenerating
   // to local copies)

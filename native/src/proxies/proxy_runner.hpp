@@ -21,8 +21,10 @@
 #include <vector>
 
 #include "dlnb/args.hpp"
+#include "dlnb/fabric.hpp"
 #include "dlnb/harness.hpp"
 #include "dlnb/model_data.hpp"
+#include "dlnb/pjrt_fabric.hpp"
 #include "dlnb/shm_backend.hpp"
 #include "dlnb/timers.hpp"
 #include "dlnb/topology.hpp"
@@ -60,7 +62,35 @@ struct ProxyEnv {
   std::string model_name;
   std::string out_path;  // empty -> stdout
   bool no_topology = false;
+  std::string backend = "shm";      // shm | pjrt
+  std::string pjrt_plugin;          // --pjrt_plugin override
+  std::vector<int> devices;         // --devices list (reference -d)
 };
+
+// "0,2,3" -> {0,2,3} (reference parse_devices, cpp/utils.hpp:62-71).
+// Every token must be a plain decimal number — std::stoi's silent prefix
+// parsing would turn a "0-3" range typo into {0}.
+inline std::vector<int> parse_device_list(const std::string& s) {
+  std::vector<int> out;
+  std::string num;
+  auto flush = [&] {
+    if (num.empty()) return;
+    for (char c : num)
+      if (c < '0' || c > '9')
+        throw std::runtime_error("--devices: bad device index '" + num +
+                                 "' (expected e.g. 0,2,3)");
+    out.push_back(std::stoi(num));
+    num.clear();
+  };
+  for (char c : s) {
+    if (c == ',')
+      flush();
+    else if (c != ' ')
+      num += c;
+  }
+  flush();
+  return out;
+}
 
 inline void add_common_args(Args& args) {
   args.required_str("model", "stats-file name, e.g. gpt2_l_16_bfloat16")
@@ -73,6 +103,14 @@ inline void add_common_args(Args& args) {
       .optional_double("size_scale", 1.0, "scale communication buffer sizes")
       .optional_str("base_path", "", "repo root containing dlnetbench_tpu/data")
       .optional_str("out", "", "append the JSON record here instead of stdout")
+      .optional_str("backend", "shm",
+                    "rank fabric: shm (threaded fake) or pjrt (XLA runtime)")
+      .optional_str("pjrt_plugin", "",
+                    "PJRT plugin path override (default: $DLNB_PJRT_PLUGIN "
+                    "or libtpu.so)")
+      .optional_str("devices", "",
+                    "device-index list for the pjrt backend, e.g. 0,2,3 "
+                    "(reference -d)")
       .flag("loop", "run the schedule forever (congestor mode)")
       .flag("no_topology", "skip the startup fabric-topology graph");
 }
@@ -97,8 +135,34 @@ inline ProxyEnv make_env(const Args& args) {
   env.dtype = dtype_from_name(env.stats.dtype);
   env.out_path = args.str("out");
   env.no_topology = args.flag_set("no_topology");
+  env.backend = args.str("backend");
+  env.pjrt_plugin = args.str("pjrt_plugin");
+  env.devices = parse_device_list(args.str("devices"));
+  if (env.backend != "shm" && env.backend != "pjrt")
+    throw std::runtime_error("unknown --backend '" + env.backend +
+                             "' (shm | pjrt)");
   if (env.world <= 0) throw std::runtime_error("--world must be positive");
+  if (!env.devices.empty()) {
+    if (env.backend != "pjrt")
+      throw std::runtime_error(
+          "--devices only applies to --backend pjrt (the shm fabric has no "
+          "devices)");
+    if (static_cast<int>(env.devices.size()) < env.world)
+      throw std::runtime_error("--devices lists " +
+                               std::to_string(env.devices.size()) +
+                               " device(s) for world " +
+                               std::to_string(env.world));
+  }
   return env;
+}
+
+inline std::unique_ptr<Fabric> make_fabric(const ProxyEnv& env) {
+  if (env.backend == "pjrt")
+    return std::make_unique<PjrtFabric>(
+        env.world, env.dtype,
+        make_pjrt_executor(env.world, env.pjrt_plugin, env.devices,
+                           std::cerr));
+  return std::make_unique<ShmFabric>(env.world, env.dtype);
 }
 
 inline ModelCard load_card_for(const ProxyEnv& env) {
@@ -110,16 +174,17 @@ inline ModelCard load_card_for(const ProxyEnv& env) {
 // Per-rank body: receives (rank, fabric, timers) and returns the rank's
 // extra identity fields (stage_id/dp_id/... as a Json object).  It must
 // call run_measured itself so proxies control communicator setup.
-using RankBody = std::function<Json(int rank, ShmFabric& fab, TimerSet& ts,
+using RankBody = std::function<Json(int rank, Fabric& fab, TimerSet& ts,
                                     RankRun& run_out)>;
 
 inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
                           const Json& global_meta, const RankBody& body) {
   if (!env.no_topology)
     print_topology(env.world, std::cerr,
-                   std::string("shm-rank[") + dtype_name(env.dtype) + "]");
+                   env.backend + "-rank[" + dtype_name(env.dtype) + "]");
 
-  ShmFabric fab(env.world, env.dtype);
+  std::unique_ptr<Fabric> fab_ptr = make_fabric(env);
+  Fabric& fab = *fab_ptr;
   std::vector<TimerSet> timers(env.world);
   std::vector<RankRun> runs(env.world);
   std::vector<Json> extras(env.world);
@@ -141,14 +206,11 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
   Json meta = global_meta;
   meta["model"] = env.model_name;
   meta["world_size"] = env.world;
-  meta["backend"] = "shm";
-  meta["device"] = "cpu";
   meta["dtype"] = dtype_name(env.dtype);
   meta["time_scale"] = env.cfg.time_scale;
   meta["size_scale"] = env.cfg.size_scale;
   Json mesh = Json::object();
-  mesh["platform"] = "shm";
-  mesh["device_kind"] = "thread-rank";
+  fab.describe(meta, mesh);  // backend/platform identity + cache stats
 
   Json rec = make_record(section, meta, mesh, runs[0].runs,
                          runs[0].warmup_us, reports);

@@ -50,21 +50,18 @@ int main(int argc, char** argv) {
 
     return run_proxy_main(
         "ring_attention", env, meta,
-        [&](int r, ShmFabric& fab, TimerSet& ts, RankRun& run) {
+        [&](int r, Fabric& fab, TimerSet& ts, RankRun& run) {
           // sp fastest-varying: ring peers are consecutive world ranks
           Grid3D grid{dp, 1, sp};
           auto c = grid.coords(r);
           auto world = fab.world_comm(r);
           auto sp_comm =
               fab.split(r, static_cast<int>(grid.tp_color(r)), "sp_comm");
-          std::unique_ptr<ShmCommunicator> dp_comm;
+          std::unique_ptr<ProxyCommunicator> dp_comm;
           if (dp > 1)
             dp_comm =
                 fab.split(r, static_cast<int>(grid.dp_color(r)), "dp_comm");
 
-          int me = sp_comm->rank();
-          int next = (me + 1) % static_cast<int>(sp);
-          int prev = (me + static_cast<int>(sp) - 1) % static_cast<int>(sp);
           Tensor kv_out(kv_elems, env.dtype), kv_in(kv_elems, env.dtype);
           Tensor g_src(grad_elems, env.dtype), g_dst(grad_elems, env.dtype);
 
@@ -73,11 +70,10 @@ int main(int argc, char** argv) {
               burn_us(block_us, env.cfg.time_scale);
               if (hop < sp - 1) {
                 auto sc = t.scoped("ring_comm");
-                // rotate: send on slot 0, recv on slot 1, one shared tag
-                // (the ppermute idiom — every rank shifts simultaneously)
-                sp_comm->Isend(kv_out.data(), kv_elems, next, 0, 100);
-                sp_comm->Irecv(kv_in.data(), kv_elems, prev, 1, 100);
-                sp_comm->WaitAll(2);
+                // rotate every rank's KV block to its successor — the
+                // ppermute idiom; a native collective_permute on the pjrt
+                // backend, paired Isend/Irecv on shm
+                sp_comm->RingShift(kv_out.data(), kv_in.data(), kv_elems);
               }
             }
           };

@@ -49,13 +49,13 @@ int main(int argc, char** argv) {
 
     return run_proxy_main(
         "ulysses", env, meta,
-        [&](int r, ShmFabric& fab, TimerSet& ts, RankRun& run) {
+        [&](int r, Fabric& fab, TimerSet& ts, RankRun& run) {
           Grid3D grid{dp, 1, sp};
           auto c = grid.coords(r);
           auto world = fab.world_comm(r);
           auto sp_comm =
               fab.split(r, static_cast<int>(grid.tp_color(r)), "sp_comm");
-          std::unique_ptr<ShmCommunicator> dp_comm;
+          std::unique_ptr<ProxyCommunicator> dp_comm;
           if (dp > 1)
             dp_comm =
                 fab.split(r, static_cast<int>(grid.dp_color(r)), "dp_comm");

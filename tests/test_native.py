@@ -39,18 +39,24 @@ def native_bin(tmp_path_factory):
     return build / "bin"
 
 
-def run_proxy(native_bin, name, *extra, model="gpt2_l_16_bfloat16", world=4):
+def run_proxy(native_bin, name, *extra, model="gpt2_l_16_bfloat16", world=4,
+              env=None):
     cmd = [str(native_bin / name), "--model", model, "--world", str(world),
            "--time_scale", "0.0001", "--size_scale", "0.00001",
            "--runs", "2", "--warmup", "1", "--no_topology",
            "--base_path", str(REPO), *map(str, extra)]
-    out = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+    full_env = None
+    if env:
+        import os
+        full_env = {**os.environ, **env}
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=180,
+                         env=full_env)
     assert out.returncode == 0, f"{name} failed: {out.stderr}"
     return json.loads(out.stdout)
 
 
 def test_native_unit_suites(native_bin):
-    for t in ("test_core", "test_comm"):
+    for t in ("test_core", "test_comm", "test_pjrt"):
         out = subprocess.run([str(native_bin.parent / t)],
                              capture_output=True, text=True, timeout=300)
         assert out.returncode == 0, f"{t} failures:\n{out.stdout}"
@@ -136,6 +142,98 @@ def test_native_reads_reference_stats_files(native_bin, tmp_path):
     # equals model_size in the reference's committed data)
     total = sum(rec["global"]["schedule_bucket_bytes"])
     assert total > 0
+
+
+# ---------------------------------------------------------------------
+# --backend pjrt: the PJRT fabric (VERDICT r1 #1).  The host executor
+# stands in for the plugin in CI — identical CollectiveProgram semantics,
+# same rendezvous/slot/cache machinery (pjrt_fabric.hpp); the plugin
+# path itself is exercised by test_native_pjrt_real_plugin when a TPU
+# is reachable.
+
+PJRT_HOST = {"DLNB_PJRT_EXECUTOR": "host"}
+
+
+@pytest.mark.parametrize("name,extra,model,world", [
+    ("dp", ("--num_buckets", 4), "gpt2_l_16_bfloat16", 4),
+    ("fsdp", ("--num_units", 3, "--sharding_factor", 2),
+     "gpt2_l_16_bfloat16", 4),
+    ("hybrid_2d", ("--num_stages", 2, "--num_microbatches", 4),
+     "gpt2_l_16_bfloat16", 4),
+    ("hybrid_3d", ("--num_stages", 2, "--num_microbatches", 2, "--tp", 2),
+     "gpt2_l_16_bfloat16", 8),
+    ("hybrid_3d_moe",
+     ("--num_stages", 2, "--num_microbatches", 2, "--num_expert_shards", 2),
+     "mixtral_8x7b_16_bfloat16", 8),
+    ("ring_attention", ("--sp", 4, "--max_layers", 2),
+     "llama3_8b_16_bfloat16", 4),
+    ("ulysses", ("--sp", 2, "--max_layers", 2), "llama3_8b_16_bfloat16", 4),
+])
+def test_native_pjrt_backend_record(native_bin, name, extra, model, world):
+    from dlnetbench_tpu.metrics.parser import records_to_dataframe, \
+        validate_record
+
+    rec = run_proxy(native_bin, name, "--backend", "pjrt", *extra,
+                    model=model, world=world, env=PJRT_HOST)
+    g = rec["global"]
+    assert g["backend"] == "pjrt"
+    assert g["pjrt_executor"] == "host"
+    assert g["p2p_transport"] == "host"
+    # the executable cache was exercised: at least one compile, and reuse
+    # across warmup+measured iterations produces hits
+    assert g["cache_misses"] >= 1
+    assert g["cache_hits"] > g["cache_misses"]
+    validate_record(rec)
+    df = records_to_dataframe([rec])
+    assert len(df) == world * rec["num_runs"]
+    assert (df["runtime"] > 0).all()
+
+
+def test_native_pjrt_executor_forced_plugin_fails_cleanly(native_bin):
+    """--backend pjrt with DLNB_PJRT_EXECUTOR=plugin and a bogus plugin
+    path must error out, not silently fall back."""
+    import os
+    cmd = [str(native_bin / "dp"), "--model", "gpt2_l_16_bfloat16",
+           "--world", "2", "--num_buckets", "2", "--backend", "pjrt",
+           "--pjrt_plugin", "/nonexistent/libtpu.so",
+           "--runs", "1", "--warmup", "1", "--time_scale", "0.0001",
+           "--size_scale", "0.00001", "--no_topology",
+           "--base_path", str(REPO)]
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=60,
+        env={**os.environ, "DLNB_PJRT_EXECUTOR": "plugin"})
+    assert out.returncode != 0
+    assert "plugin" in out.stderr
+
+
+def test_native_pjrt_devices_validation(native_bin):
+    """--devices shorter than world is a startup error (reference -d
+    semantics, utils.hpp:62-71)."""
+    cmd = [str(native_bin / "dp"), "--model", "gpt2_l_16_bfloat16",
+           "--world", "4", "--num_buckets", "2", "--backend", "pjrt",
+           "--devices", "0,1", "--no_topology", "--base_path", str(REPO)]
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=60)
+    assert out.returncode != 0
+    assert "devices" in out.stderr
+
+
+def test_native_pjrt_real_plugin(native_bin):
+    """End-to-end on the real PJRT plugin (libtpu) when a device is
+    reachable: world=1 degenerate collectives still compile, cache, and
+    execute on the TPU runtime (VERDICT r1 #1 done-criterion)."""
+    import os
+    probe = subprocess.run([str(native_bin / "pjrt_probe")],
+                           capture_output=True, text=True, timeout=120)
+    report = json.loads(probe.stdout)
+    if not report.get("available"):
+        pytest.skip(f"no usable PJRT plugin: {report.get('reason', '?')}")
+    rec = run_proxy(native_bin, "dp", "--backend", "pjrt",
+                    "--num_buckets", "2", world=1,
+                    env={"DLNB_PJRT_EXECUTOR": "plugin"})
+    g = rec["global"]
+    assert g["backend"] == "pjrt"
+    assert g["pjrt_executor"] != "host"
+    assert g["cache_misses"] >= 1
 
 
 def test_loop_binaries_exist(native_bin):
