@@ -73,20 +73,25 @@ def ring_shift(x, axis: str, shift: int = 1):
     return lax.ppermute(x, axis, perm)
 
 
-def shift_up(x, axis: str):
+def shift_up(x, axis: str, senders=None):
     """Stage s -> stage s+1 edge transfer (forward activations).  Non-ring:
     the last stage's output is dropped and the first stage receives zeros,
     encoding GPipe's 'stage 0 has no upstream' asymmetry as a masked
-    permute (SURVEY.md §7.3 hard-part 3)."""
+    permute (SURVEY.md §7.3 hard-part 3).  ``senders`` (static iterable of
+    stage ids) restricts the edges further — fill/drain pipeline ticks use
+    it so an edge carries exactly one message per microbatch while the
+    permute still synchronizes the whole axis every tick."""
     n = lax.axis_size(axis)
-    perm = [(i, i + 1) for i in range(n - 1)]
+    allowed = set(range(n - 1)) if senders is None else set(senders)
+    perm = [(i, i + 1) for i in range(n - 1) if i in allowed]
     return lax.ppermute(x, axis, perm)
 
 
-def shift_down(x, axis: str):
+def shift_down(x, axis: str, senders=None):
     """Stage s -> stage s-1 edge transfer (backward gradients)."""
     n = lax.axis_size(axis)
-    perm = [(i, i - 1) for i in range(1, n)]
+    allowed = set(range(1, n)) if senders is None else set(senders)
+    perm = [(i, i - 1) for i in range(1, n) if i in allowed]
     return lax.ppermute(x, axis, perm)
 
 

@@ -57,6 +57,25 @@ def burn(state, iters: int):
     return lax.fori_loop(0, iters, body, state, unroll=False)
 
 
+def burn_if(state, iters: int, active):
+    """Advance the chain ``iters`` times when ``active`` (a traced bool —
+    typically derived from a mesh axis index), else do ~0 work: the
+    rank-predicated trip count that lets one SPMD program express
+    stage-gated pipeline compute (GPipe fill/drain ticks where idle stages
+    participate in the hop but not the burn).  The dynamic bound lowers to
+    ``lax.while_loop``, so the idle branch costs one predicate check."""
+    if iters <= 0:
+        return state
+    scale = 1.0 / state.shape[-1]
+
+    def body(_, s):
+        p = jnp.dot(s, s, preferred_element_type=jnp.float32)
+        return jnp.tanh(p * scale).astype(s.dtype)
+
+    n = jnp.where(active, jnp.int32(iters), jnp.int32(0))
+    return lax.fori_loop(0, n, body, state, unroll=False)
+
+
 @dataclasses.dataclass(frozen=True)
 class BurnCalibration:
     ns_per_iter: float

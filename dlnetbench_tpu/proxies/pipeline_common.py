@@ -133,82 +133,138 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                 outs.append(a)
         return outs
 
+    S, M = num_stages, num_microbatches
+    # the pipeline clock: both schedules take M + S - 1 ticks per
+    # direction — the GPipe fill/drain bubble the reference realizes with
+    # blocking recv chains (hybrid_2d.cpp:106-133: stage s's first compute
+    # is serialized behind s upstream computes).  One SPMD program cannot
+    # block per-stage, so idle ticks are stage-GATED burns instead
+    # (rank-predicated trip count, burnlib.burn_if) while the hop keeps
+    # every device participating (masked ppermute with per-tick sender
+    # sets, so each edge still carries exactly M messages per direction).
+    ticks_per_direction = M + S - 1
+    # static per-tick sender sets — shared by the schedule bodies, the
+    # hop-only variant, and the emitted counts, so they cannot drift.
+    # gpipe: stage s computes mb k at tick s+k (fwd) / (S-1-s)+k (bwd)
+    gp_fwd_senders = [[s for s in range(S - 1) if s <= t < s + M]
+                      for t in range(ticks_per_direction)]
+    gp_bwd_senders = [[s for s in range(1, S)
+                       if (S - 1 - s) <= t < (S - 1 - s) + M]
+                      for t in range(ticks_per_direction)]
+    # 1f1b: warmup fill (stage s's k-th warm fwd at tick s+k), M steady
+    # fwd/bwd pairs, and a drain where stage s's bwds spill (S-1-s) ticks
+    fill_senders = [[s for s in range(min(t + 1, S - 1)) if t - s < M]
+                    for t in range(S - 1)]
+    steady_f_senders = [[s for s in range(S - 1) if (S - 1 - s + i) < M]
+                        for i in range(M)]
+    steady_b_senders = [[s for s in range(1, S) if i >= (S - 1 - s)]
+                        for i in range(M)]
+    drain_senders = [[s for s in range(1, S)
+                      if (S - 1 - s) - M <= d < (S - 1 - s)]
+                     for d in range(S - 1)]
+    if schedule == "gpipe":
+        _sender_tables = (gp_fwd_senders, gp_bwd_senders)
+    else:
+        _sender_tables = (fill_senders, steady_f_senders,
+                          steady_b_senders, drain_senders)
+    # permute ops per iteration and total edge messages (must be exactly
+    # one per microbatch per edge per direction — the masking invariant)
+    pp_permute_ticks = sum(1 for tab in _sender_tables for x in tab if x)
+    pp_edge_messages = sum(len(x) for tab in _sender_tables for x in tab)
+    assert pp_edge_messages == 2 * M * (S - 1), \
+        f"sender masks lost messages: {pp_edge_messages} != {2 * M * (S-1)}"
+
     def step(state, act_b, act2_b, grad_b, tp_b, a2a_b, ne_b, ex_b, *,
              with_compute: bool, with_comm: bool):
-        def burn_(s, iters):
-            return burnlib.burn(s, iters) if with_compute else s
+        def burn_(s, iters, active=None):
+            if not with_compute:
+                return s
+            if active is None:
+                return burnlib.burn(s, iters)
+            return burnlib.burn_if(s, iters, active)
 
         bufs = {"tp": tp_b, "a2a": a2a_b}
         outs = []
         cur = act_b
-
-        def fwd_tick(state, cur):
-            state = burn_(state, fwd_iters)
-            if with_comm:
-                cur = col.shift_up(col.tie(cur, state), AXIS_PP)
-            state = col.tie(state, cur)
-            outs.extend(inner_comms(state, bufs, with_comm))
-            return state, cur
-
-        def bwd_tick(state, cur):
-            state = burn_(state, bwd_iters)
-            if with_comm:
-                cur = col.shift_down(col.tie(cur, state), AXIS_PP)
-            state = col.tie(state, cur)
-            outs.extend(inner_comms(state, bufs, with_comm))
-            return state, cur
+        stage = col.axis_index(AXIS_PP)
 
         if schedule == "gpipe":
-            # phase 1: all microbatches forward (hybrid_2d.cpp:106-133);
-            # phase 2: all backward, mirrored (hybrid_2d.cpp:135-161)
-            for _ in range(num_microbatches):
-                state, cur = fwd_tick(state, cur)
-            for _ in range(num_microbatches):
-                state, cur = bwd_tick(state, cur)
-        else:  # 1f1b: warmup fwd, steady interleave, cooldown bwd
+            # phase 1 — forward, T = M+S-1 ticks: stage s computes mb k at
+            # tick s+k (active window [s, s+M)); senders are the stages
+            # whose window covers the tick, so edge s->s+1 moves one
+            # message per microbatch and idle stages only sync the permute
+            for t in range(ticks_per_direction):
+                active = (stage <= t) & (t < stage + M)
+                state = burn_(state, fwd_iters, active)
+                senders = gp_fwd_senders[t]
+                if with_comm and senders:
+                    cur = col.shift_up(col.tie(cur, state), AXIS_PP, senders)
+                state = col.tie(state, cur)
+                if t >= S - 1:  # one mb wave completes per steady tick
+                    outs.extend(inner_comms(state, bufs, with_comm))
+            # phase 2 — backward, mirrored: stage s active [(S-1-s),
+            # (S-1-s)+M), wave flows from the last stage down
+            for t in range(ticks_per_direction):
+                off = (S - 1) - stage
+                active = (off <= t) & (t < off + M)
+                state = burn_(state, bwd_iters, active)
+                senders = gp_bwd_senders[t]
+                if with_comm and senders:
+                    cur = col.shift_down(col.tie(cur, state), AXIS_PP,
+                                         senders)
+                state = col.tie(state, cur)
+                if t >= S - 1:
+                    outs.extend(inner_comms(state, bufs, with_comm))
+        else:  # 1f1b: fill / steady pairs / drain, same (M+S-1)-tick clock
             # Unlike the GPipe ticks (blocking send: inner comms tie on the
             # hop, matching the reference's serial recv/compute/send +
             # allreduce order), every 1f1b hop is async (native tier:
             # slot-indexed Isend) — inner comms depend only on the burn,
             # and the next tick ties on the hop landing.
-            warm = min(num_stages - 1, num_microbatches)
             cur_b = act2_b
-
-            def fwd_tick_async(state, cur):
-                state = burn_(state, fwd_iters)
-                if with_comm:
-                    cur = col.shift_up(col.tie(cur, state), AXIS_PP)
+            # fill: stage s's k-th warmup fwd at tick s+k, k < S-1-s
+            for t in range(S - 1):
+                active = (stage <= t) & (t - stage < M)
+                state = burn_(state, fwd_iters, active)
+                senders = fill_senders[t]
+                if with_comm and senders:
+                    cur = col.shift_up(col.tie(cur, state), AXIS_PP, senders)
+                state = col.tie(state, cur)
+            # steady: M pair ticks; the up-hop of one microbatch and the
+            # down-hop of another are issued on INDEPENDENT carries
+            # (neither burn nor the other hop depends on them until the
+            # tick ends), so XLA can ride both directions of the
+            # bidirectional links together — the property that makes
+            # 1F1B's comm pattern differ from GPipe's two serial phases
+            for i in range(M):
+                # fwd of mb (S-1-stage)+i while it exists
+                active_f = (S - 1 - stage + i) < M
+                state = burn_(state, fwd_iters, active_f)
+                senders_f = steady_f_senders[i]
+                up = col.shift_up(col.tie(cur, state), AXIS_PP, senders_f) \
+                    if with_comm and senders_f else cur
                 outs.extend(inner_comms(state, bufs, with_comm))
-                return col.tie(state, cur), cur
-
-            def bwd_tick_async(state, cur):
-                state = burn_(state, bwd_iters)
-                if with_comm:
-                    cur = col.shift_down(col.tie(cur, state), AXIS_PP)
-                outs.extend(inner_comms(state, bufs, with_comm))
-                return col.tie(state, cur), cur
-
-            for _ in range(warm):
-                state, cur = fwd_tick_async(state, cur)
-            for _ in range(num_microbatches - warm):
-                # steady pair: the up-hop of microbatch i and the down-hop
-                # of microbatch i-(pp-1) are issued on INDEPENDENT carries
-                # (neither burn nor the other hop depends on them until the
-                # tick ends), so XLA can ride both directions of the
-                # bidirectional links together — the property that makes
-                # 1F1B's comm pattern differ from GPipe's two serial phases
-                state = burn_(state, fwd_iters)
-                up = col.shift_up(col.tie(cur, state), AXIS_PP) \
-                    if with_comm else cur
-                outs.extend(inner_comms(state, bufs, with_comm))
-                state = burn_(state, bwd_iters)
-                down = col.shift_down(col.tie(cur_b, state), AXIS_PP) \
-                    if with_comm else cur_b
+                # bwd of mb i-(S-1-stage) once the bwd wave arrived
+                active_b = i >= (S - 1 - stage)
+                state = burn_(state, bwd_iters, active_b)
+                senders_b = steady_b_senders[i]
+                down = col.shift_down(col.tie(cur_b, state), AXIS_PP,
+                                      senders_b) \
+                    if with_comm and senders_b else cur_b
                 outs.extend(inner_comms(state, bufs, with_comm))
                 cur, cur_b = up, down
                 state = col.tie(col.tie(state, cur), cur_b)
-            for _ in range(warm):
-                state, cur_b = bwd_tick_async(state, cur_b)
+            # drain: stage s's remaining bwds spill (S-1-s) ticks past the
+            # steady phase (bounded below for M < S-1-s)
+            for d in range(S - 1):
+                off = (S - 1) - stage
+                active = (d < off) & (d >= off - M)
+                state = burn_(state, bwd_iters, active)
+                senders = drain_senders[d]
+                if with_comm and senders:
+                    cur_b = col.shift_down(col.tie(cur_b, state), AXIS_PP,
+                                           senders)
+                state = col.tie(state, cur_b)
             outs.append(cur_b)
         # phase 3: gradient sync
         if with_comm:
@@ -244,26 +300,36 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         return lambda: jitted(*bufs)
 
     def pp_body(a, a2=None):
+        """Hop-only replay of the schedule's permute ticks (same sender
+        masks as the full step, burns elided)."""
         outs = []
         if schedule == "gpipe":
-            for _ in range(num_microbatches):
-                a = col.shift_up(a, AXIS_PP)
-                outs.append(a)
-            for _ in range(num_microbatches):
-                a = col.shift_down(a, AXIS_PP)
-                outs.append(a)
+            for senders in gp_fwd_senders:
+                if senders:
+                    a = col.shift_up(a, AXIS_PP, senders)
+                    outs.append(a)
+            for senders in gp_bwd_senders:
+                if senders:
+                    a = col.shift_down(a, AXIS_PP, senders)
+                    outs.append(a)
         else:  # 1f1b: steady pairs on independent carries (overlappable)
-            warm = min(num_stages - 1, num_microbatches)
-            for _ in range(warm):
-                a = col.shift_up(a, AXIS_PP)
-                outs.append(a)
-            for _ in range(num_microbatches - warm):
-                a = col.shift_up(a, AXIS_PP)
-                a2 = col.shift_down(a2, AXIS_PP)
-                outs += [a, a2]
-            for _ in range(warm):
-                a2 = col.shift_down(a2, AXIS_PP)
-                outs.append(a2)
+            for senders in fill_senders:
+                if senders:
+                    a = col.shift_up(a, AXIS_PP, senders)
+                    outs.append(a)
+            for i in range(M):
+                senders_f = steady_f_senders[i]
+                senders_b = steady_b_senders[i]
+                if senders_f:
+                    a = col.shift_up(a, AXIS_PP, senders_f)
+                    outs.append(a)
+                if senders_b:
+                    a2 = col.shift_down(a2, AXIS_PP, senders_b)
+                    outs.append(a2)
+            for senders in drain_senders:
+                if senders:
+                    a2 = col.shift_down(a2, AXIS_PP, senders)
+                    outs.append(a2)
         return col.fence(*outs)
 
     pp_bufs = (act,) if schedule == "gpipe" else (act, act2_in)
@@ -308,6 +374,11 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         "num_expert_shards": num_expert_shards if mode == "moe" else 0,
         "num_microbatches": num_microbatches,
         "schedule": schedule,
+        # both schedules pay the (S-1)-tick fill/drain bubble; analysis can
+        # divide runtime by this to recover per-tick cost
+        "ticks_per_direction": ticks_per_direction,
+        "pp_permute_ticks": pp_permute_ticks,
+        "pp_edge_messages": pp_edge_messages,
         "layers_per_stage": sched.layers_per_stage,
         "pipe_msg_bytes": int(pipe_elems * itemsize),
         "schedule_pipe_msg_bytes": int(sched.pipe_msg_elems

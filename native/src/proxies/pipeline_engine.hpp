@@ -46,6 +46,11 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
   meta["num_stages"] = p.grid.pp;
   meta["num_microbatches"] = p.num_microbatches;
   meta["schedule"] = spec.schedule;
+  // fill/drain bubble clock: stage s's first compute serializes behind s
+  // upstream computes through the blocking rendezvous send/recv chain
+  // (reference hybrid_2d.cpp:106-133), so measured runtime spans
+  // (M + S - 1) ticks per direction, not M — same clock as the JAX tier
+  meta["ticks_per_direction"] = p.num_microbatches + p.grid.pp - 1;
   meta["dp"] = p.grid.dp;
   meta["layers_per_stage"] = p.layers_per_stage;
   meta["pipe_msg_bytes"] = static_cast<i64>(

@@ -128,3 +128,90 @@ def test_unknown_schedule_rejected(eight_devices):
     with pytest.raises(ValueError, match="schedule"):
         hybrid_2d.build(stats, card, CFG, num_stages=2, num_microbatches=4,
                         schedule="zb")
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_bubble_modeled(eight_devices, schedule):
+    """The fill/drain bubble (reference hybrid_2d.cpp:106-133: stage s's
+    first compute serialized behind s upstream computes) must show in
+    measured runtime: at fixed S*M the per-iteration wall time scales with
+    (M + S - 1)/(S*M), NOT with M/(S*M) as a bubble-free steady-state
+    schedule would.
+
+    S=2,M=8 -> 9 tick-units of 1/16 model time; S=4,M=4 -> 7 tick-units.
+    Bubble modeled: t(S=4)/t(S=2) ~ 7/9 = 0.78; bubble missing: ~ 0.5."""
+    from dlnetbench_tpu.core.model_card import load_model_card
+    stats = _stats("gpt2_l_16_bfloat16")
+    card = load_model_card("gpt2_l")
+    cfg = ProxyConfig(warmup=2, runs=3, size_scale=1e-6, time_scale=0.5)
+
+    times = {}
+    for S, M in ((2, 8), (4, 4)):
+        bundle = hybrid_2d.build(stats, card, cfg, num_stages=S,
+                                 num_microbatches=M, dp=1,
+                                 schedule=schedule,
+                                 devices=eight_devices[:S])
+        assert bundle.global_meta["ticks_per_direction"] == M + S - 1
+        # the masking invariant: every edge still carries exactly one
+        # message per microbatch per direction despite the extra ticks
+        assert bundle.global_meta["pp_edge_messages"] == 2 * M * (S - 1)
+        res = run_proxy("hybrid_2d", bundle, cfg)
+        times[S] = min(res.timers_us["runtimes"])
+
+    ratio = times[4] / times[2]
+    # analytic: 7/9 = 0.78 with the bubble, 0.5 without; generous noise
+    # margins still separate the two cleanly
+    assert 0.62 < ratio < 0.95, (
+        f"{schedule}: t(S=4)/t(S=2) = {ratio:.3f}; expected ~0.78 "
+        f"(bubble modeled) — 0.5 means the fill/drain bubble is missing")
+
+
+def test_1f1b_updown_hops_independent_gpipe_chained(eight_devices):
+    """VERDICT r1 #5: the 1F1B overlap claim, verified against the program
+    rather than asserted.  Whether the up and down pipe hops of a steady
+    1F1B pair can ride the bidirectional links together is a dataflow
+    property — XLA may only overlap ops with no dependency path between
+    them.  This must hold in the traced program (and fail if the
+    independent-carry structure regresses); GPipe's hops must instead form
+    one serial chain, which is what makes its two phases serial."""
+    from dlnetbench_tpu.core.model_card import load_model_card
+    from dlnetbench_tpu.metrics.profiling import permute_dependencies
+
+    stats = _stats("gpt2_l_16_bfloat16")
+    card = load_model_card("gpt2_l")
+    cfg = ProxyConfig(warmup=1, runs=1, size_scale=1e-5, time_scale=1e-5)
+    S, M = 4, 8
+
+    deps_of = {}
+    for sch in ("gpipe", "1f1b"):
+        bundle = hybrid_2d.build(stats, card, cfg, num_stages=S,
+                                 num_microbatches=M, dp=1, schedule=sch,
+                                 devices=eight_devices[:S])
+        n, deps = permute_dependencies(bundle.variants["pp_comm"])
+        deps_of[sch] = (n, deps)
+
+    # gpipe: every later hop transitively depends on every earlier one
+    n, deps = deps_of["gpipe"]
+    assert n > 0
+    assert all((i, i + 1) in deps for i in range(n - 1)), \
+        "GPipe hops must form a serial chain"
+
+    # 1f1b: the steady phase interleaves up/down on independent carries —
+    # most adjacent pairs must be mutually schedulable (no dependency)
+    n, deps = deps_of["1f1b"]
+    indep = [i for i in range(n - 1) if (i, i + 1) not in deps]
+    # S-1 fill hops chain; of the remaining adjacent pairs the steady
+    # up/down interleave must be independent (allow edge effects)
+    assert len(indep) >= M, \
+        f"1F1B lost its up/down overlap structure: only {indep}"
+
+    # the same property must survive in the full comm program (burns and
+    # gradient sync included), not just the hop-only variant
+    bundle = hybrid_2d.build(stats, card, cfg, num_stages=S,
+                             num_microbatches=M, dp=1, schedule="1f1b",
+                             devices=eight_devices[:S])
+    n_full, deps_full = permute_dependencies(bundle.comm)
+    indep_full = [i for i in range(n_full - 1)
+                  if (i, i + 1) not in deps_full]
+    assert len(indep_full) >= M // 2, \
+        f"full 1F1B program serialized its hops: {indep_full}"
