@@ -93,3 +93,64 @@ def profile_collectives(fn, *args, trace_dir: str | Path | None = None,
     with jax.profiler.trace(d):
         jax.block_until_ready(fn(*args, **kwargs))
     return collective_stats(load_trace_events(d))
+
+
+# ---------------------------------------------------------------------
+# Structural overlap analysis.  Whether two collectives CAN ride the
+# links together is a property of the program's dataflow: XLA may only
+# overlap ops with no dependency path between them.  A CPU-mesh trace
+# cannot show device-channel overlap (host thunks timeshare cores), so
+# the schedulability check is done on the jaxpr — 1F1B's steady up/down
+# hop pairs must be mutually independent, GPipe's hops must chain.
+
+def _iter_subjaxprs(jaxpr):
+    """The jaxpr and every nested sub-jaxpr (pjit / shard_map / scan...)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", v)
+            if hasattr(inner, "eqns"):
+                yield from _iter_subjaxprs(inner)
+
+
+def permute_dependencies(fn, *args) -> tuple[int, set[tuple[int, int]]]:
+    """Trace ``fn`` and analyze its ``ppermute`` ops' mutual dataflow.
+
+    Returns ``(n_permutes, deps)`` where ``deps`` holds ordered pairs
+    ``(i, j)``: the j-th permute (program order) transitively consumes the
+    i-th's output, so the two can never be in flight together.  Pairs
+    absent from ``deps`` are schedulable concurrently by XLA — the 1F1B
+    overlap property is ``(i, i+1) not in deps`` for its steady pairs.
+    """
+    closed = jax.make_jaxpr(fn)(*args)
+    # find the (deepest) jaxpr level that actually contains the permutes
+    level = None
+    for j in _iter_subjaxprs(closed.jaxpr):
+        if any(e.primitive.name == "ppermute" for e in j.eqns):
+            level = j
+            break
+    if level is None:
+        return 0, set()
+
+    producer: dict = {}            # var -> eqn index
+    depsets: list[set] = []        # eqn index -> transitive eqn deps
+    permute_eqns: list[int] = []
+    for idx, eqn in enumerate(level.eqns):
+        deps: set = set()
+        for v in eqn.invars:
+            if hasattr(v, "count") and v in producer:  # Var, not Literal
+                p = producer[v]
+                deps.add(p)
+                deps |= depsets[p]
+        depsets.append(deps)
+        for v in eqn.outvars:
+            producer[v] = idx
+        if eqn.primitive.name == "ppermute":
+            permute_eqns.append(idx)
+
+    pairs: set[tuple[int, int]] = set()
+    for j_pos, j_eqn in enumerate(permute_eqns):
+        for i_pos, i_eqn in enumerate(permute_eqns[:j_pos]):
+            if i_eqn in depsets[j_eqn]:
+                pairs.add((i_pos, j_pos))
+    return len(permute_eqns), pairs
