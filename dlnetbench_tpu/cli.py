@@ -36,8 +36,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="seconds; when set, runs are estimated from warmup")
     p.add_argument("--loop", action="store_true",
                    help="run the schedule forever (congestor mode)")
-    p.add_argument("--devices", type=int, default=0,
-                   help="use only the first N devices (0 = all)")
+    p.add_argument("-d", "--devices", default="0",
+                   help="device selection: a count N (first N devices, "
+                        "0 = all) or an explicit index list like 0,2,3 "
+                        "(the reference -d flag, utils.hpp:62-71)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. 'cpu'); combine with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N "
@@ -86,10 +88,31 @@ def _add_pipeline(p: argparse.ArgumentParser) -> None:
                         "1f1b = interleaved fwd/bwd, rebuild extra)")
 
 
-def _devices(args):
+def _devices(args, parser):
     import jax
     devs = jax.devices()
-    return devs[:args.devices] if args.devices else devs
+    spec = str(args.devices).strip()
+    if "," in spec:  # explicit index list: arbitrary subset, in order
+        try:
+            indices = [int(tok) for tok in spec.split(",") if tok.strip()]
+        except ValueError:
+            parser.error(f"--devices wants N or a list like 0,2,3, "
+                         f"got {spec!r}")
+        bad = [i for i in indices if not 0 <= i < len(devs)]
+        if bad:
+            parser.error(f"--devices indices {bad} out of range "
+                         f"(have {len(devs)} devices)")
+        if len(set(indices)) != len(indices):
+            parser.error(f"--devices has duplicate indices: {spec}")
+        return [devs[i] for i in indices]
+    try:
+        count = int(spec)
+    except ValueError:
+        parser.error(f"--devices wants N or a list like 0,2,3, got {spec!r}")
+    if count < 0 or count > len(devs):
+        parser.error(f"--devices {count} out of range "
+                     f"(have {len(devs)} devices)")
+    return devs[:count] if count else devs
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -167,7 +190,7 @@ def main(argv: list[str] | None = None) -> int:
         stats = load_model_stats(args.model, args.stats_dir)
     except FileNotFoundError as e:
         parser.error(str(e))
-    devices = _devices(args)
+    devices = _devices(args, parser)
 
     # startup fabric graph (reference print_topology_graph at every proxy's
     # startup, cpp/netcommunicators.hpp:142); stderr keeps stdout pure JSON

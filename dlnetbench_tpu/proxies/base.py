@@ -111,30 +111,53 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
     if energy_sampler is None and cfg.measure_energy:
         from dlnetbench_tpu.metrics.energy import detect_sampler
         energy_sampler = detect_sampler()
+    if energy_sampler is not None:
+        # which sensor produced energy_consumed — misattribution (wrong
+        # hwmon device) must be visible in the record, not silent
+        bundle.global_meta["energy_source"] = getattr(
+            energy_sampler, "source", type(energy_sampler).__name__)
+
+    # Interleaved A/B measurement: each full run is paired with an
+    # immediately adjacent compute-only run, so barrier_time[i] =
+    # full[i] - compute[i] uses a MATCHED sample — run-to-run compute
+    # variance (clock drift, co-tenancy) hits both sides of the
+    # subtraction instead of leaking into the exposed-comm signal the way
+    # a full[i] - mean(compute) estimate would.  The reference gets this
+    # for free by bracketing WaitAll inside the same iteration
+    # (dp.cpp:191); the decomposition channel has to earn it.
+    measure_compute = cfg.measure_compute_only and bundle.compute is not None
+    if measure_compute:
+        time_callable(bundle.compute, reps=1)  # compile outside the A/B loop
 
     timers: dict[str, list] = {}
-    if energy_sampler is not None:
-        # One bracket around the whole measured phase, amortized to a
-        # per-run mean (reference energy_consumed arrays,
-        # plots/parser.py:172).  Per-run brackets would fold the
-        # transfer-fence host spin (utils/timing.py) into each sample on
-        # the tunnel backend; amortizing keeps that harness overhead a
-        # constant offset that cancels when configs are compared.
-        e0 = energy_sampler.read_joules()
-        full_s = time_callable(bundle.full, reps=runs)
-        per_run_j = max(0.0, energy_sampler.read_joules() - e0) / runs
-        timers["energy_consumed"] = [per_run_j] * runs
-    else:
-        full_s = time_callable(bundle.full, reps=runs)
+    full_s: list[float] = []
+    comp_s: list[float] = []
+    energy_j: list[float] = []
+    for _ in range(runs):
+        # Energy brackets ONLY the fenced full run (reference per-rank
+        # energy_consumed arrays, plots/parser.py:172) — genuinely per-run.
+        # The RTT-aware transfer fence inside time_callable guarantees the
+        # device work finished before the closing read; its host spin adds
+        # a constant per-run offset that cancels across configs.
+        if energy_sampler is not None:
+            e0 = energy_sampler.read_joules()
+        t_full = time_callable(bundle.full, reps=1)[0]
+        if energy_sampler is not None:
+            energy_j.append(max(0.0, energy_sampler.read_joules() - e0))
+        full_s.append(t_full)
+        if measure_compute:
+            comp_s.append(time_callable(bundle.compute, reps=1)[0])
     timers["runtimes"] = [t * 1e6 for t in full_s]
-
-    if cfg.measure_compute_only and bundle.compute is not None:
-        time_callable(bundle.compute, reps=1)  # compile
-        comp_s = time_callable(bundle.compute, reps=runs)
+    if energy_sampler is not None:
+        timers["energy_consumed"] = energy_j
+        # stop any background polling now that the measured phase is over
+        # (restartable: the cached sampler revives on its next read)
+        from dlnetbench_tpu.metrics.energy import close_sampler
+        close_sampler(energy_sampler)
+    if measure_compute:
         timers["compute_time"] = [t * 1e6 for t in comp_s]
-        mean_comp = sum(comp_s) / len(comp_s)
-        timers["barrier_time"] = [max(0.0, (t - mean_comp)) * 1e6
-                                  for t in full_s]
+        timers["barrier_time"] = [max(0.0, f - c) * 1e6
+                                  for f, c in zip(full_s, comp_s)]
 
     if cfg.measure_comm_only and bundle.comm is not None:
         time_callable(bundle.comm, reps=1)  # compile

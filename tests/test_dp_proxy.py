@@ -104,6 +104,30 @@ def test_cli_dp(tmp_path, eight_devices, capsys):
     assert len(rec["ranks"][0]["runtimes"]) == 2
 
 
+def test_cli_device_list_selection(tmp_path, eight_devices):
+    """--devices accepts an arbitrary index list (reference -d 0,2,3,
+    utils.hpp:62-71), not just a first-N count."""
+    from dlnetbench_tpu.cli import main
+    out = tmp_path / "cli.jsonl"
+    rc = main(["dp", "--model", "gpt2_l_16_bfloat16", "--num_buckets", "2",
+               "-w", "1", "-r", "1", "--devices", "1,3,5",
+               "--size_scale", "1e-5", "--time_scale", "1e-4",
+               "--no_topology", "--out", str(out)])
+    assert rc == 0
+    rec = json.loads(out.read_text().strip())
+    assert rec["global"]["world_size"] == 3
+    assert [r["device_id"] for r in rec["ranks"]] == [1, 3, 5]
+
+
+def test_cli_device_list_rejects_bad_specs(eight_devices, capsys):
+    from dlnetbench_tpu.cli import main
+    for spec in ("0,2,99", "0,0", "abc", "0-3"):
+        with pytest.raises(SystemExit):
+            main(["dp", "--model", "gpt2_l_16_bfloat16", "--num_buckets",
+                  "2", "--devices", spec, "--no_topology"])
+        capsys.readouterr()
+
+
 def test_cli_buffer_dtype_stats(eight_devices, tmp_path):
     """--buffer_dtype stats follows the stat file's Dtype (the reference's
     compile-time bf16/fp8 selection as a runtime switch): bfloat16 buffers
@@ -124,3 +148,37 @@ def test_cli_buffer_dtype_stats(eight_devices, tmp_path):
     f32 = recs["float32"]["global"]["bucket_bytes"]
     bf16 = recs["stats"]["global"]["bucket_bytes"]  # stat file is bfloat16
     assert [b // 2 for b in f32] == list(bf16)
+
+
+def test_barrier_time_uses_matched_compute_samples():
+    """VERDICT r1 #6: barrier_time[i] must be full[i] - compute[i] with an
+    ADJACENT (A/B-interleaved) compute sample, not full[i] minus an
+    averaged compute time — drifting per-run durations would otherwise
+    leak compute variance into the exposed-comm signal."""
+    import time as _time
+    from dlnetbench_tpu.proxies.base import ProxyConfig, StepBundle, run_proxy
+
+    # Call counts include one warmup (full) / compile (compute) call each,
+    # so measured pairs are (20, 18), (30, 28), (40, 38) ms: matched
+    # subtraction gives ~2 ms for every run, while subtracting the MEAN
+    # compute (28 ms) would give ~[0, 2, 12] ms
+    calls = {"full": 0, "comp": 0}
+
+    def full():
+        _time.sleep(0.010 + 0.010 * calls["full"])
+        calls["full"] += 1
+
+    def compute():
+        _time.sleep(0.008 + 0.010 * calls["comp"])
+        calls["comp"] += 1
+
+    bundle = StepBundle(full=full, compute=compute, comm=None,
+                        global_meta={"proxy": "t", "world_size": 1})
+    cfg = ProxyConfig(warmup=1, runs=3, measure_energy=False)
+    res = run_proxy("t", bundle, cfg)
+    barrier_ms = [t / 1000 for t in res.timers_us["barrier_time"]]
+    assert len(barrier_ms) == 3
+    for b in barrier_ms:
+        assert 1.0 < b < 6.0, (
+            f"barrier_time {barrier_ms} — matched samples give ~2 ms each; "
+            "a spread like [0, 2, 12] means a mean-compute subtraction")

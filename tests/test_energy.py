@@ -77,8 +77,9 @@ def test_run_proxy_emits_energy_consumed():
                         global_meta={"proxy": "t", "world_size": 1})
     cfg = ProxyConfig(warmup=1, runs=3)
     res = run_proxy("t", bundle, cfg, energy_sampler=FakeSampler())
-    # one bracket over 3 runs of a 2 J/read counter: 2 J total / 3 runs
-    want = [2.0 / 3] * 3
+    # per-run open/close brackets of a 2 J/read counter: 2 J each run
+    # (genuinely per-run samples, not one amortized bracket)
+    want = [2.0, 2.0, 2.0]
     assert res.timers_us["energy_consumed"] == want
     assert len(res.timers_us["runtimes"]) == 3
 
@@ -108,3 +109,50 @@ def test_pareto_uses_energy_consumed_column():
     })
     ax = plot_pareto(df)
     assert "energy_consumed" in ax.get_ylabel()
+
+
+def _mk_hwmon(root, dev, name, uw="1000000"):
+    d = root / dev
+    d.mkdir()
+    (d / "name").write_text(name)
+    (d / "power1_input").write_text(uw)
+
+
+def test_hwmon_prefers_cpu_package_sensor(tmp_path):
+    """Unconfigured selection must prefer CPU-package-like sensors over the
+    alphabetically-first device (battery/NVMe/wifi misattribution guard),
+    and surface the chosen device in .source."""
+    _mk_hwmon(tmp_path, "hwmon0", "BAT0")          # alphabetically first
+    _mk_hwmon(tmp_path, "hwmon1", "coretemp")      # the CPU-like one
+    s = E.HwmonSampler(root=str(tmp_path))
+    try:
+        assert s.available
+        assert s.source == "hwmon:coretemp"
+    finally:
+        s.close()
+
+
+def test_hwmon_thread_lifecycle(tmp_path):
+    """The 5 ms poller starts lazily on first read and stops on close —
+    no busy thread for the remaining process lifetime (advisor finding)."""
+    _mk_hwmon(tmp_path, "hwmon0", "cpu")
+    s = E.HwmonSampler(root=str(tmp_path))
+    assert s._thread is None            # nothing spinning before use
+    s.read_joules()
+    assert s._thread is not None and s._thread.is_alive()
+    s.close()
+    s._thread.join(timeout=2)
+    assert not s._thread.is_alive()
+    s.read_joules()                     # restartable for the next phase
+    assert s._thread.is_alive()
+    s.close()
+
+
+def test_run_proxy_reports_energy_source():
+    bundle = StepBundle(full=lambda: None, compute=None, comm=None,
+                        global_meta={"proxy": "t", "world_size": 1})
+    sampler = FakeSampler()
+    sampler.source = "fake"
+    res = run_proxy("t", bundle, ProxyConfig(warmup=1, runs=1),
+                    energy_sampler=sampler)
+    assert res.global_meta["energy_source"] == "fake"
