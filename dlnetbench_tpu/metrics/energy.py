@@ -122,18 +122,34 @@ class HwmonSampler:
                       f"{sorted(names.values())}; hwmon sampling disabled",
                       file=sys.stderr)
         else:
-            chosen = next(iter(by_dev), None)
+            # unconfigured: prefer CPU-package-like sensors — the
+            # alphabetically-first device could be a battery, NVMe or
+            # wifi sensor, silently attributing energy to the wrong part
+            preferred = ("cpu", "package", "core", "soc", "rapl")
+            chosen = next((d for d in sorted(by_dev)
+                           if any(p in names[d].lower() for p in preferred)),
+                          next(iter(sorted(by_dev)), None))
         self._inputs = by_dev.get(chosen, []) if chosen else []
+        # surfaced in the emitted record (energy_source) so a
+        # misattributed sensor is visible, not silent
+        self.source = f"hwmon:{names[chosen]}" if self._inputs else ""
         self._joules = 0.0
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        if self._inputs:
-            self._thread = threading.Thread(target=self._loop, daemon=True)
-            self._thread.start()
+        self._thread: threading.Thread | None = None
 
     @property
     def available(self) -> bool:
         return bool(self._inputs)
+
+    def _ensure_running(self):
+        """Lazy-start (or restart after close) the integration thread —
+        the 5 ms poller only spins while a measurement is in progress."""
+        if self._inputs and (self._thread is None
+                             or not self._thread.is_alive()):
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
 
     def _loop(self):
         prev = time.monotonic()
@@ -152,11 +168,20 @@ class HwmonSampler:
             prev = now
 
     def read_joules(self) -> float:
+        self._ensure_running()
         with self._lock:
             return self._joules
 
     def close(self):
+        """Stop the integration thread; a later read_joules restarts it.
+        Joins before returning so a read that follows immediately sees a
+        dead thread and restarts cleanly (otherwise it could observe the
+        stopping-but-alive thread, skip the restart, and integrate
+        nothing for the whole next measured phase)."""
         self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=1.0)
 
 
 _CACHED = None
@@ -171,10 +196,23 @@ def detect_sampler():
     _PROBED = True
     rapl = RaplSampler()
     if rapl.available:
+        rapl.source = "rapl"
         _CACHED = rapl
         return _CACHED
     hw = HwmonSampler()
     if hw.available:
+        # safety net: never leave the poller spinning past process end
+        # even if a caller forgets close_sampler()
+        import atexit
+        atexit.register(hw.close)
         _CACHED = hw
         return _CACHED
     return None
+
+
+def close_sampler(sampler) -> None:
+    """Release a sampler's background resources after a measured phase
+    (restartable — the cached sampler keeps working for later runs)."""
+    close = getattr(sampler, "close", None)
+    if close is not None:
+        close()

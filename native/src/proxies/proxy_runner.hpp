@@ -15,6 +15,7 @@
 
 #include <fstream>
 #include <functional>
+#include <set>
 #include <iostream>
 #include <string>
 #include <sys/stat.h>
@@ -152,6 +153,11 @@ inline ProxyEnv make_env(const Args& args) {
                                std::to_string(env.devices.size()) +
                                " device(s) for world " +
                                std::to_string(env.world));
+    std::set<int> uniq(env.devices.begin(), env.devices.end());
+    if (uniq.size() != env.devices.size())
+      throw std::runtime_error(
+          "--devices has duplicate indices (two replicas cannot share a "
+          "device)");
   }
   return env;
 }
