@@ -253,6 +253,27 @@ def test_loop_mode_runs_forever(native_bin):
         subprocess.run(cmd, capture_output=True, timeout=3)
 
 
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_native_pipeline_bubble(native_bin, schedule):
+    """The native engine realizes the GPipe fill/drain bubble through its
+    blocking rendezvous send/recv chain (reference hybrid_2d.cpp:106-133):
+    at fixed S*M, runtime scales with (M+S-1)/(S*M), not M/(S*M).
+    S=2,M=8 -> 9/16 model-time units; S=4,M=4 -> 7/16; expected ratio
+    ~7/9 = 0.78, vs ~0.5 if stages never waited for upstream compute."""
+    times = {}
+    for S, M in ((2, 8), (4, 4)):
+        rec = run_proxy(native_bin, "hybrid_2d", "--num_stages", S,
+                        "--num_microbatches", M, "--dp", 1,
+                        "--schedule", schedule, "--time_scale", "0.05",
+                        "--runs", 3, world=S)
+        assert rec["global"]["ticks_per_direction"] == M + S - 1
+        times[S] = min(rec["ranks"][0]["runtimes"])
+    ratio = times[4] / times[2]
+    assert 0.62 < ratio < 0.95, (
+        f"{schedule}: t(S=4)/t(S=2) = {ratio:.3f}; expected ~0.78 "
+        f"(bubble present) — ~0.5 means the fill serialization regressed")
+
+
 def test_native_1f1b_schedule(native_bin):
     """1F1B (slot-indexed Isend, per-stage warmup) emits a valid record
     with the schedule tagged and the same pp entry totals as GPipe."""
