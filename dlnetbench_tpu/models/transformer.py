@@ -46,6 +46,14 @@ class TransformerConfig:
                              # measured ~6% faster than full at S=2048 on v5e
                              # for a fraction of full-remat's memory saving)
     attention_impl: str = "auto"   # ops.attention dispatch: auto | flash | xla
+    scan_layers: bool = True       # lax.scan over the layer stack (O(1)
+                             # compile time in depth); False unrolls the
+                             # Python loop — measured ~5% faster at 4 layers
+                             # on v5e (no dynamic-slice save/restore of
+                             # per-layer activations), at O(depth) compile
+    logits_f32: bool = True        # emit f32 logits (training-grade CE
+                             # numerics); False keeps them bf16 — halves
+                             # the [B, S, V] logits traffic for benches
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots"):
@@ -186,16 +194,23 @@ def forward(params: dict, tokens, cfg: TransformerConfig):
                   if cfg.remat_policy == "dots" else None)
         block = jax.checkpoint(_block, static_argnums=(0,), policy=policy)
 
-    def body(carry, lp):
-        return block(cfg, carry, lp, positions), None
+    if cfg.scan_layers:
+        def body(carry, lp):
+            return block(cfg, carry, lp, positions), None
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+        x, _ = jax.lax.scan(body, x, params["layers"])
+    else:
+        for li in range(cfg.num_layers):
+            lp = jax.tree.map(lambda a: a[li], params["layers"])
+            x = block(cfg, x, lp, positions)
     if cfg.gated:
         x = L.rmsnorm(x, params["final_norm"])
     else:
         x = L.layernorm(x, params["final_norm"], params["final_norm_b"])
     head = params["embed"].T if cfg.tied_embeddings else params["head"]
-    return jnp.dot(x, head, preferred_element_type=jnp.float32)
+    return jnp.dot(x, head,
+                   preferred_element_type=(jnp.float32 if cfg.logits_f32
+                                           else x.dtype))
 
 
 def loss_fn(params: dict, tokens, cfg: TransformerConfig):

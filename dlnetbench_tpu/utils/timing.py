@@ -87,32 +87,16 @@ def time_callable(fn, *args, reps: int = 1, **kwargs) -> list[float]:
     for _ in range(reps):
         t0 = time.perf_counter()
         res = fn(*args, **kwargs)
-        jax.block_until_ready(res)
-        # only subtract the RTT when a fence round-trip actually happened
+        # the transfer fence IS the wait; block_until_ready is only the
+        # fallback for empty results — on the tunnel backend it costs a
+        # dispatch-ack round-trip per output leaf (~100 ms for a params
+        # pytree) without actually fencing anything
         fenced = _transfer_fence(res) if fence_transfer else False
+        if not fenced:
+            jax.block_until_ready(res)
         out.append(max(0.0,
                        time.perf_counter() - t0 - (rtt if fenced else 0.0)))
     return out
-
-
-def time_pipelined(fn, *args, iters: int = 20, **kwargs) -> float:
-    """Amortized per-iteration seconds: enqueue ``iters`` calls back-to-back
-    and fence ONCE.  Per-rep fencing (time_callable) pays the tunnel RTT on
-    every rep, which swamps millisecond-scale kernels with RTT jitter;
-    here the RTT is paid (and subtracted) once, so the resolution is
-    ~RTT/iters.  Use for throughput-style measurement; use time_callable
-    when per-run samples are needed (the proxy harness).  Caller need not
-    pre-warm: the first call is fenced out of the timed region."""
-    res = fn(*args, **kwargs)
-    jax.block_until_ready(res)
-    fenced_warm = _transfer_fence(res)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        res = fn(*args, **kwargs)
-    jax.block_until_ready(res)
-    fenced = _transfer_fence(res) if fenced_warm else False
-    el = time.perf_counter() - t0 - (tunnel_rtt_s() if fenced else 0.0)
-    return max(0.0, el / iters)
 
 
 def median_us(samples_s: list[float]) -> float:
