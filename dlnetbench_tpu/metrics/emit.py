@@ -28,10 +28,21 @@ from dlnetbench_tpu.proxies.base import ProxyResult
 SCHEMA_VERSION = 1
 
 
+def _process_identity() -> tuple[int, int]:
+    """(this process's index, process count) — the multi-controller
+    coordinates a multi-host merge keys on; (0, 1) without a runtime."""
+    try:
+        import jax
+        return jax.process_index(), jax.process_count()
+    except Exception:  # jax absent or uninitialized: single-process
+        return 0, 1
+
+
 def result_to_record(result: ProxyResult) -> dict:
     mesh_info = result.global_meta.get("mesh", {})
     devices = mesh_info.get("devices", [{"id": 0, "process": 0}])
     hostname = socket.gethostname()
+    proc, num_procs = _process_identity()
     ranks = []
     for i, dev in enumerate(devices):
         row = {
@@ -43,10 +54,16 @@ def result_to_record(result: ProxyResult) -> dict:
         }
         row.update(result.timers_us)
         ranks.append(row)
+    g = {k: v for k, v in result.global_meta.items() if k != "mesh"}
+    if num_procs > 1:
+        g.setdefault("num_processes", num_procs)
     return {
         "section": result.name,
         "version": SCHEMA_VERSION,
-        "global": {k: v for k, v in result.global_meta.items() if k != "mesh"},
+        # which process measured this record's clocks — metrics.merge
+        # keeps exactly the rows owned by it (multi-host reassembly)
+        "process": proc,
+        "global": g,
         "mesh": {k: v for k, v in mesh_info.items() if k != "devices"},
         "num_runs": result.num_runs,
         "warmup_times": result.warmup_times_us,

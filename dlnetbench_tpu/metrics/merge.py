@@ -1,0 +1,142 @@
+"""Merge one-record-per-process multi-host outputs into a single record.
+
+The reference's native tier prints per-rank JSON from every MPI rank into
+one job stdout, so its parser sees genuinely per-rank timers
+(reference cpp/data_parallel/dp.cpp:291-294, plots/parser.py:139-196).
+The rebuild's multi-controller runtime has one *process* per host: each
+process measures its own wall-clock timers and emits one record whose
+rank rows cover every device of the global mesh — but only the rows of
+the emitting process carry that process's real measurements (emit.py
+documents the duplication).
+
+``merge_records`` reassembles the reference's shape: given the records
+the N processes wrote (one JSONL file per process, or one combined
+file), it keeps from each record exactly the rows measured by the
+emitting process and returns one record with true per-process timers.
+Rank coverage and process coverage are validated; mismatched schedule
+metadata aborts the merge (records from different runs must never
+silently combine).
+
+CLI:  python -m dlnetbench_tpu.metrics.merge out.jsonl in0.jsonl in1.jsonl ...
+"""
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+from dlnetbench_tpu.metrics.parser import load_records, validate_record
+
+# global keys that legitimately differ between the emitting processes
+_VOLATILE_GLOBALS = {"energy_source"}
+
+
+def _comparable_global(g: dict) -> dict:
+    return {k: v for k, v in g.items() if k not in _VOLATILE_GLOBALS}
+
+
+def merge_records(records: list[dict]) -> dict:
+    """Combine per-process records of ONE run into a single record.
+
+    Each input record contributes the rank rows whose ``process_index``
+    equals its emitting ``process`` (every process measures only its own
+    clock).  The result carries the union of rows, per-process warmup
+    times, and process-0's globals.
+    """
+    if not records:
+        raise ValueError("merge_records: no records given")
+    by_process: dict[int, dict] = {}
+    for rec in records:
+        proc = rec.get("process", 0)
+        if proc in by_process:
+            raise ValueError(
+                f"merge_records: two records claim process {proc} — inputs "
+                f"must be one record per process of one run")
+        by_process[proc] = rec
+
+    base = by_process.get(0)
+    if base is None:
+        raise ValueError("merge_records: no record from process 0")
+    want = _comparable_global(base["global"])
+    for proc, rec in sorted(by_process.items()):
+        if rec.get("section") != base.get("section"):
+            raise ValueError(
+                f"merge_records: section mismatch "
+                f"({rec.get('section')!r} vs {base.get('section')!r})")
+        if _comparable_global(rec["global"]) != want:
+            diff = {k for k in set(want) | set(_comparable_global(rec["global"]))
+                    if want.get(k) != rec["global"].get(k)}
+            raise ValueError(
+                f"merge_records: process {proc} global metadata differs on "
+                f"{sorted(diff)} — records are not from the same run")
+        if rec.get("num_runs") != base.get("num_runs"):
+            raise ValueError(
+                f"merge_records: process {proc} ran {rec.get('num_runs')} "
+                f"iterations, process 0 ran {base.get('num_runs')}")
+
+    declared = base["global"].get("num_processes")
+    if declared is not None and sorted(by_process) != list(range(declared)):
+        raise ValueError(
+            f"merge_records: have records from processes {sorted(by_process)}"
+            f", expected range({declared}) — a host's output is missing")
+
+    ranks = []
+    for proc, rec in sorted(by_process.items()):
+        local = [row for row in rec.get("ranks", [])
+                 if row.get("process_index", 0) == proc]
+        if not local:
+            raise ValueError(
+                f"merge_records: process {proc}'s record has no rows for "
+                f"its own process_index")
+        ranks.extend(local)
+    ranks.sort(key=lambda row: row["rank"])
+
+    merged = {k: v for k, v in base.items() if k != "ranks"}
+    merged["ranks"] = ranks
+    merged["warmup_times_by_process"] = {
+        str(proc): rec.get("warmup_times", [])
+        for proc, rec in sorted(by_process.items())
+    }
+    validate_record(merged)
+    return merged
+
+
+def merge_files(out_path: str | Path, in_paths: list[str | Path],
+                section: str | None = None) -> dict:
+    """Load one record per input file (per process), merge, append the
+    merged record to ``out_path``."""
+    records = []
+    for p in in_paths:
+        recs = load_records(p, section)
+        if len(recs) != 1:
+            raise ValueError(
+                f"{p}: expected exactly one record"
+                f"{f' for section {section!r}' if section else ''}, "
+                f"found {len(recs)} — merge one run at a time")
+        records.append(recs[0])
+    merged = merge_records(records)
+    with open(out_path, "a") as f:
+        f.write(json.dumps(merged) + "\n")
+    return merged
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    section = None
+    if args and args[0] == "--section":
+        section = args[1]
+        args = args[2:]
+    if len(args) < 2:
+        print("usage: python -m dlnetbench_tpu.metrics.merge "
+              "[--section NAME] OUT.jsonl IN0.jsonl IN1.jsonl ...",
+              file=sys.stderr)
+        return 2
+    merged = merge_files(args[0], args[1:], section)
+    print(f"merged {len(args) - 1} process record(s): "
+          f"{merged['section']}, {len(merged['ranks'])} ranks "
+          f"-> {args[0]}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,21 +37,36 @@ def load_records(path: str | Path, section: str | None = None) -> list[dict]:
 
 def validate_record(rec: dict) -> None:
     """Every device rank must have reported (reference
-    plots/parser.py:102-136 'did every rank report' check)."""
+    plots/parser.py:102-136 'did every rank report'), every declared
+    process must be represented, and the host set must be plausible for
+    the process count (the reference's hostname-vs-node-count check)."""
     world = rec["global"].get("world_size")
-    ranks = [r["rank"] for r in rec.get("ranks", [])]
+    rows = rec.get("ranks", [])
+    ranks = [r["rank"] for r in rows]
     if world is not None and sorted(ranks) != list(range(world)):
         raise ValueError(
             f"record for {rec.get('section')}/{rec['global'].get('model')}: "
             f"rank set {sorted(ranks)} != range({world})")
     n = rec.get("num_runs")
-    for row in rec.get("ranks", []):
+    for row in rows:
         for k, v in row.items():
             if k not in _TIMER_KEYS_EXCLUDE and isinstance(v, list) and n \
                     and len(v) != n:
                 raise ValueError(
                     f"rank {row['rank']} timer {k!r} has {len(v)} entries, "
                     f"expected {n}")
+    num_procs = rec["global"].get("num_processes")
+    if num_procs is not None:
+        procs = sorted({row.get("process_index", 0) for row in rows})
+        if procs != list(range(num_procs)):
+            raise ValueError(
+                f"record for {rec.get('section')}: process coverage "
+                f"{procs} != range({num_procs}) — a host did not report")
+        hosts = {row.get("hostname") for row in rows}
+        if len(hosts) > num_procs:
+            raise ValueError(
+                f"record for {rec.get('section')}: {len(hosts)} distinct "
+                f"hostnames for {num_procs} processes")
 
 
 def records_to_dataframe(records: list[dict], validate: bool = True):

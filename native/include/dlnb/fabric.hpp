@@ -18,6 +18,7 @@
 #include <functional>
 #include <memory>
 #include <string>
+#include <vector>
 
 #include "dlnb/communicator.hpp"
 #include "dlnb/json.hpp"
@@ -41,7 +42,18 @@ class Fabric {
       int world_rank, int color, const std::string& name) = 0;
 
   // Run body(rank) on world_size threads; rethrows the first rank failure.
+  // (Cross-process fabrics run body once, for this process's rank.)
   virtual void launch(const std::function<void(int)>& body) = 0;
+
+  // Ranks measured BY THIS PROCESS (record rows to emit); in-process
+  // fabrics own the whole world, cross-process fabrics their one rank.
+  virtual std::vector<int> local_ranks() const {
+    std::vector<int> all(world_size());
+    for (int i = 0; i < world_size(); ++i) all[i] = i;
+    return all;
+  }
+  // This process's index in a multi-process run (metrics.merge key).
+  virtual int process_index() const { return 0; }
 
   // Enrich the emitted record: backend/platform identity into `meta`,
   // device fabric description (and compile-cache stats) into `mesh`.

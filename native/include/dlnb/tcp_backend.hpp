@@ -1,0 +1,671 @@
+// Cross-process rank fabric over TCP sockets — the native tier's
+// multi-process path.
+//
+// The reference goes multi-process by launching N MPI ranks and
+// bootstrapping vendor communicators over them (reference
+// cpp/data_parallel/dp.cpp:166-189: MPI_Init + ncclUniqueId broadcast).
+// There is no MPI on a TPU host image, so the rebuild bootstraps the way
+// NCCL itself does under the hood: rank 0 listens on a well-known
+// address (the ncclUniqueId role), every rank announces itself and its
+// own listen port, rank 0 broadcasts the address book, and the ranks
+// dial each other into a FULL MESH of pairwise sockets.
+//
+// Collectives are symmetric (no coordinator in the data path): every
+// group member sends its buffer to every other member and reduces
+// locally — the same each-rank-computes-its-own-output model as the
+// in-process ShmFabric, so the two fabrics are behaviorally
+// interchangeable behind the Fabric interface.  Framing carries
+// (comm id, slot, sequence, op, element count, tag), a per-peer reader
+// thread demultiplexes frames into an inbox, and mismatched op/count
+// across ranks aborts with a clear error instead of hanging.
+//
+// Communicator splits need no extra round-trips: colors are allgathered
+// over the world communicator and every process derives the same group
+// memberships and the same new comm id (splits are collective and
+// ordered, exactly MPI_Comm_split's contract).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dlnb/communicator.hpp"
+#include "dlnb/fabric.hpp"
+#include "dlnb/shm_backend.hpp"  // SlotWorker (stream-per-slot discipline)
+#include "dlnb/tensor.hpp"
+
+namespace dlnb {
+namespace tcp {
+
+// ------------------------------------------------------------- framing
+enum class FrameKind : std::uint32_t { Coll = 1, P2P = 2 };
+
+struct FrameHeader {
+  std::uint32_t kind;     // FrameKind
+  std::uint32_t comm_id;  // 0 = world; splits count up identically everywhere
+  std::uint32_t slot;     // slot index (num_slots = blocking ops' slot)
+  std::uint32_t seq;      // per-(comm, slot) sequence number at the sender
+  std::uint32_t op;       // OpKind for Coll; tag for P2P
+  std::uint32_t src;      // sender's WORLD rank
+  std::uint64_t count;    // elements (Coll) / bytes (P2P)
+  std::uint64_t bytes;    // payload size
+};
+
+inline void send_all(int fd, const void* data, std::size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) throw std::runtime_error("tcp: send failed (peer gone?)");
+    p += w;
+    n -= static_cast<std::size_t>(w);
+  }
+}
+
+inline bool recv_all(int fd, void* data, std::size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r == 0) return false;  // orderly shutdown
+    if (r < 0) throw std::runtime_error("tcp: recv failed");
+    p += r;
+    n -= static_cast<std::size_t>(r);
+  }
+  return true;
+}
+
+// Received frames, keyed for matching.  Collectives match on
+// (comm, slot, seq, src); p2p matches on (comm, tag, src) in FIFO order.
+class Inbox {
+ public:
+  struct Frame {
+    FrameHeader h;
+    std::vector<char> payload;
+  };
+
+  void push(Frame f) {
+    std::lock_guard<std::mutex> lk(m_);
+    frames_.push_back(std::move(f));
+    cv_.notify_all();
+  }
+
+  void fail(const std::string& why) {
+    std::lock_guard<std::mutex> lk(m_);
+    error_ = why;
+    cv_.notify_all();
+  }
+
+  // Blocking take of the first frame matching `pred`.
+  template <typename Pred>
+  Frame take(const Pred& pred) {
+    std::unique_lock<std::mutex> lk(m_);
+    std::deque<Frame>::iterator it;
+    cv_.wait(lk, [&] {
+      if (!error_.empty()) return true;
+      for (it = frames_.begin(); it != frames_.end(); ++it)
+        if (pred(it->h)) return true;
+      return false;
+    });
+    if (!error_.empty()) throw std::runtime_error("tcp fabric: " + error_);
+    Frame f = std::move(*it);
+    frames_.erase(it);
+    return f;
+  }
+
+ private:
+  std::mutex m_;
+  std::condition_variable cv_;
+  std::deque<Frame> frames_;
+  std::string error_;
+};
+
+}  // namespace tcp
+
+class TcpFabric;
+
+// One process's view of a communicator group over the TCP mesh.
+class TcpCommunicator : public ProxyCommunicator {
+ public:
+  TcpCommunicator(TcpFabric* fab, std::uint32_t comm_id,
+                  std::vector<int> members, int world_rank, DType dtype,
+                  int num_slots, std::string name)
+      : fab_(fab),
+        comm_id_(comm_id),
+        members_(std::move(members)),
+        wrank_(world_rank),
+        dtype_(dtype),
+        num_slots_(num_slots),
+        name_(std::move(name)),
+        seq_(num_slots + 1, 0),
+        workers_(num_slots) {
+    for (std::size_t i = 0; i < members_.size(); ++i)
+      if (members_[i] == wrank_) grank_ = static_cast<int>(i);
+  }
+
+  ~TcpCommunicator() override {
+    for (auto& w : workers_) w.stop();
+  }
+
+  int rank() const override { return grank_; }
+  int size() const override { return static_cast<int>(members_.size()); }
+  std::string name() const override { return name_; }
+  DType dtype() const override { return dtype_; }
+
+  void Allreduce(const void* src, void* dst, std::int64_t count) override {
+    collective(num_slots_, shm::OpKind::Allreduce, count, src, dst);
+  }
+  void Allgather(const void* src, void* dst, std::int64_t cpr) override {
+    collective(num_slots_, shm::OpKind::Allgather, cpr, src, dst);
+  }
+  void ReduceScatterBlock(const void* src, void* dst,
+                          std::int64_t cpr) override {
+    collective(num_slots_, shm::OpKind::ReduceScatterBlock, cpr, src, dst);
+  }
+  void Alltoall(const void* src, void* dst, std::int64_t cpr) override {
+    collective(num_slots_, shm::OpKind::Alltoall, cpr, src, dst);
+  }
+  void Barrier() override {
+    collective(num_slots_, shm::OpKind::Barrier, 0, nullptr, nullptr);
+  }
+
+  void Send(const void* src, std::int64_t count, int dst_rank,
+            int tag = 0) override;
+  void Recv(void* dst, std::int64_t count, int src_rank,
+            int tag = 0) override;
+
+  void Iallreduce(const void* src, void* dst, std::int64_t count,
+                  int slot) override {
+    enqueue(slot, [=] {
+      collective(slot, shm::OpKind::Allreduce, count, src, dst);
+    });
+  }
+  void Iallgather(const void* src, void* dst, std::int64_t cpr,
+                  int slot) override {
+    enqueue(slot, [=] {
+      collective(slot, shm::OpKind::Allgather, cpr, src, dst);
+    });
+  }
+  void Isend(const void* src, std::int64_t count, int dst_rank, int slot,
+             int tag = -1) override {
+    int t = tag >= 0 ? tag : 1 + slot;
+    enqueue(slot, [=] { Send(src, count, dst_rank, t); });
+  }
+  void Irecv(void* dst, std::int64_t count, int src_rank, int slot,
+             int tag = -1) override {
+    int t = tag >= 0 ? tag : 1 + slot;
+    enqueue(slot, [=] { Recv(dst, count, src_rank, t); });
+  }
+  void Wait(int slot) override { worker(slot).wait(); }
+  void WaitAll(int num_slots) override {
+    for (int i = 0; i < num_slots && i < num_slots_; ++i) workers_[i].wait();
+  }
+
+ private:
+  friend class TcpFabric;
+  void collective(int slot, shm::OpKind op, std::int64_t count,
+                  const void* src, void* dst);
+
+  shm::SlotWorker& worker(int slot) {
+    if (slot < 0 || slot >= num_slots_)
+      throw std::out_of_range("slot " + std::to_string(slot) +
+                              " out of range");
+    return workers_[slot];
+  }
+  void enqueue(int slot, std::function<void()> fn) {
+    worker(slot).enqueue(std::move(fn));
+  }
+
+  TcpFabric* fab_;
+  std::uint32_t comm_id_;
+  std::vector<int> members_;  // world ranks, ascending (group rank order)
+  int wrank_;
+  int grank_ = 0;
+  DType dtype_;
+  int num_slots_;
+  std::string name_;
+  std::vector<std::uint32_t> seq_;  // per-slot collective sequence
+  std::mutex seq_m_;
+  std::vector<shm::SlotWorker> workers_;
+};
+
+// The world: bootstrap, pairwise sockets, reader threads, comm registry.
+class TcpFabric : public Fabric {
+ public:
+  // Rank 0 listens on `coordinator` ("host:port"); everyone else dials
+  // it.  After the address-book exchange all ranks hold one socket per
+  // peer.  One fabric = one process = one rank (the MPI model).
+  TcpFabric(const std::string& coordinator, int world_size, int rank,
+            DType dtype, int num_slots = 32)
+      : world_(world_size),
+        rank_(rank),
+        dtype_(dtype),
+        num_slots_(num_slots),
+        fds_(world_size, -1) {
+    if (world_size <= 0 || rank < 0 || rank >= world_size)
+      throw std::invalid_argument("tcp fabric: bad world/rank");
+    if (world_size > 1) bootstrap(coordinator);
+    for (int r = 0; r < world_; ++r)
+      if (r != rank_) start_reader(r);
+  }
+
+  ~TcpFabric() override {
+    closing_.store(true, std::memory_order_release);
+    for (int fd : fds_)
+      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : readers_)
+      if (t.joinable()) t.join();
+    for (int fd : fds_)
+      if (fd >= 0) ::close(fd);
+  }
+
+  int world_size() const override { return world_; }
+  int rank() const { return rank_; }
+  DType dtype() const override { return dtype_; }
+  std::string backend() const override { return "tcp"; }
+
+  std::unique_ptr<ProxyCommunicator> world_comm(int /*rank*/) override {
+    std::vector<int> all(world_);
+    for (int i = 0; i < world_; ++i) all[i] = i;
+    return std::make_unique<TcpCommunicator>(this, 0, all, rank_, dtype_,
+                                             num_slots_, "tcp_world");
+  }
+
+  // Collective split: colors are allgathered over an internal world
+  // communicator; every process derives the same groups and the same
+  // comm id (splits are ordered, the MPI_Comm_split contract).
+  std::unique_ptr<ProxyCommunicator> split(
+      int /*world_rank*/, int color, const std::string& name) override {
+    std::vector<std::int32_t> colors(world_);
+    {
+      // f32 allgather of colors — exact for |color| < 2^24.  NOTE the
+      // seq-matching contract: every process must create and use its
+      // communicators in the same order (the SPMD/MPI discipline the
+      // proxies already follow), since sequence counters are per object.
+      Tensor s(1, DType::F32), d(world_, DType::F32);
+      s.set(0, static_cast<float>(color));
+      TcpCommunicator tmp(this, 0, all_ranks(), rank_, DType::F32,
+                          num_slots_, "split_tmp");
+      tmp.Allgather(s.data(), d.data(), 1);
+      for (int r = 0; r < world_; ++r)
+        colors[r] = static_cast<std::int32_t>(d.get(r));
+    }
+    std::vector<int> members;
+    for (int r = 0; r < world_; ++r)
+      if (colors[r] == colors[rank_]) members.push_back(r);
+    std::uint32_t id = ++next_comm_id_;
+    return std::make_unique<TcpCommunicator>(this, id, std::move(members),
+                                             rank_, dtype_, num_slots_, name);
+  }
+
+  // One process = one rank: body runs once, in this thread.
+  void launch(const std::function<void(int)>& body) override {
+    body(rank_);
+  }
+
+  std::vector<int> local_ranks() const override { return {rank_}; }
+  int process_index() const override { return rank_; }
+
+  void describe(Json& meta, Json& mesh) const override {
+    meta["backend"] = "tcp";
+    meta["device"] = "cpu";
+    meta["num_processes"] = world_;
+    mesh["platform"] = "tcp";
+    mesh["device_kind"] = "process-rank";
+  }
+
+  tcp::Inbox& inbox() { return inbox_; }
+
+  void send_frame(int dst, const tcp::FrameHeader& h, const void* payload) {
+    if (dst == rank_) {  // self-delivery (degenerate groups, self-sends)
+      tcp::Inbox::Frame f;
+      f.h = h;
+      f.payload.assign(static_cast<const char*>(payload),
+                       static_cast<const char*>(payload) + h.bytes);
+      inbox_.push(std::move(f));
+      return;
+    }
+    std::lock_guard<std::mutex> lk(send_m_[dst]);
+    tcp::send_all(fds_[dst], &h, sizeof h);
+    if (h.bytes) tcp::send_all(fds_[dst], payload, h.bytes);
+  }
+
+ private:
+  std::vector<int> all_ranks() const {
+    std::vector<int> all(world_);
+    for (int i = 0; i < world_; ++i) all[i] = i;
+    return all;
+  }
+
+  static int dial(const std::string& host, int port, int timeout_s = 30) {
+    // resolve names as well as dotted quads (multi-host address books
+    // carry whatever the peer's kernel reported)
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<std::uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      if (::getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res)
+        throw std::runtime_error("tcp: cannot resolve " + host);
+      addr.sin_addr =
+          reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      ::freeaddrinfo(res);
+    }
+    for (int attempt = 0; attempt < timeout_s * 10; ++attempt) {
+      int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0) throw std::runtime_error("tcp: socket() failed");
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) ==
+          0) {
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        return fd;
+      }
+      ::close(fd);
+      ::usleep(100 * 1000);  // coordinator may not be up yet
+    }
+    throw std::runtime_error("tcp: cannot reach " + host + ":" +
+                             std::to_string(port));
+  }
+
+  static int listen_any(int& port_out) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) throw std::runtime_error("tcp: socket() failed");
+    int one = 1;
+    ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<std::uint16_t>(port_out));
+    if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0)
+      throw std::runtime_error("tcp: bind failed (port " +
+                               std::to_string(port_out) + ")");
+    socklen_t len = sizeof addr;
+    ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_out = ntohs(addr.sin_port);
+    if (::listen(fd, 64) != 0) throw std::runtime_error("tcp: listen failed");
+    return fd;
+  }
+
+  void bootstrap(const std::string& coordinator) {
+    auto colon = coordinator.rfind(':');
+    if (colon == std::string::npos)
+      throw std::runtime_error("tcp: coordinator must be host:port, got " +
+                               coordinator);
+    std::string host = coordinator.substr(0, colon);
+    int coord_port = std::stoi(coordinator.substr(colon + 1));
+    send_m_ = std::vector<std::mutex>(world_);
+
+    // address book entry: the host each rank is reachable at (learned
+    // by rank 0 from the accepted connection's peer address — always a
+    // routable address, unlike a self-reported hostname) + listen port
+    struct Entry {
+      char host[64];
+      std::int32_t port;
+    };
+
+    if (rank_ == 0) {
+      // the ncclUniqueId role: accept every rank, note where it dialed
+      // from and its own listen port, then broadcast the address book
+      int port = coord_port;
+      int lfd = listen_any(port);
+      std::vector<Entry> book(world_);
+      std::memset(book.data(), 0, book.size() * sizeof(Entry));
+      for (int n = 1; n < world_; ++n) {
+        sockaddr_in peer_addr{};
+        socklen_t alen = sizeof peer_addr;
+        int fd = ::accept(lfd, reinterpret_cast<sockaddr*>(&peer_addr),
+                          &alen);
+        if (fd < 0) throw std::runtime_error("tcp: accept failed");
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        std::int32_t hello[2];  // {rank, my own listen port}
+        if (!tcp::recv_all(fd, hello, sizeof hello))
+          throw std::runtime_error("tcp: rank hello truncated");
+        fds_[hello[0]] = fd;
+        Entry& e = book[hello[0]];
+        ::inet_ntop(AF_INET, &peer_addr.sin_addr, e.host, sizeof e.host);
+        e.port = hello[1];
+      }
+      ::close(lfd);
+      for (int r = 1; r < world_; ++r)
+        tcp::send_all(fds_[r], book.data(), book.size() * sizeof(Entry));
+      // rank 0 reuses its accepted sockets; higher ranks dial each other:
+      // rank i accepts from ranks j > i on its own listener
+    } else {
+      // listen for higher ranks first so the book can be acted on
+      int my_port = 0;
+      int lfd = listen_any(my_port);
+      int fd0 = dial(host, coord_port);
+      std::int32_t hello[2] = {static_cast<std::int32_t>(rank_),
+                               static_cast<std::int32_t>(my_port)};
+      tcp::send_all(fd0, hello, sizeof hello);
+      fds_[0] = fd0;
+      std::vector<Entry> book(world_);
+      if (!tcp::recv_all(fd0, book.data(), book.size() * sizeof(Entry)))
+        throw std::runtime_error("tcp: address book truncated");
+      // dial every lower-ranked peer (except 0, already connected) AT ITS
+      // OWN HOST; accept from every higher-ranked peer
+      for (int r = 1; r < rank_; ++r) {
+        int fd = dial(book[r].host, book[r].port);
+        std::int32_t me = rank_;
+        tcp::send_all(fd, &me, sizeof me);
+        fds_[r] = fd;
+      }
+      for (int r = rank_ + 1; r < world_; ++r) {
+        int fd = ::accept(lfd, nullptr, nullptr);
+        if (fd < 0) throw std::runtime_error("tcp: accept failed");
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        std::int32_t peer;
+        if (!tcp::recv_all(fd, &peer, sizeof peer))
+          throw std::runtime_error("tcp: peer hello truncated");
+        fds_[peer] = fd;
+      }
+      ::close(lfd);
+    }
+  }
+
+  void start_reader(int peer) {
+    readers_.emplace_back([this, peer] {
+      try {
+        while (true) {
+          tcp::FrameHeader h;
+          if (!tcp::recv_all(fds_[peer], &h, sizeof h)) {
+            // EOF: silent only during our own orderly teardown — a peer
+            // dying mid-run must fail blocked collectives, not hang them
+            if (!closing_.load(std::memory_order_acquire))
+              inbox_.fail("rank " + std::to_string(peer) +
+                          " disconnected mid-run");
+            return;
+          }
+          tcp::Inbox::Frame f;
+          f.h = h;
+          f.payload.resize(h.bytes);
+          if (h.bytes && !tcp::recv_all(fds_[peer], f.payload.data(), h.bytes))
+            throw std::runtime_error("payload truncated");
+          inbox_.push(std::move(f));
+        }
+      } catch (const std::exception& e) {
+        if (!closing_.load(std::memory_order_acquire))
+          inbox_.fail(std::string("reader for rank ") + std::to_string(peer) +
+                      ": " + e.what());
+      }
+    });
+  }
+
+  int world_;
+  int rank_;
+  DType dtype_;
+  int num_slots_;
+  std::vector<int> fds_;
+  std::vector<std::mutex> send_m_{1};
+  std::vector<std::thread> readers_;
+  tcp::Inbox inbox_;
+  std::atomic<std::uint32_t> next_comm_id_{0};
+  std::atomic<bool> closing_{false};
+};
+
+// ---- TcpCommunicator method bodies needing the fabric ----
+
+inline void TcpCommunicator::Send(const void* src, std::int64_t count,
+                                  int dst_rank, int tag) {
+  tcp::FrameHeader h{};
+  h.kind = static_cast<std::uint32_t>(tcp::FrameKind::P2P);
+  h.comm_id = comm_id_;
+  h.op = static_cast<std::uint32_t>(tag);
+  h.src = static_cast<std::uint32_t>(wrank_);
+  h.count = static_cast<std::uint64_t>(count);
+  h.bytes = static_cast<std::uint64_t>(count) * dtype_bytes(dtype_);
+  fab_->send_frame(members_.at(dst_rank), h, src);
+}
+
+inline void TcpCommunicator::Recv(void* dst, std::int64_t count,
+                                  int src_rank, int tag) {
+  std::uint32_t want_src = static_cast<std::uint32_t>(members_.at(src_rank));
+  std::uint32_t want_tag = static_cast<std::uint32_t>(tag);
+  std::uint32_t cid = comm_id_;
+  auto f = fab_->inbox().take([&](const tcp::FrameHeader& h) {
+    return h.kind == static_cast<std::uint32_t>(tcp::FrameKind::P2P) &&
+           h.comm_id == cid && h.src == want_src && h.op == want_tag;
+  });
+  std::size_t want = static_cast<std::size_t>(count) * dtype_bytes(dtype_);
+  if (f.payload.size() != want)
+    throw std::runtime_error("tcp p2p size mismatch: got " +
+                             std::to_string(f.payload.size()) + "B, want " +
+                             std::to_string(want) + "B");
+  std::memcpy(dst, f.payload.data(), want);
+}
+
+inline void TcpCommunicator::collective(int slot, shm::OpKind op,
+                                        std::int64_t count, const void* src,
+                                        void* dst) {
+  const int n = size();
+  const std::size_t esz = dtype_bytes(dtype_);
+  std::uint32_t seq;
+  {
+    std::lock_guard<std::mutex> lk(seq_m_);
+    seq = seq_[static_cast<std::size_t>(slot)]++;
+  }
+  // payload per op: what the OTHER side needs from us
+  std::size_t bytes = 0;
+  switch (op) {
+    case shm::OpKind::Barrier: bytes = 0; break;
+    case shm::OpKind::Allreduce:
+    case shm::OpKind::Allgather:
+      bytes = static_cast<std::size_t>(count) * esz;  // my full contribution
+      break;
+    case shm::OpKind::ReduceScatterBlock:
+    case shm::OpKind::Alltoall:
+      bytes = static_cast<std::size_t>(count) * esz;  // one block per peer
+      break;
+  }
+  tcp::FrameHeader h{};
+  h.kind = static_cast<std::uint32_t>(tcp::FrameKind::Coll);
+  h.comm_id = comm_id_;
+  h.slot = static_cast<std::uint32_t>(slot);
+  h.seq = seq;
+  h.op = static_cast<std::uint32_t>(op);
+  h.src = static_cast<std::uint32_t>(wrank_);
+  h.count = static_cast<std::uint64_t>(count);
+  const char* me = static_cast<const char*>(src);
+  for (int g = 0; g < n; ++g) {
+    int peer = members_[g];
+    if (peer == wrank_) continue;
+    const void* payload = me;
+    // scatter-style ops send peer g its own block
+    if (op == shm::OpKind::ReduceScatterBlock ||
+        op == shm::OpKind::Alltoall)
+      payload = me + static_cast<std::size_t>(g) * bytes;
+    h.bytes = bytes;
+    fab_->send_frame(peer, h, payload);
+  }
+
+  // gather everyone's frame for (comm, slot, seq), then combine locally
+  std::map<int, std::vector<char>> got;
+  for (int g = 0; g < n; ++g) {
+    int peer = members_[g];
+    if (peer == wrank_) continue;
+    std::uint32_t want_src = static_cast<std::uint32_t>(peer);
+    auto f = fab_->inbox().take([&](const tcp::FrameHeader& fh) {
+      return fh.kind == static_cast<std::uint32_t>(tcp::FrameKind::Coll) &&
+             fh.comm_id == comm_id_ &&
+             fh.slot == static_cast<std::uint32_t>(slot) && fh.seq == seq &&
+             fh.src == want_src;
+    });
+    if (static_cast<shm::OpKind>(f.h.op) != op ||
+        static_cast<std::int64_t>(f.h.count) != count)
+      throw std::runtime_error(
+          "tcp collective mismatch: ranks disagree on op/count (got op " +
+          std::to_string(f.h.op) + " count " + std::to_string(f.h.count) +
+          ", expected op " + std::to_string(static_cast<int>(op)) +
+          " count " + std::to_string(count) + ")");
+    got[g] = std::move(f.payload);
+  }
+
+  switch (op) {
+    case shm::OpKind::Barrier:
+      break;
+    case shm::OpKind::Allreduce: {
+      for (std::int64_t i = 0; i < count; ++i) {
+        float acc = load_element(src, static_cast<std::size_t>(i), dtype_);
+        for (auto& [g, buf] : got)
+          acc += load_element(buf.data(), static_cast<std::size_t>(i),
+                              dtype_);
+        store_element(dst, static_cast<std::size_t>(i), dtype_, acc);
+      }
+      break;
+    }
+    case shm::OpKind::Allgather: {
+      char* out = static_cast<char*>(dst);
+      std::size_t blk = static_cast<std::size_t>(count) * esz;
+      std::memcpy(out + static_cast<std::size_t>(grank_) * blk, src, blk);
+      for (auto& [g, buf] : got)
+        std::memcpy(out + static_cast<std::size_t>(g) * blk, buf.data(),
+                    blk);
+      break;
+    }
+    case shm::OpKind::ReduceScatterBlock: {
+      // my own block g=grank_ from src, plus each peer's sent block
+      const char* mine =
+          static_cast<const char*>(src) +
+          static_cast<std::size_t>(grank_) * static_cast<std::size_t>(count) *
+              esz;
+      for (std::int64_t i = 0; i < count; ++i) {
+        float acc = load_element(mine, static_cast<std::size_t>(i), dtype_);
+        for (auto& [g, buf] : got)
+          acc += load_element(buf.data(), static_cast<std::size_t>(i),
+                              dtype_);
+        store_element(dst, static_cast<std::size_t>(i), dtype_, acc);
+      }
+      break;
+    }
+    case shm::OpKind::Alltoall: {
+      char* out = static_cast<char*>(dst);
+      std::size_t blk = static_cast<std::size_t>(count) * esz;
+      std::memcpy(out + static_cast<std::size_t>(grank_) * blk,
+                  static_cast<const char*>(src) +
+                      static_cast<std::size_t>(grank_) * blk,
+                  blk);
+      for (auto& [g, buf] : got)
+        std::memcpy(out + static_cast<std::size_t>(g) * blk, buf.data(), blk);
+      break;
+    }
+  }
+}
+
+}  // namespace dlnb

@@ -27,6 +27,7 @@
 #include "dlnb/model_data.hpp"
 #include "dlnb/pjrt_fabric.hpp"
 #include "dlnb/shm_backend.hpp"
+#include "dlnb/tcp_backend.hpp"
 #include "dlnb/timers.hpp"
 #include "dlnb/topology.hpp"
 
@@ -63,9 +64,11 @@ struct ProxyEnv {
   std::string model_name;
   std::string out_path;  // empty -> stdout
   bool no_topology = false;
-  std::string backend = "shm";      // shm | pjrt
+  std::string backend = "shm";      // shm | pjrt | tcp
   std::string pjrt_plugin;          // --pjrt_plugin override
   std::vector<int> devices;         // --devices list (reference -d)
+  std::string coordinator;          // tcp: rank 0's host:port
+  int proc_rank = 0;                // tcp: this process's rank
 };
 
 // "0,2,3" -> {0,2,3} (reference parse_devices, cpp/utils.hpp:62-71).
@@ -112,6 +115,10 @@ inline void add_common_args(Args& args) {
       .optional_str("devices", "",
                     "device-index list for the pjrt backend, e.g. 0,2,3 "
                     "(reference -d)")
+      .optional_str("coordinator", "",
+                    "tcp backend: rank 0's listen address host:port "
+                    "(the ncclUniqueId bootstrap role, dp.cpp:183-188)")
+      .optional_int("rank", 0, "tcp backend: this process's rank")
       .flag("loop", "run the schedule forever (congestor mode)")
       .flag("no_topology", "skip the startup fabric-topology graph");
 }
@@ -139,9 +146,16 @@ inline ProxyEnv make_env(const Args& args) {
   env.backend = args.str("backend");
   env.pjrt_plugin = args.str("pjrt_plugin");
   env.devices = parse_device_list(args.str("devices"));
-  if (env.backend != "shm" && env.backend != "pjrt")
+  env.coordinator = args.str("coordinator");
+  env.proc_rank = static_cast<int>(args.integer("rank"));
+  if (env.backend != "shm" && env.backend != "pjrt" &&
+      env.backend != "tcp")
     throw std::runtime_error("unknown --backend '" + env.backend +
-                             "' (shm | pjrt)");
+                             "' (shm | pjrt | tcp)");
+  if (env.backend == "tcp" && env.world > 1 && env.coordinator.empty())
+    throw std::runtime_error(
+        "--backend tcp needs --coordinator host:port (rank 0 listens "
+        "there) and --rank");
   if (env.world <= 0) throw std::runtime_error("--world must be positive");
   if (!env.devices.empty()) {
     if (env.backend != "pjrt")
@@ -168,6 +182,9 @@ inline std::unique_ptr<Fabric> make_fabric(const ProxyEnv& env) {
         env.world, env.dtype,
         make_pjrt_executor(env.world, env.pjrt_plugin, env.devices,
                            std::cerr));
+  if (env.backend == "tcp")
+    return std::make_unique<TcpFabric>(env.coordinator, env.world,
+                                       env.proc_rank, env.dtype);
   return std::make_unique<ShmFabric>(env.world, env.dtype);
 }
 
@@ -196,13 +213,16 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
   std::vector<Json> extras(env.world);
   fab.launch([&](int r) { extras[r] = body(r, fab, timers[r], runs[r]); });
 
+  // emit only the ranks THIS process measured (cross-process fabrics own
+  // one rank each; dlnetbench_tpu.metrics.merge reassembles the run)
+  std::vector<int> local = fab.local_ranks();
   std::string host = local_hostname();
   std::vector<RankReport> reports;
-  for (int r = 0; r < env.world; ++r) {
+  for (int r : local) {
     RankReport rep;
     rep.rank = r;
     rep.device_id = r;
-    rep.process_index = 0;
+    rep.process_index = fab.process_index();
     rep.hostname = host;
     rep.extra = extras[r];
     rep.timers = &timers[r];
@@ -218,8 +238,10 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
   Json mesh = Json::object();
   fab.describe(meta, mesh);  // backend/platform identity + cache stats
 
-  Json rec = make_record(section, meta, mesh, runs[0].runs,
-                         runs[0].warmup_us, reports);
+  int rep_rank = local.at(0);  // the rank whose harness counters we hold
+  Json rec = make_record(section, meta, mesh, runs[rep_rank].runs,
+                         runs[rep_rank].warmup_us, reports);
+  rec["process"] = fab.process_index();
   if (!env.out_path.empty()) {
     std::ofstream f(env.out_path, std::ios::app);
     f << rec.dump() << "\n";

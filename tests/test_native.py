@@ -289,3 +289,68 @@ def test_native_1f1b_schedule(native_bin):
         recs[sch] = rec
     for a, b in zip(recs["gpipe"]["ranks"], recs["1f1b"]["ranks"]):
         assert len(a["pp_comm"]) == len(b["pp_comm"])  # same hop totals
+
+
+# ---------------------------------------------------------------------
+# --backend tcp: the cross-process fabric (VERDICT r1 #7) — two real OS
+# processes bootstrap over a loopback coordinator (the ncclUniqueId
+# role, reference dp.cpp:166-189), run the proxy jointly, and their
+# per-process records merge into one via dlnetbench_tpu.metrics.merge.
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_native_tcp_selftest(native_bin):
+    """Every collective + p2p + split verified across 2 OS processes
+    ('correct sums' done-criterion)."""
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [str(native_bin / "tcp_selftest"), "--world", "2", "--rank", str(r),
+         "--coordinator", f"127.0.0.1:{port}"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    outs = [p.communicate(timeout=90)[0] for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r} OK" in out
+
+
+def test_native_dp_over_tcp_and_merge(native_bin, tmp_path):
+    """dp across 2 processes: each emits its own record (own timers,
+    process identity), metrics.merge reassembles the full rank set."""
+    from dlnetbench_tpu.metrics.merge import merge_files
+    from dlnetbench_tpu.metrics.parser import records_to_dataframe, \
+        validate_record
+
+    port = _free_port()
+    outs = [tmp_path / f"p{r}.jsonl" for r in range(2)]
+    procs = [subprocess.Popen(
+        [str(native_bin / "dp"), "--model", "gpt2_l_16_bfloat16",
+         "--world", "2", "--backend", "tcp", "--rank", str(r),
+         "--coordinator", f"127.0.0.1:{port}", "--num_buckets", "2",
+         "--time_scale", "0.0001", "--size_scale", "0.00001",
+         "--runs", "2", "--warmup", "1", "--no_topology",
+         "--base_path", str(REPO), "--out", str(outs[r])],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    texts = [p.communicate(timeout=120)[0] for p in procs]
+    for r, (p, txt) in enumerate(zip(procs, texts)):
+        assert p.returncode == 0, f"rank {r} failed:\n{txt}"
+
+    for r, path in enumerate(outs):
+        rec = json.loads(path.read_text().strip())
+        assert rec["process"] == r
+        assert rec["global"]["backend"] == "tcp"
+        assert rec["global"]["num_processes"] == 2
+        assert [row["rank"] for row in rec["ranks"]] == [r]
+
+    merged = merge_files(tmp_path / "merged.jsonl", outs)
+    validate_record(merged)
+    assert [row["rank"] for row in merged["ranks"]] == [0, 1]
+    df = records_to_dataframe([merged])
+    assert len(df) == 2 * merged["num_runs"]
+    assert (df["runtime"] > 0).all()
