@@ -41,13 +41,34 @@ _LANES = 128                 # TPU lane width; head dim padded to this
 _SUBLANES = 8                # fp32 sublane tile: row vectors (lse, D) are
                              # stored (B, H, 8, S) so blocks are (8, block_q)
 _NEG_INF = -1e30             # finite "-inf": keeps masked rows NaN-free
-# 1024-blocks measured ~2.5x faster than 512 at S=2048 on v5e (fewer grid
-# steps -> less per-invocation overhead, still comfortably inside VMEM)
-_BLOCK_CANDIDATES = (1024, 512, 256, 128)
+# Default block sizes are direction-specific (measured at S=4096 on v5e,
+# with the parallel dimension_semantics below): the forward kernel gains
+# ~40% from 2048-wide blocks (fewer online-softmax rescale rounds, deeper
+# MXU pipelining per grid lane), while both backward kernels peak at 1024
+# (the dq/dkv bodies hold more live blocks, so 2048 spills).  1024 was
+# itself ~2.5x faster than 512 at S=2048.
+_BLOCK_CANDIDATES_FWD = (2048, 1024, 512, 256, 128)
+_BLOCK_CANDIDATES_BWD = (1024, 512, 256, 128)
+_BLOCK_CANDIDATES = _BLOCK_CANDIDATES_BWD   # shape gate: the common subset
 
 
-def _pick_block(seq_len: int) -> int | None:
-    for b in _BLOCK_CANDIDATES:
+def _compiler_params():
+    """Mosaic params shared by all three kernels: the minor grid axis
+    carries the online-softmax / accumulator scratch (sequential); the
+    outer (batch, head, row-block) axes are independent — declaring them
+    ``parallel`` lets Mosaic pipeline DMA across grid rows instead of
+    treating the whole grid as one sequential chain (measured: the 2048
+    forward blocks are ~1.7x slower without it).  The VMEM cap is raised
+    above the 16 MiB default so 2048-wide blocks keep double-buffering
+    headroom on v5e/v5p (128 MiB physical VMEM)."""
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=64 * 1024 * 1024,
+    )
+
+
+def _pick_block(seq_len: int, candidates=_BLOCK_CANDIDATES) -> int | None:
+    for b in candidates:
         if seq_len % b == 0 and seq_len >= b:
             return b
     return None
@@ -182,6 +203,7 @@ def _fwd(q, k, v, *, causal: bool, block_q: int, block_k: int):
             pltpu.VMEM((block_q, _LANES), _F32),
             pltpu.VMEM((block_q, _LANES), _F32),
         ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qt, kt, vt)
     return _from_bsf(out, hq, dh), lse
@@ -311,6 +333,7 @@ def _bwd_impl(q, k, v, out, lse, do, *, causal: bool,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, s, hq * dh_p), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, dh_p), _F32)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qt, kt, vt, dot, lse, dcap)
 
@@ -343,6 +366,7 @@ def _bwd_impl(q, k, v, out, lse, do, *, causal: bool,
                    jax.ShapeDtypeStruct((b, s, hq * dh_p), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, dh_p), _F32),
                         pltpu.VMEM((block_k, dh_p), _F32)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qt, kt, vt, dot, lse, dcap)
 
@@ -387,15 +411,16 @@ def flash_attention(q, k, v, causal: bool = True,
     return out
 
 
-def _resolve_blocks(q, k, block_q, block_k):
+def _resolve_blocks(q, k, block_q, block_k,
+                    candidates=_BLOCK_CANDIDATES):
     s, dh = q.shape[1], q.shape[3]
     hq, hkv = q.shape[2], k.shape[2]
     if hq % hkv or dh > _LANES:
         raise ValueError(
             f"flash_attention: unsupported shape (Hq={hq} % Hkv={hkv} != 0 "
             f"or head dim {dh} > {_LANES}); use ops.attention(..., impl='auto')")
-    bq = block_q or _pick_block(s)
-    bk = block_k or _pick_block(s)
+    bq = block_q or _pick_block(s, candidates)
+    bk = block_k or _pick_block(s, candidates)
     if bq is None or bk is None or s % bq or s % bk:
         raise ValueError(
             f"flash_attention: seq_len {s} not divisible into blocks "
@@ -404,14 +429,16 @@ def _resolve_blocks(q, k, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    bq, bk = _resolve_blocks(q, k, block_q, block_k)
+    bq, bk = _resolve_blocks(q, k, block_q, block_k,
+                             candidates=_BLOCK_CANDIDATES_FWD)
     out, lse = _fwd(q, k, v, causal=causal, block_q=bq, block_k=bk)
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    bq, bk = _resolve_blocks(q, k, block_q, block_k)
+    bq, bk = _resolve_blocks(q, k, block_q, block_k,
+                             candidates=_BLOCK_CANDIDATES_BWD)
     return _bwd_impl(q, k, v, out, lse, g, causal=causal,
                      block_q=bq, block_k=bk)
 
