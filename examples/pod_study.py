@@ -1,0 +1,232 @@
+#!/usr/bin/env python3
+"""North-star pod study — every proxy workload on llama3_70b + mixtral,
+one command producing the effective-bandwidth table and the three plot
+families (SURVEY.md §7.2 step 7; reference BASELINE.md's "effective bus
+GB/s + iter time per collective").
+
+The reference runs this as a SLURM grid (sbatchman) over
+dp/fsdp/hybrid_3d/hybrid_3d_moe and parses the job outputs back into
+DataFrames (reference plots/parser.py:213-256).  Here the same study is
+one script with no scheduler:
+
+    python examples/pod_study.py --out_dir /tmp/pod_study
+
+runs all 7 proxies (dp, fsdp, hybrid_2d/3d/3d-moe, ring_attention,
+ulysses) on an 8-device virtual CPU mesh at reduced buffer/time scale,
+then prints per-collective effective bandwidth and writes
+scaling / barrier-scatter / Pareto PNGs plus bandwidth_summary.csv.
+
+On a real TPU pod slice, drop the shrink factors and let the runtime's
+devices be the mesh:
+
+    python examples/pod_study.py --platform tpu --full_scale \
+        --devices 16 --out_dir ~/pod_study_v5p
+
+Every point is a fresh subprocess (compilation caches and backend state
+cannot leak between grid points), tagged with ``proxy=<name>`` so the
+combined records file remains one flat, parseable study.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+# runnable from a clone without installation
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+DENSE = "llama3_70b_16_bfloat16"
+MOE = "mixtral_8x7b_16_bfloat16"
+
+
+def build_plan(models: list[str], devices: int) -> list[tuple[str, dict]]:
+    """(proxy, flags) for every study point.
+
+    Grid shapes mirror the reference's study configurations scaled to the
+    available world size: dp scaling over world sizes and bucket counts
+    (reference plots/plot_dp.py:29, :80), fsdp with hybrid sharding
+    (sharding_factor x replicas = world, reference
+    cpp/data_parallel/fsdp.cpp:217), the three hybrids on stagexdp(xtp/ep)
+    grids (reference cpp/hybrid_parallel/*.cpp), and the two
+    sequence-parallel extensions on sp x dp grids.
+    """
+    half = max(devices // 2, 1)
+    quarter = max(devices // 4, 1)
+    plan: list[tuple[str, dict]] = []
+
+    for model in models:
+        # dp runtime scaling over world sizes (last point = full world)
+        w = devices
+        worlds = []
+        while w >= 2:
+            worlds.append(w)
+            w //= 2
+        for w in sorted(worlds):
+            plan.append(("dp", {"model": model, "num_buckets": 4, "d": w}))
+        # dp bucket study at full world (barrier-scatter axis)
+        for nb in (2, 8):
+            plan.append(("dp", {"model": model, "num_buckets": nb,
+                                "d": devices}))
+        plan.append(("fsdp", {"model": model, "num_units": 8,
+                              "sharding_factor": half}))
+        plan.append(("hybrid_2d", {"model": model, "num_stages": 4,
+                                   "num_microbatches": 8, "dp": quarter}))
+        plan.append(("hybrid_3d", {"model": model, "num_stages": 2,
+                                   "num_microbatches": 8, "tp": 2,
+                                   "dp": quarter}))
+        if model == MOE:
+            plan.append(("hybrid_3d_moe", {"model": model, "num_stages": 2,
+                                           "num_microbatches": 8,
+                                           "num_expert_shards": 2,
+                                           "dp": quarter}))
+        plan.append(("ring_attention", {"model": model, "sp": 4,
+                                        "dp": quarter, "max_layers": 2}))
+        plan.append(("ulysses", {"model": model, "sp": 4, "dp": quarter,
+                                 "max_layers": 2}))
+    return plan
+
+
+def run_plan(plan, args, records: Path) -> int:
+    env = dict(os.environ)
+    if args.platform == "cpu" and not env.get("XLA_FLAGS"):
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={args.devices}")
+    repo = str(Path(__file__).resolve().parent.parent)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, env.get("PYTHONPATH")) if p)
+
+    failed = 0
+    for i, (proxy, flags) in enumerate(plan):
+        argv = [sys.executable, "-m", "dlnetbench_tpu.cli", proxy,
+                "--out", str(records), "--platform", args.platform,
+                "-r", str(args.runs), "-w", "1", "--no_topology",
+                "--tag", f"proxy={proxy}"]
+        if not args.full_scale:
+            argv += ["--size_scale", str(args.size_scale),
+                     "--time_scale", str(args.time_scale)]
+        for k, v in flags.items():
+            argv += [f"--{k}", str(v)]
+        desc = " ".join(f"{k}={v}" for k, v in flags.items())
+        print(f"[{i + 1}/{len(plan)}] {proxy} {desc}", flush=True)
+        proc = subprocess.run(argv, env=env, stdout=subprocess.DEVNULL)
+        if proc.returncode != 0:
+            print(f"  FAILED rc={proc.returncode}", file=sys.stderr)
+            failed += 1
+    return failed
+
+
+def report(args, records: Path) -> None:
+    import pandas as pd
+
+    from dlnetbench_tpu.analysis import plots
+    from dlnetbench_tpu.analysis.bandwidth import bandwidth_summary
+    from dlnetbench_tpu.metrics.parser import load_records, \
+        records_to_dataframe
+
+    recs = load_records(records)
+    df = records_to_dataframe(recs)
+
+    # --- north-star table: iter time + effective bus GB/s per collective
+    per_point = []
+    for rec in recs:
+        s = bandwidth_summary([rec])
+        if s.empty:
+            continue
+        g = rec.get("global", {})
+        # bandwidth_summary already carries model; add proxy + world size
+        s.insert(0, "proxy", g.get("variables", {}).get("proxy",
+                                                        rec.get("section")))
+        s.insert(1, "world", len(rec.get("ranks", [])))
+        per_point.append(s)
+    if per_point:
+        bw = pd.concat(per_point, ignore_index=True)
+        # one line per (proxy, model, world, collective): the per-iteration
+        # exposed time and the standard busbw figure
+        cols = ["proxy", "model", "world", "collective", "group_size",
+                "time_us", "algbw_GBps", "busbw_GBps"]
+        bw = (bw.groupby(cols[:5], as_index=False)[cols[5:]].mean()
+              .sort_values(["proxy", "model", "world"]))[cols]
+        print("\n=== effective bandwidth per collective "
+              "(mean over ranks/runs) ===")
+        print(bw.to_string(index=False,
+                           float_format=lambda v: f"{v:10.2f}"))
+        bw.to_csv(args.out_dir / "bandwidth_summary.csv", index=False)
+
+    # --- runtime summary per study point
+    summary = (df.groupby(["proxy", "model", "world_size"])["runtime"]
+               .mean().rename("runtime_us").reset_index())
+    print("\n=== mean iteration runtime (us) ===")
+    print(summary.to_string(index=False,
+                            float_format=lambda v: f"{v:12.1f}"))
+
+    # --- plots
+    import matplotlib
+    matplotlib.use("Agg")
+
+    dp = df[df["proxy"] == "dp"]
+    scaling = dp[dp["num_buckets"] == 4]
+    if not scaling.empty:
+        ax = plots.plot_runtime_scaling(scaling, group_by="model")
+        ax.figure.savefig(args.out_dir / "dp_runtime_scaling.png", dpi=120)
+    full = dp[dp["world_size"] == dp["world_size"].max()]
+    if not full.empty:
+        ax = plots.plot_barrier_scatter_by_bucket(full)
+        ax.figure.savefig(args.out_dir / "dp_barrier_by_bucket.png", dpi=120)
+    # cross-proxy exposure Pareto: mean runtime vs mean exposed comm.
+    # Exposed-comm column differs per proxy; take the max-information one
+    # present per proxy row (barrier_time for dp/fsdp, dp_comm_time for
+    # the hybrids, ring/a2a wait for the sequence proxies).
+    exposed_cols = [c for c in ("barrier_time", "dp_comm_time",
+                                "ring_wait_time", "a2a_time") if c in df]
+    if exposed_cols:
+        exp = df.assign(exposed=df[exposed_cols].bfill(axis=1)
+                        .iloc[:, 0]).dropna(subset=["exposed"])
+        if not exp.empty:
+            ax = plots.plot_pareto(exp, x="runtime", y="exposed",
+                                   group_by="proxy")
+            ax.figure.savefig(args.out_dir / "pareto_proxies.png", dpi=120)
+    print(f"\nwrote {args.out_dir}/{{bandwidth_summary.csv,"
+          f"dp_runtime_scaling,dp_barrier_by_bucket,pareto_proxies}}.png")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out_dir", type=Path, default=Path("/tmp/pod_study"))
+    ap.add_argument("--devices", type=int, default=8,
+                    help="world size (CPU: virtual device count)")
+    ap.add_argument("--platform", default="cpu", choices=("cpu", "tpu"),
+                    help="cpu = virtual mesh dev box; tpu = real slice")
+    ap.add_argument("--models", default=f"{DENSE},{MOE}",
+                    help="comma-separated stats-file names")
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--size_scale", type=float, default=1e-4,
+                    help="buffer shrink factor (CPU default)")
+    ap.add_argument("--time_scale", type=float, default=1e-4,
+                    help="burn-time shrink factor (CPU default)")
+    ap.add_argument("--full_scale", action="store_true",
+                    help="real buffer sizes and burn times (pod runs)")
+    ap.add_argument("--report_only", action="store_true",
+                    help="skip the sweep; re-analyze an existing "
+                         "records.jsonl in --out_dir")
+    args = ap.parse_args()
+
+    args.out_dir.mkdir(parents=True, exist_ok=True)
+    records = args.out_dir / "records.jsonl"
+    failed = 0
+    if not args.report_only:
+        records.unlink(missing_ok=True)
+        plan = build_plan([m for m in args.models.split(",") if m],
+                          args.devices)
+        failed = run_plan(plan, args, records)
+    report(args, records)
+    if failed:
+        print(f"\n{failed} study point(s) failed", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

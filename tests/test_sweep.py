@@ -85,6 +85,30 @@ def test_real_two_point_sweep(tmp_path):
 
 
 @pytest.mark.slow
+def test_pod_study_end_to_end(tmp_path):
+    """examples/pod_study.py (the north-star study) must run every proxy
+    on the virtual mesh and produce the bandwidth table + the PNGs."""
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, "examples/pod_study.py",
+         "--out_dir", str(tmp_path), "--devices", "4", "--runs", "1",
+         "--models", "mixtral_8x7b_16_bfloat16"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "XLA_FLAGS": ""},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "effective bandwidth per collective" in proc.stdout
+    # every proxy family must have reported at least one bandwidth row
+    for proxy in ("dp", "fsdp", "hybrid_2d", "hybrid_3d", "hybrid_3d_moe",
+                  "ring_attention", "ulysses"):
+        assert proxy in proc.stdout, f"{proxy} missing from study output"
+    assert (tmp_path / "bandwidth_summary.csv").stat().st_size > 0
+    for png in ("dp_runtime_scaling", "dp_barrier_by_bucket",
+                "pareto_proxies"):
+        assert (tmp_path / f"{png}.png").stat().st_size > 0
+
+
+@pytest.mark.slow
 def test_example_study_end_to_end(tmp_path):
     """examples/dp_bucket_study.py must run the whole sweep->parse->plot
     loop and write the three PNGs."""
