@@ -267,6 +267,11 @@ class SequenceSchedule:
     a2a_elems: int                 # ulysses: one head<->seq reshard message
     num_ring_hops: int             # sp - 1 per attention layer
     attn_us_per_block: float       # compute per KV block per layer
+    attn_time_source: str          # "ffn_stats" (1 - ffn_fwd/fwd from the
+                                   # stat file) or "even_split_fallback"
+                                   # (0.5 — stats lacked FFN timings);
+                                   # emitted so analysis can tell which
+                                   # path produced attn_us_per_block
     layers: int
     bytes_per_element: float
 
@@ -286,8 +291,10 @@ def sequence_schedule(stats: ModelStats, card: ModelCard, sp: int,
     # fall back to an even split when the stats file lacks FFN timings
     if stats.fwd_us > 0 and stats.ffn_fwd_us > 0:
         attn_frac = 1.0 - stats.ffn_fwd_us / stats.fwd_us
+        attn_source = "ffn_stats"
     else:
         attn_frac = 0.5
+        attn_source = "even_split_fallback"
     attn_us = stats.fwd_us * attn_frac / max(card.num_layers, 1) / (sp * sp)
     return SequenceSchedule(
         sp=sp,
@@ -296,6 +303,7 @@ def sequence_schedule(stats: ModelStats, card: ModelCard, sp: int,
         a2a_elems=b * n_local * card.embed_dim,
         num_ring_hops=sp - 1,
         attn_us_per_block=attn_us,
+        attn_time_source=attn_source,
         layers=card.num_layers,
         bytes_per_element=stats.bytes_per_element,
     )

@@ -115,6 +115,9 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                                        * stats.bytes_per_element),
         "ring_hops_per_layer": sp - 1,
         "attn_us_per_block": sched.attn_us_per_block * cfg.time_scale,
+        # which estimator produced attn_us_per_block: "ffn_stats" (stat
+        # file carried FFN timings) or "even_split_fallback" (0.5 guess)
+        "attn_time_source": sched.attn_time_source,
         "burn_ns_per_iter": cal.ns_per_iter,
         "comm_model": {"ring_comm_time": [
             {"kind": "p2p", "group": sp,

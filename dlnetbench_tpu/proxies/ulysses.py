@@ -113,6 +113,9 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         "a2a_bytes": int(a2a_elems * jnp.dtype(dtype).itemsize),
         "schedule_a2a_bytes": int(sched.a2a_elems * stats.bytes_per_element),
         "a2a_per_layer": 4,
+        # which estimator produced the attention burn budget (see
+        # core/schedule.py sequence_schedule)
+        "attn_time_source": sched.attn_time_source,
         "burn_ns_per_iter": cal.ns_per_iter,
         "comm_model": {"a2a_comm_time": [
             {"kind": "alltoall", "group": sp,
