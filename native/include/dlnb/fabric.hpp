@@ -21,6 +21,7 @@
 #include <vector>
 
 #include "dlnb/communicator.hpp"
+#include "dlnb/harness.hpp"
 #include "dlnb/json.hpp"
 #include "dlnb/tensor.hpp"
 
@@ -54,6 +55,17 @@ class Fabric {
   }
   // This process's index in a multi-process run (metrics.merge key).
   virtual int process_index() const { return 0; }
+
+  // Simulated compute for rank `rank`: `us` microseconds, scaled by
+  // `time_scale`.  Default is the host sleep (the reference's usleep,
+  // cpp/data_parallel/dp.cpp:93); device-backed fabrics override this to
+  // burn REAL device cycles via a calibrated compiled kernel in the same
+  // slot (the JAX tier's proxies/burn.py equivalent), so "compute" loads
+  // the same execution stream the collectives run on.
+  virtual void burn(int rank, double us, double time_scale) {
+    (void)rank;
+    burn_us(us, time_scale);
+  }
 
   // Enrich the emitted record: backend/platform identity into `meta`,
   // device fabric description (and compile-cache stats) into `mesh`.

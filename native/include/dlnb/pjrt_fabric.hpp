@@ -36,6 +36,8 @@
 // — runs in CI without a TPU.
 #pragma once
 
+#include <chrono>
+#include <cmath>
 #include <condition_variable>
 #include <cstring>
 #include <functional>
@@ -69,6 +71,20 @@ class CollectiveExecutor {
   virtual std::string platform() const = 0;
   virtual std::size_t cache_hits() const = 0;
   virtual std::size_t cache_misses() const = 0;
+  // Burn ~`us` microseconds of REAL device compute on `rank`'s device
+  // (calibrated chained-matmul kernel, the JAX tier's proxies/burn.py
+  // analogue).  Returns false when the executor has no device to burn on
+  // (host executor) — callers fall back to the host sleep.
+  virtual bool device_burn(int rank, double us) {
+    (void)rank;
+    (void)us;
+    return false;
+  }
+  // "device_burn" | "host_sleep" — recorded so analyses can tell which
+  // compute simulation produced a record.
+  virtual std::string compute_mode() const { return "host_sleep"; }
+  // ns per burn iteration once calibrated (0 until then / host executor).
+  virtual double burn_ns_per_iter() const { return 0.0; }
 };
 
 // Host reference executor: the same CollectiveProgram semantics computed
@@ -179,6 +195,22 @@ class PluginExecutor : public CollectiveExecutor {
     PjrtCollectiveRunner{ctx_}.run(prog, srcs, dsts, dtype);
   }
 
+  // Calibrated on-device burn: the per-iteration cost is measured once
+  // (on device 0; a fabric's devices are one kind) by differencing two
+  // trip counts, cancelling dispatch and loop overheads — the same
+  // two-point scheme as the JAX tier (proxies/burn.py calibrate()).
+  bool device_burn(int rank, double us) override {
+    if (us <= 0) return true;
+    if (rank < 0 || rank >= ctx_.num_devices()) return false;
+    calibrate_once();
+    auto iters = static_cast<std::int32_t>(
+        std::max(1.0, std::round(us * 1000.0 / ns_per_iter_)));
+    ctx_.run_burn(rank, iters, kBurnWidth);
+    return true;
+  }
+  std::string compute_mode() const override { return "device_burn"; }
+  double burn_ns_per_iter() const override { return ns_per_iter_; }
+
   int num_devices() const { return ctx_.num_devices(); }
   std::string platform() const override {
     return const_cast<PjrtContext&>(ctx_).platform_name();
@@ -187,7 +219,30 @@ class PluginExecutor : public CollectiveExecutor {
   std::size_t cache_misses() const override { return ctx_.cache_misses(); }
 
  private:
+  static constexpr int kBurnWidth = 256;  // proxies/burn.py DEFAULT_SHAPE
+
+  void calibrate_once() {
+    std::call_once(calibrated_, [&] {
+      ctx_.run_burn(0, 1, kBurnWidth);  // compile + warm the dispatch path
+      const std::int32_t lo = 64, hi = 512;
+      auto time_iters = [&](std::int32_t n) {
+        auto t0 = std::chrono::steady_clock::now();
+        ctx_.run_burn(0, n, kBurnWidth);
+        return std::chrono::duration<double, std::nano>(
+                   std::chrono::steady_clock::now() - t0)
+            .count();
+      };
+      time_iters(lo);  // second warmup (buffer path now resident)
+      double t_lo = time_iters(lo), t_hi = time_iters(hi);
+      double nspi = (t_hi - t_lo) / static_cast<double>(hi - lo);
+      // guard against clock jitter producing a nonpositive slope
+      ns_per_iter_ = nspi > 0 ? nspi : std::max(t_hi / hi, 1.0);
+    });
+  }
+
   PjrtContext ctx_;
+  std::once_flag calibrated_;
+  double ns_per_iter_ = 0.0;
 };
 #endif  // DLNB_HAVE_PJRT
 
@@ -575,6 +630,15 @@ class PjrtFabric : public Fabric {
     if (first_error) std::rethrow_exception(first_error);
   }
 
+  // Real device cycles when the executor has devices (rank r burns on
+  // device r — the replica assignment the collectives use too), host
+  // sleep otherwise (host executor in CI).
+  void burn(int rank, double us, double time_scale) override {
+    double scaled = us * time_scale;
+    if (scaled <= 0) return;
+    if (!exec_->device_burn(rank, scaled)) burn_us(scaled);
+  }
+
   void describe(Json& meta, Json& mesh) const override {
     meta["backend"] = "pjrt";
     meta["pjrt_executor"] = exec_->platform();
@@ -583,6 +647,9 @@ class PjrtFabric : public Fabric {
     std::string plat = exec_->platform();
     meta["device"] = plat == "host" ? "cpu" : plat;
     meta["p2p_transport"] = "host";
+    meta["compute_mode"] = exec_->compute_mode();
+    if (exec_->burn_ns_per_iter() > 0)
+      meta["burn_ns_per_iter"] = exec_->burn_ns_per_iter();
     meta["cache_hits"] = static_cast<std::int64_t>(exec_->cache_hits());
     meta["cache_misses"] = static_cast<std::int64_t>(exec_->cache_misses());
     mesh["platform"] = exec_->platform();

@@ -207,6 +207,10 @@ struct SequenceSchedule {
   i64 a2a_elems;       // ulysses: one head<->seq reshard message
   i64 num_ring_hops;   // sp - 1 per attention layer
   double attn_us_per_block;
+  // "ffn_stats" | "even_split_fallback" — which estimator produced
+  // attn_us_per_block (emitted into the record, mirrors the JAX tier's
+  // core/schedule.py SequenceSchedule.attn_time_source)
+  std::string attn_time_source;
   i64 layers;
   double bytes_per_element;
 };
@@ -219,9 +223,8 @@ inline SequenceSchedule sequence_schedule(const ModelStats& st,
                                 " not divisible by sp=" + std::to_string(sp));
   i64 b = batch > 0 ? batch : st.batch_size;
   i64 n_local = card.seq_len / sp;
-  double attn_frac = (st.fwd_us > 0 && st.ffn_fwd_us > 0)
-                         ? 1.0 - st.ffn_fwd_us / st.fwd_us
-                         : 0.5;
+  bool have_ffn = st.fwd_us > 0 && st.ffn_fwd_us > 0;
+  double attn_frac = have_ffn ? 1.0 - st.ffn_fwd_us / st.fwd_us : 0.5;
   double attn_us = st.fwd_us * attn_frac /
                    std::max<i64>(card.num_layers(), 1) /
                    static_cast<double>(sp * sp);
@@ -231,6 +234,7 @@ inline SequenceSchedule sequence_schedule(const ModelStats& st,
                           b * n_local * card.embed_dim,
                           sp - 1,
                           attn_us,
+                          have_ffn ? "ffn_stats" : "even_split_fallback",
                           card.num_layers(),
                           st.bytes_per_element};
 }

@@ -483,6 +483,7 @@ class ShmFabric : public Fabric {
   void describe(Json& meta, Json& mesh) const override {
     meta["backend"] = "shm";
     meta["device"] = "cpu";
+    meta["compute_mode"] = "host_sleep";
     mesh["platform"] = "shm";
     mesh["device_kind"] = "thread-rank";
   }

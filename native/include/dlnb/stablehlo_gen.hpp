@@ -178,6 +178,46 @@ inline std::string generate_stablehlo(const CollectiveProgram& p) {
   return os.str();
 }
 
+// Generate the single-device compute-burn module: a dynamic-trip-count
+// while loop advancing `state <- tanh(state @ state / width)` — the same
+// chained-matmul burn the JAX tier calibrates (dlnetbench_tpu/proxies/
+// burn.py): strictly sequential (each iteration consumes the previous
+// state) so XLA can neither shrink nor parallelize it, values bounded by
+// tanh.  Signature: (iters: tensor<i32>, state: tensor<WxWxf32>) ->
+// tensor<WxWxf32>; the runtime trip count means ONE cached executable
+// serves every microsecond budget.
+inline std::string generate_burn_stablehlo(int width = 256) {
+  const std::string mat = "tensor<" + std::to_string(width) + "x" +
+                          std::to_string(width) + "xf32>";
+  std::ostringstream os;
+  os << "module @dlnb_burn attributes {mhlo.num_replicas = 1 : i32, "
+        "mhlo.num_partitions = 1 : i32} {\n"
+     << "  func.func public @main(%arg0: tensor<i32>, %arg1: " << mat
+     << ") -> " << mat << " {\n"
+     << "    %c0 = stablehlo.constant dense<0> : tensor<i32>\n"
+     << "    %c1 = stablehlo.constant dense<1> : tensor<i32>\n"
+     << "    %scale = stablehlo.constant dense<"
+     << (1.0 / static_cast<double>(width)) << "> : " << mat << "\n"
+     << "    %r:2 = stablehlo.while(%i = %c0, %x = %arg1) : tensor<i32>, "
+     << mat << "\n"
+     << "     cond {\n"
+     << "      %cmp = stablehlo.compare  LT, %i, %arg0 : (tensor<i32>, "
+        "tensor<i32>) -> tensor<i1>\n"
+     << "      stablehlo.return %cmp : tensor<i1>\n"
+     << "    } do {\n"
+     << "      %d = stablehlo.dot_general %x, %x, contracting_dims = [1] "
+        "x [0] : (" << mat << ", " << mat << ") -> " << mat << "\n"
+     << "      %s = stablehlo.multiply %d, %scale : " << mat << "\n"
+     << "      %t = stablehlo.tanh %s : " << mat << "\n"
+     << "      %ip1 = stablehlo.add %i, %c1 : tensor<i32>\n"
+     << "      stablehlo.return %ip1, %t : tensor<i32>, " << mat << "\n"
+     << "    }\n"
+     << "    return %r#1 : " << mat << "\n"
+     << "  }\n"
+     << "}\n";
+  return os.str();
+}
+
 // Serialized xla CompileOptionsProto carrying {executable_build_options
 // {num_replicas, num_partitions: 1, device_assignment?}} — the options
 // blob PJRT_Client_Compile expects.  Hand-encoded protobuf wire format;

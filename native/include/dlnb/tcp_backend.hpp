@@ -62,7 +62,7 @@ struct FrameHeader {
   std::uint32_t seq;      // per-(comm, slot) sequence number at the sender
   std::uint32_t op;       // OpKind for Coll; tag for P2P
   std::uint32_t src;      // sender's WORLD rank
-  std::uint64_t count;    // elements (Coll) / bytes (P2P)
+  std::uint64_t count;    // element count (both Coll and P2P)
   std::uint64_t bytes;    // payload size
 };
 
@@ -278,10 +278,8 @@ class TcpFabric : public Fabric {
   std::string backend() const override { return "tcp"; }
 
   std::unique_ptr<ProxyCommunicator> world_comm(int /*rank*/) override {
-    std::vector<int> all(world_);
-    for (int i = 0; i < world_; ++i) all[i] = i;
-    return std::make_unique<TcpCommunicator>(this, 0, all, rank_, dtype_,
-                                             num_slots_, "tcp_world");
+    return std::make_unique<TcpCommunicator>(this, 0, all_ranks(), rank_,
+                                             dtype_, num_slots_, "tcp_world");
   }
 
   // Collective split: colors are allgathered over an internal world
@@ -322,6 +320,7 @@ class TcpFabric : public Fabric {
   void describe(Json& meta, Json& mesh) const override {
     meta["backend"] = "tcp";
     meta["device"] = "cpu";
+    meta["compute_mode"] = "host_sleep";
     meta["num_processes"] = world_;
     mesh["platform"] = "tcp";
     mesh["device_kind"] = "process-rank";

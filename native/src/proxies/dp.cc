@@ -56,10 +56,12 @@ int main(int argc, char** argv) {
             sums.emplace_back(c, env.dtype);
           }
 
+          // device-backed fabrics burn real device cycles, others sleep
+          auto burn = [&](double us) { fab.burn(r, us, env.cfg.time_scale); };
           run = run_measured(env.cfg, *comm, ts, [&](TimerSet& t) {
-            burn_us(sched.fwd_us, env.cfg.time_scale);
+            burn(sched.fwd_us);
             for (i64 b = 0; b < sched.num_buckets; ++b) {
-              burn_us(sched.bwd_us_per_bucket, env.cfg.time_scale);
+              burn(sched.bwd_us_per_bucket);
               comm->Iallreduce(grads[b].data(), sums[b].data(), counts[b],
                                static_cast<int>(b));
             }

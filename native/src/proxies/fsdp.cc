@@ -71,6 +71,7 @@ int main(int argc, char** argv) {
             for (int u = 0; u < U; ++u)
               repl_sums.emplace_back(shard_elems, env.dtype);
 
+          auto burn = [&](double us) { fab.burn(r, us, env.cfg.time_scale); };
           run = run_measured(env.cfg, *world, ts, [&](TimerSet& t) {
             // initial blocking allgather of unit 0 (fsdp.cpp:86-91)
             {
@@ -82,18 +83,18 @@ int main(int argc, char** argv) {
             for (int u = 0; u < U - 1; ++u) {
               unit_comm->Iallgather(shards[u + 1].data(), fulls[u + 1].data(),
                                     shard_elems, u + 1);
-              burn_us(sched.fwd_us_per_unit, env.cfg.time_scale);
+              burn(sched.fwd_us_per_unit);
               auto sc = t.scoped("allgather_wait_fwd");
               unit_comm->Wait(u + 1);
             }
-            burn_us(sched.fwd_us_per_unit, env.cfg.time_scale);  // last unit
+            burn(sched.fwd_us_per_unit);  // last unit
 
             // backward: prefetch prev, compute, reduce-scatter grads
             // (fsdp.cpp:111-140)
             for (int u = U - 1; u >= 1; --u) {
               unit_comm->Iallgather(shards[u - 1].data(), fulls[u - 1].data(),
                                     shard_elems, u - 1);
-              burn_us(sched.bwd_us_per_unit, env.cfg.time_scale);
+              burn(sched.bwd_us_per_unit);
               {
                 auto sc = t.scoped("reduce_scatter");
                 unit_comm->ReduceScatterBlock(fulls[u].data(),
@@ -107,7 +108,7 @@ int main(int argc, char** argv) {
               unit_comm->Wait(u - 1);
             }
             // unit 0 backward + reduce-scatter (fsdp.cpp:143-152)
-            burn_us(sched.bwd_us_per_unit, env.cfg.time_scale);
+            burn(sched.bwd_us_per_unit);
             {
               auto sc = t.scoped("reduce_scatter");
               unit_comm->ReduceScatterBlock(fulls[0].data(),

@@ -90,6 +90,7 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
   const bool has_axis = grid.tp > 1;  // TP or EP axis present
 
   auto world = fab.world_comm(r);
+  auto burn = [&](double us) { fab.burn(r, us, env.cfg.time_scale); };
   auto pp_comm = fab.split(r, static_cast<int>(grid.pp_color(r)), "pp_comm");
   auto dp_comm = fab.split(r, static_cast<int>(grid.dp_color(r)), "dp_comm");
   std::unique_ptr<ProxyCommunicator> axis_comm;
@@ -151,14 +152,14 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
 
   auto fwd_mb = [&](TimerSet& t) {
     if (S == 1) {
-      burn_us(p.fwd_us_per_stage_mb, env.cfg.time_scale);
+      burn(p.fwd_us_per_stage_mb);
       return;
     }
     if (!first) {
       auto sc = t.scoped("pp_comm");
       pp_comm->Recv(act_in.data(), pipe_elems, stage - 1);
     }
-    burn_us(p.fwd_us_per_stage_mb, env.cfg.time_scale);
+    burn(p.fwd_us_per_stage_mb);
     if (!last) {
       if (spec.schedule == "gpipe") {
         auto sc = t.scoped("pp_comm");
@@ -173,14 +174,14 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
   };
   auto bwd_mb = [&](TimerSet& t) {
     if (S == 1) {
-      burn_us(p.bwd_us_per_stage_mb, env.cfg.time_scale);
+      burn(p.bwd_us_per_stage_mb);
       return;
     }
     if (!last) {
       auto sc = t.scoped("pp_comm");
       pp_comm->Recv(act_in.data(), pipe_elems, stage + 1);
     }
-    burn_us(p.bwd_us_per_stage_mb, env.cfg.time_scale);
+    burn(p.bwd_us_per_stage_mb);
     if (!first) {
       if (spec.schedule == "gpipe") {
         auto sc = t.scoped("pp_comm");

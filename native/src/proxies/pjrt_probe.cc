@@ -100,6 +100,11 @@ int main(int argc, char** argv) {
     }
 
     if (std::string op = args.str("emit"); !op.empty()) {
+      if (op == "burn") {  // the device compute-burn module (fabric.burn)
+        std::cout << generate_burn_stablehlo(
+            static_cast<int>(args.integer("count")));
+        return 0;
+      }
       CollectiveProgram prog;
       prog.op = op_from_name(op);
       prog.dtype = dtype_from_name(args.str("dtype"));

@@ -47,6 +47,7 @@ int main(int argc, char** argv) {
     meta["schedule_kv_block_bytes"] =
         static_cast<i64>(sched.kv_block_elems * sched.bytes_per_element);
     meta["attn_us_per_block"] = sched.attn_us_per_block * env.cfg.time_scale;
+    meta["attn_time_source"] = sched.attn_time_source;
 
     return run_proxy_main(
         "ring_attention", env, meta,
@@ -62,12 +63,13 @@ int main(int argc, char** argv) {
             dp_comm =
                 fab.split(r, static_cast<int>(grid.dp_color(r)), "dp_comm");
 
+          auto burn = [&](double us) { fab.burn(r, us, env.cfg.time_scale); };
           Tensor kv_out(kv_elems, env.dtype), kv_in(kv_elems, env.dtype);
           Tensor g_src(grad_elems, env.dtype), g_dst(grad_elems, env.dtype);
 
           auto ring_pass = [&](TimerSet& t, double block_us) {
             for (i64 hop = 0; hop < sp; ++hop) {
-              burn_us(block_us, env.cfg.time_scale);
+              burn(block_us);
               if (hop < sp - 1) {
                 auto sc = t.scoped("ring_comm");
                 // rotate every rank's KV block to its successor — the
@@ -81,11 +83,11 @@ int main(int argc, char** argv) {
           run = run_measured(env.cfg, *world, ts, [&](TimerSet& t) {
             for (i64 l = 0; l < layers; ++l) {  // forward
               ring_pass(t, sched.attn_us_per_block);
-              burn_us(mlp_us_per_layer, env.cfg.time_scale);
+              burn(mlp_us_per_layer);
             }
             for (i64 l = 0; l < layers; ++l) {  // backward ~2x
               ring_pass(t, 2 * sched.attn_us_per_block);
-              burn_us(2 * mlp_us_per_layer, env.cfg.time_scale);
+              burn(2 * mlp_us_per_layer);
             }
             if (dp_comm) {
               auto sc = t.scoped("dp_comm");

@@ -42,6 +42,7 @@ int main(int argc, char** argv) {
     meta["sp"] = sp;
     meta["dp"] = dp;
     meta["layers"] = layers;
+    meta["attn_time_source"] = sched.attn_time_source;
     meta["a2a_bytes"] =
         static_cast<i64>(a2a_per_rank * sp * dtype_bytes(env.dtype));
     meta["schedule_a2a_bytes"] =
@@ -60,6 +61,7 @@ int main(int argc, char** argv) {
             dp_comm =
                 fab.split(r, static_cast<int>(grid.dp_color(r)), "dp_comm");
 
+          auto burn = [&](double us) { fab.burn(r, us, env.cfg.time_scale); };
           Tensor a2a_src(a2a_per_rank * sp, env.dtype);
           Tensor a2a_dst(a2a_per_rank * sp, env.dtype);
           Tensor g_src(grad_elems, env.dtype), g_dst(grad_elems, env.dtype);
@@ -69,12 +71,12 @@ int main(int argc, char** argv) {
               auto sc = t.scoped("a2a_comm");
               sp_comm->Alltoall(a2a_src.data(), a2a_dst.data(), a2a_per_rank);
             }
-            burn_us(attn_us_per_layer * scale, env.cfg.time_scale);
+            burn(attn_us_per_layer * scale);
             {  // reshard heads -> seq
               auto sc = t.scoped("a2a_comm");
               sp_comm->Alltoall(a2a_dst.data(), a2a_src.data(), a2a_per_rank);
             }
-            burn_us(mlp_us_per_layer * scale, env.cfg.time_scale);
+            burn(mlp_us_per_layer * scale);
           };
 
           run = run_measured(env.cfg, *world, ts, [&](TimerSet& t) {
