@@ -27,8 +27,11 @@ from pathlib import Path
 
 from dlnetbench_tpu.metrics.parser import load_records, validate_record
 
-# global keys that legitimately differ between the emitting processes
-_VOLATILE_GLOBALS = {"energy_source"}
+# global keys that legitimately differ between the emitting processes:
+# per-process measurements (each process calibrates its own burn kernel)
+# and host-local identity — never evidence of records from different runs
+_VOLATILE_GLOBALS = {"energy_source", "burn_ns_per_iter", "cache_hits",
+                     "cache_misses"}
 
 
 def _comparable_global(g: dict) -> dict:
@@ -124,6 +127,11 @@ def main(argv: list[str] | None = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     section = None
     if args and args[0] == "--section":
+        if len(args) < 2:
+            print("usage: python -m dlnetbench_tpu.metrics.merge "
+                  "[--section NAME] OUT.jsonl IN0.jsonl IN1.jsonl ...",
+                  file=sys.stderr)
+            return 2
         section = args[1]
         args = args[2:]
     if len(args) < 2:

@@ -68,6 +68,19 @@ def test_merge_rejects_mismatched_globals():
                        _replace(_proc_record(1), num_buckets=4)])
 
 
+def test_merge_tolerates_per_process_measured_globals():
+    """Every proxy emits its own measured burn calibration (and the pjrt
+    backend its cache counters) into the globals; processes never agree on
+    those floats, and the merge must not mistake them for records from
+    different runs."""
+    merged = merge_records([
+        _replace(_proc_record(0), burn_ns_per_iter=101.7, cache_hits=5),
+        _replace(_proc_record(1), burn_ns_per_iter=98.2, cache_hits=9),
+    ])
+    assert [r["rank"] for r in merged["ranks"]] == [0, 1, 2, 3]
+    validate_record(merged)
+
+
 def test_merge_rejects_mismatched_num_runs():
     bad = _proc_record(1)
     bad["num_runs"] = 5
