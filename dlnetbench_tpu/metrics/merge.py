@@ -125,19 +125,17 @@ def merge_files(out_path: str | Path, in_paths: list[str | Path],
 
 def main(argv: list[str] | None = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
+    usage = ("usage: python -m dlnetbench_tpu.metrics.merge "
+             "[--section NAME] OUT.jsonl IN0.jsonl IN1.jsonl ...")
     section = None
     if args and args[0] == "--section":
         if len(args) < 2:
-            print("usage: python -m dlnetbench_tpu.metrics.merge "
-                  "[--section NAME] OUT.jsonl IN0.jsonl IN1.jsonl ...",
-                  file=sys.stderr)
+            print(usage, file=sys.stderr)
             return 2
         section = args[1]
         args = args[2:]
     if len(args) < 2:
-        print("usage: python -m dlnetbench_tpu.metrics.merge "
-              "[--section NAME] OUT.jsonl IN0.jsonl IN1.jsonl ...",
-              file=sys.stderr)
+        print(usage, file=sys.stderr)
         return 2
     merged = merge_files(args[0], args[1:], section)
     print(f"merged {len(args) - 1} process record(s): "

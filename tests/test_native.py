@@ -308,9 +308,11 @@ def test_native_tcp_selftest(native_bin):
     """Every collective + p2p + split verified across 2 OS processes
     ('correct sums' done-criterion)."""
     # the freshly-probed port can be stolen before rank 0 binds it
-    # (TOCTOU); retry on a new port ONLY for that distinguishable bind
-    # failure — any other non-zero exit is a real fabric regression and
-    # must fail immediately, not be retried into an occasional flake
+    # (TOCTOU); retry on a new port ONLY for that distinguishable
+    # signature — rank 0's bind failure, or a hang (the thief may itself
+    # be listening, wedging rank 1 against a foreign coordinator).  Any
+    # other non-zero exit is a real fabric regression and must fail
+    # immediately, not be retried into an occasional flake.
     for attempt in range(3):
         port = _free_port()
         procs = [subprocess.Popen(
@@ -318,10 +320,18 @@ def test_native_tcp_selftest(native_bin):
              "--rank", str(r), "--coordinator", f"127.0.0.1:{port}"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
             for r in range(2)]
-        outs = [p.communicate(timeout=90)[0] for p in procs]
+        outs, timed_out = [], False
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=90)[0])
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                p.kill()
+                outs.append(p.communicate()[0])
         if all(p.returncode == 0 for p in procs):
             break
-        port_stolen = any("tcp: bind failed (port" in o for o in outs)
+        port_stolen = (timed_out
+                       or any("tcp: bind failed (port" in o for o in outs))
         if not port_stolen or attempt == 2:
             break
     for r, (p, out) in enumerate(zip(procs, outs)):
