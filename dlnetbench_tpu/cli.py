@@ -82,10 +82,11 @@ def _add_pipeline(p: argparse.ArgumentParser) -> None:
     _add_common(p)
     p.add_argument("--num_stages", type=int, required=True)
     p.add_argument("--num_microbatches", type=int, required=True)
-    p.add_argument("--schedule", choices=["gpipe", "1f1b"],
+    p.add_argument("--schedule", choices=["gpipe", "1f1b", "zb"],
                    default="gpipe",
                    help="pipeline schedule (gpipe = reference parity; "
-                        "1f1b = interleaved fwd/bwd, rebuild extra)")
+                        "1f1b = interleaved fwd/bwd and zb = ZB-H1 "
+                        "zero-bubble, rebuild extras)")
 
 
 def _devices(args, parser):

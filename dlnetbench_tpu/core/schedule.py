@@ -257,6 +257,92 @@ def moe_schedule(stats: ModelStats, card: ModelCard, *,
 
 
 # --------------------------------------------------------------------- #
+# Zero-bubble pipeline tick tables (rebuild extension; no reference
+# counterpart — the reference models only GPipe, hybrid_2d.cpp:106-161)
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class ZBTables:
+    """Per-tick op sets for the ZB-H1 schedule (Qi et al., "Zero Bubble
+    Pipeline Parallelism"): backward is split into the input-grad half B
+    (must propagate to the previous stage) and the weight-grad half W
+    (local, no hop), and W ticks fill the drain bubble.  With the stat
+    model's bwd = 2 x fwd (reference python/model_stats.py:140), F, B and
+    W are equal one-unit ticks — the exact setting where ZB-H1 removes
+    most of the 1F1B bubble.
+
+    Each list has one entry per tick; entry = sorted list of stages doing
+    that op in the tick.  Hops derive directly: a stage doing F sends up
+    (except the last), a stage doing B sends down (except the first).
+    """
+    f_stages: list[list[int]]
+    b_stages: list[list[int]]
+    w_stages: list[list[int]]
+
+    @property
+    def ticks(self) -> int:
+        return len(self.f_stages)
+
+    def f_senders(self, num_stages: int) -> list[list[int]]:
+        return [[s for s in tick if s < num_stages - 1]
+                for tick in self.f_stages]
+
+    def b_senders(self) -> list[list[int]]:
+        return [[s for s in tick if s > 0] for tick in self.b_stages]
+
+
+def zb_tables(num_stages: int, num_microbatches: int) -> ZBTables:
+    """Tick-synchronous greedy construction of ZB-H1: every stage runs at
+    most one unit op per tick with priority B > F > W.  Dependencies:
+    F(k)@s needs F(k)@(s-1) done in an earlier tick (activation hop);
+    B(k)@s needs F(k)@s locally and B(k)@(s+1) done earlier (grad hop);
+    W(k)@s needs B(k)@s.  The B-first priority reproduces the 1F1B
+    skeleton; W's slot into ticks that 1F1B leaves idle, which is the
+    whole point of the schedule."""
+    S, M = num_stages, num_microbatches
+    if S <= 0 or M <= 0:
+        raise ValueError("num_stages and num_microbatches must be positive")
+    f_tick = [[-1] * M for _ in range(S)]   # tick F(k) ran at stage s
+    b_tick = [[-1] * M for _ in range(S)]
+    nf = [0] * S                            # next F/B/W index per stage
+    nb = [0] * S
+    nw = [0] * S
+    f_stages: list[list[int]] = []
+    b_stages: list[list[int]] = []
+    w_stages: list[list[int]] = []
+    while any(nw[s] < M for s in range(S)):
+        t = len(f_stages)
+        ft, bt, wt = [], [], []
+        for s in range(S):
+            # cross-stage deps compare tick indices STRICTLY below t, so a
+            # hop never lands in the tick it was sent (stage s-1's F this
+            # very tick must not enable stage s's F until the next tick)
+            k = nb[s]
+            if (k < nf[s]
+                    and (s == S - 1
+                         or 0 <= b_tick[s + 1][k] < t)):
+                bt.append(s)
+                b_tick[s][k] = t
+                nb[s] += 1
+                continue
+            k = nf[s]
+            if (k < M
+                    and (s == 0 or 0 <= f_tick[s - 1][k] < t)):
+                ft.append(s)
+                f_tick[s][k] = t
+                nf[s] += 1
+                continue
+            if nw[s] < nb[s]:
+                wt.append(s)
+                nw[s] += 1
+        f_stages.append(ft)
+        b_stages.append(bt)
+        w_stages.append(wt)
+        if len(f_stages) > 4 * (M + S):  # pragma: no cover - safety bound
+            raise RuntimeError("zb_tables failed to converge")
+    return ZBTables(f_stages, b_stages, w_stages)
+
+
+# --------------------------------------------------------------------- #
 # Sequence/context parallelism (rebuild extension, SURVEY.md §5.7)
 # --------------------------------------------------------------------- #
 @dataclasses.dataclass(frozen=True)

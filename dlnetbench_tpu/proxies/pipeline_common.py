@@ -29,7 +29,8 @@ from jax.sharding import PartitionSpec as P
 
 from dlnetbench_tpu.core.model_card import ModelCard
 from dlnetbench_tpu.core.model_stats import ModelStats
-from dlnetbench_tpu.core.schedule import moe_schedule, pipeline_schedule
+from dlnetbench_tpu.core.schedule import (
+    moe_schedule, pipeline_schedule, zb_tables)
 from dlnetbench_tpu.parallel import collectives as col
 from dlnetbench_tpu.parallel.buffers import scaled_elems, sharded_zeros
 from dlnetbench_tpu.parallel.mesh import (
@@ -54,12 +55,15 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
           schedule: str = "gpipe", devices=None,
           dtype=jnp.float32) -> StepBundle:
     """``schedule``: "gpipe" (all-fwd-then-all-bwd, the reference's only
-    schedule, hybrid_2d.cpp:106-161) or "1f1b" (rebuild extra: pp-1
+    schedule, hybrid_2d.cpp:106-161), "1f1b" (rebuild extra: pp-1
     forward warmup ticks, then interleaved fwd/bwd pairs, then backward
     cooldown — the up and down pipe hops of a steady-state pair ride the
-    bidirectional links together instead of in two serial phases)."""
+    bidirectional links together instead of in two serial phases), or
+    "zb" (rebuild extra: ZB-H1 zero-bubble — backward split into the
+    input-grad hop half and a local weight-grad half that fills the drain
+    bubble; core/schedule.py zb_tables)."""
     assert mode in ("2d", "3d", "moe")
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in ("gpipe", "1f1b", "zb"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     devices = devices if devices is not None else jax.devices()
     world = len(devices)
@@ -81,6 +85,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
 
     fwd_iters = cal.iters_for_us(sched.fwd_us_per_stage_mb * cfg.time_scale)
     bwd_iters = cal.iters_for_us(sched.bwd_us_per_stage_mb * cfg.time_scale)
+    # zb splits backward into equal input-grad (B) and weight-grad (W)
+    # halves (dgrad and wgrad each re-walk the layer's matmuls once)
+    half_bwd_iters = cal.iters_for_us(
+        sched.bwd_us_per_stage_mb / 2 * cfg.time_scale)
 
     pipe_elems = scaled_elems(sched.pipe_msg_elems, cfg.size_scale)
     dp_elems = scaled_elems(sched.dp_sync_elems, cfg.size_scale)
@@ -94,10 +102,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         ex_elems = scaled_elems(moe.expert_sync_elems, cfg.size_scale)
 
     act = sharded_zeros(mesh, P(), (pipe_elems,), dtype)
-    # second carry only exists for 1f1b's independent down-hop; gpipe runs
-    # feed a 1-element dummy (like ne_in/ex_in) and never touch it
+    # second carry only exists for 1f1b/zb's independent down-hop; gpipe
+    # runs feed a 1-element dummy (like ne_in/ex_in) and never touch it
     act2 = sharded_zeros(mesh, P(), (pipe_elems,), dtype) \
-        if schedule == "1f1b" else None
+        if schedule in ("1f1b", "zb") else None
     grad_shard = sharded_zeros(mesh, P(), (dp_elems,), dtype)
     tp_buf = sharded_zeros(mesh, P(), (max(tp_elems, 1),), dtype)
     a2a_buf = sharded_zeros(mesh, P(), (max(a2a_elems, num_expert_shards),),
@@ -162,8 +170,13 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
     drain_senders = [[s for s in range(1, S)
                       if (S - 1 - s) - M <= d < (S - 1 - s)]
                      for d in range(S - 1)]
+    # zb: ZB-H1 greedy tick tables (F / input-grad B / weight-grad W);
+    # only F and B hop (W is the local weight-grad half)
+    zb = zb_tables(S, M) if schedule == "zb" else None
     if schedule == "gpipe":
         _sender_tables = (gp_fwd_senders, gp_bwd_senders)
+    elif schedule == "zb":
+        _sender_tables = (zb.f_senders(S), zb.b_senders())
     else:
         _sender_tables = (fill_senders, steady_f_senders,
                           steady_b_senders, drain_senders)
@@ -215,6 +228,45 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                 state = col.tie(state, cur)
                 if t >= S - 1:
                     outs.extend(inner_comms(state, bufs, with_comm))
+        elif schedule == "zb":
+            # ZB-H1: one unit op per stage per tick from the greedy
+            # tables.  F hops up and B hops down on independent carries
+            # (1f1b's overlap property); W is a local burn only — the
+            # weight-grad half that fills what 1f1b leaves as bubble.
+            def stage_in(stages_list):
+                if not stages_list:
+                    return None
+                pred = (stage == stages_list[0])
+                for s in stages_list[1:]:
+                    pred = pred | (stage == s)
+                return pred
+
+            f_send, b_send = _sender_tables
+            cur_b = act2_b
+            for t in range(zb.ticks):
+                pf = stage_in(zb.f_stages[t])
+                if pf is not None:
+                    state = burn_(state, fwd_iters, pf)
+                pb = stage_in(zb.b_stages[t])
+                if pb is not None:
+                    state = burn_(state, half_bwd_iters, pb)
+                pw = stage_in(zb.w_stages[t])
+                if pw is not None:
+                    state = burn_(state, half_bwd_iters, pw)
+                up = col.shift_up(col.tie(cur, state), AXIS_PP, f_send[t]) \
+                    if with_comm and f_send[t] else cur
+                down = col.shift_down(col.tie(cur_b, state), AXIS_PP,
+                                      b_send[t]) \
+                    if with_comm and b_send[t] else cur_b
+                # inner TP/EP traffic rides wave completions so totals
+                # stay 2 calls x M (same as the other schedules)
+                if (S - 1) in zb.f_stages[t]:
+                    outs.extend(inner_comms(state, bufs, with_comm))
+                if 0 in zb.b_stages[t]:
+                    outs.extend(inner_comms(state, bufs, with_comm))
+                cur, cur_b = up, down
+                state = col.tie(col.tie(state, cur), cur_b)
+            outs.append(cur_b)
         else:  # 1f1b: fill / steady pairs / drain, same (M+S-1)-tick clock
             # Unlike the GPipe ticks (blocking send: inner comms tie on the
             # hop, matching the reference's serial recv/compute/send +
@@ -312,6 +364,15 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                 if senders:
                     a = col.shift_down(a, AXIS_PP, senders)
                     outs.append(a)
+        elif schedule == "zb":  # per-tick up/down on independent carries
+            f_send, b_send = _sender_tables
+            for t in range(zb.ticks):
+                if f_send[t]:
+                    a = col.shift_up(a, AXIS_PP, f_send[t])
+                    outs.append(a)
+                if b_send[t]:
+                    a2 = col.shift_down(a2, AXIS_PP, b_send[t])
+                    outs.append(a2)
         else:  # 1f1b: steady pairs on independent carries (overlappable)
             for senders in fill_senders:
                 if senders:
@@ -377,6 +438,13 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         # both schedules pay the (S-1)-tick fill/drain bubble; analysis can
         # divide runtime by this to recover per-tick cost
         "ticks_per_direction": ticks_per_direction,
+        # pipeline clock in UNIT ticks (1 unit = fwd = half-bwd, the stat
+        # model's bwd = 2 x fwd): gpipe/1f1b span (M+S-1) fwd ticks plus
+        # (M+S-1) 2-unit bwd ticks = 3(M+S-1); zb's greedy table is
+        # 3M + (S-1) single-unit ticks.  Dividing runtime by this gives a
+        # schedule-comparable per-unit cost (the zero-bubble gain).
+        "ticks_total": zb.ticks if zb is not None
+        else 3 * ticks_per_direction,
         "pp_permute_ticks": pp_permute_ticks,
         "pp_edge_messages": pp_edge_messages,
         "layers_per_stage": sched.layers_per_stage,

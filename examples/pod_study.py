@@ -71,8 +71,12 @@ def build_plan(models: list[str], devices: int) -> list[tuple[str, dict]]:
                                 "d": devices}))
         plan.append(("fsdp", {"model": model, "num_units": 8,
                               "sharding_factor": half}))
-        plan.append(("hybrid_2d", {"model": model, "num_stages": 4,
-                                   "num_microbatches": 8, "dp": quarter}))
+        # pipeline-schedule comparison: reference GPipe vs the rebuild's
+        # 1F1B and ZB-H1 extras, same grid and microbatch totals
+        for sch in ("gpipe", "1f1b", "zb"):
+            plan.append(("hybrid_2d", {"model": model, "num_stages": 4,
+                                       "num_microbatches": 8,
+                                       "dp": quarter, "schedule": sch}))
         plan.append(("hybrid_3d", {"model": model, "num_stages": 2,
                                    "num_microbatches": 8, "tp": 2,
                                    "dp": quarter}))
@@ -139,23 +143,28 @@ def report(args, records: Path) -> None:
         s.insert(0, "proxy", g.get("variables", {}).get("proxy",
                                                         rec.get("section")))
         s.insert(1, "world", len(rec.get("ranks", [])))
+        s.insert(2, "sched", g.get("schedule", ""))
         per_point.append(s)
     if per_point:
         bw = pd.concat(per_point, ignore_index=True)
         # one line per (proxy, model, world, collective): the per-iteration
         # exposed time and the standard busbw figure
-        cols = ["proxy", "model", "world", "collective", "group_size",
-                "time_us", "algbw_GBps", "busbw_GBps"]
-        bw = (bw.groupby(cols[:5], as_index=False)[cols[5:]].mean()
-              .sort_values(["proxy", "model", "world"]))[cols]
+        cols = ["proxy", "model", "world", "sched", "collective",
+                "group_size", "time_us", "algbw_GBps", "busbw_GBps"]
+        bw = (bw.groupby(cols[:6], as_index=False)[cols[6:]].mean()
+              .sort_values(["proxy", "model", "world", "sched"]))[cols]
         print("\n=== effective bandwidth per collective "
               "(mean over ranks/runs) ===")
         print(bw.to_string(index=False,
                            float_format=lambda v: f"{v:10.2f}"))
         bw.to_csv(args.out_dir / "bandwidth_summary.csv", index=False)
 
-    # --- runtime summary per study point
-    summary = (df.groupby(["proxy", "model", "world_size"])["runtime"]
+    # --- runtime summary per study point (schedule column distinguishes
+    # the hybrid_2d gpipe/1f1b/zb comparison points)
+    group_cols = ["proxy", "model", "world_size"]
+    if "schedule" in df:
+        group_cols.append("schedule")
+    summary = (df.groupby(group_cols, dropna=False)["runtime"]
                .mean().rename("runtime_us").reset_index())
     print("\n=== mean iteration runtime (us) ===")
     print(summary.to_string(index=False,

@@ -155,6 +155,64 @@ inline PipelineSchedule pipeline_schedule(const ModelStats& st,
       st.bytes_per_element};
 }
 
+// ------------------------------------------------- zero-bubble pipeline
+// ZB-H1 per-stage op program (rebuild extension; the reference models
+// only GPipe).  Same tick-synchronous greedy as the JAX tier
+// (dlnetbench_tpu/core/schedule.py zb_tables): one unit op per stage per
+// tick, priority B > F > W, cross-stage deps land strictly after the
+// tick that produced them.  F = forward microbatch (hops up), B =
+// input-grad half (hops down), W = local weight-grad half (no hop; fills
+// the drain bubble).  Returns stage `s`'s ops in execution order — the
+// blocking recv/async send discipline of the engine realizes the timing.
+struct ZBOp {
+  char kind;  // 'F' | 'B' | 'W'
+  i64 mb;     // microbatch index
+};
+
+inline std::vector<ZBOp> zb_ops(i64 num_stages, i64 num_microbatches,
+                                i64 stage) {
+  const i64 S = num_stages, M = num_microbatches;
+  if (S <= 0 || M <= 0)
+    throw std::invalid_argument("zb_ops: S and M must be positive");
+  std::vector<std::vector<i64>> f_tick(S, std::vector<i64>(M, -1));
+  std::vector<std::vector<i64>> b_tick(S, std::vector<i64>(M, -1));
+  std::vector<i64> nf(S, 0), nb(S, 0), nw(S, 0);
+  std::vector<ZBOp> mine;
+  i64 t = 0;
+  auto done = [&] {
+    for (i64 s = 0; s < S; ++s)
+      if (nw[s] < M) return false;
+    return true;
+  };
+  while (!done()) {
+    for (i64 s = 0; s < S; ++s) {
+      i64 k = nb[s];
+      if (k < nf[s] &&
+          (s == S - 1 || (b_tick[s + 1][k] >= 0 && b_tick[s + 1][k] < t))) {
+        b_tick[s][k] = t;
+        ++nb[s];
+        if (s == stage) mine.push_back({'B', k});
+        continue;
+      }
+      k = nf[s];
+      if (k < M &&
+          (s == 0 || (f_tick[s - 1][k] >= 0 && f_tick[s - 1][k] < t))) {
+        f_tick[s][k] = t;
+        ++nf[s];
+        if (s == stage) mine.push_back({'F', k});
+        continue;
+      }
+      if (nw[s] < nb[s]) {
+        ++nw[s];
+        if (s == stage) mine.push_back({'W', nw[s] - 1});
+      }
+    }
+    if (++t > 4 * (M + S))
+      throw std::runtime_error("zb_ops failed to converge");
+  }
+  return mine;
+}
+
 // ----------------------------------------------------------------- MoE/EP
 struct MoESchedule {
   PipelineSchedule pipe;

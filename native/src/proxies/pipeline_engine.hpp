@@ -26,9 +26,10 @@ namespace dlnb {
 
 struct HybridSpec {
   PipelineSchedule pipe;
-  // "gpipe" (reference parity) or "1f1b" (rebuild extra: per-stage warmup
+  // "gpipe" (reference parity), "1f1b" (rebuild extra: per-stage warmup
   // of S-1-stage forwards, steady fwd/bwd pairs with slot-indexed Isend so
-  // opposite-direction hops are in flight together, backward cooldown)
+  // opposite-direction hops are in flight together, backward cooldown), or
+  // "zb" (rebuild extra: ZB-H1 zero-bubble, schedule.hpp zb_ops)
   std::string schedule = "gpipe";
   // MoE extras (zero/unused unless is_moe)
   bool is_moe = false;
@@ -51,6 +52,13 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
   // (reference hybrid_2d.cpp:106-133), so measured runtime spans
   // (M + S - 1) ticks per direction, not M — same clock as the JAX tier
   meta["ticks_per_direction"] = p.num_microbatches + p.grid.pp - 1;
+  // pipeline clock in UNIT ticks (1 unit = fwd = half-bwd): the 2-phase
+  // schedules span 3(M+S-1); zb's greedy table is 3M + (S-1) (mirrors
+  // the JAX tier's ticks_total so cross-tier analyses divide alike)
+  meta["ticks_total"] =
+      spec.schedule == "zb"
+          ? 3 * p.num_microbatches + p.grid.pp - 1
+          : 3 * (p.num_microbatches + p.grid.pp - 1);
   meta["dp"] = p.grid.dp;
   meta["layers_per_stage"] = p.layers_per_stage;
   meta["pipe_msg_bytes"] = static_cast<i64>(
@@ -143,11 +151,12 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
     }
   };
 
-  // 1f1b uses slot-indexed Isend (slot 0 = up, slot 1 = down) so the two
-  // directions can be in flight together; the slot is drained (untimed)
-  // right before reuse, and each direction has its own out buffer
-  // (allocated only when 1f1b actually runs).
-  Tensor act_out2(spec.schedule == "1f1b" ? pipe_elems : 0, env.dtype);
+  // 1f1b and zb use slot-indexed Isend (slot 0 = up, slot 1 = down) so
+  // the two directions can be in flight together; the slot is drained
+  // (untimed) right before reuse, and each direction has its own out
+  // buffer (allocated for every non-gpipe schedule).
+  Tensor act_out2(spec.schedule != "gpipe" ? pipe_elems : 0,
+                  env.dtype);
   bool up_pending = false, down_pending = false;
 
   auto fwd_mb = [&](TimerSet& t) {
@@ -172,16 +181,17 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
       }
     }
   };
-  auto bwd_mb = [&](TimerSet& t) {
+  auto bwd_mb = [&](TimerSet& t, bool half = false) {
+    double bwd_us = p.bwd_us_per_stage_mb * (half ? 0.5 : 1.0);
     if (S == 1) {
-      burn(p.bwd_us_per_stage_mb);
+      burn(bwd_us);
       return;
     }
     if (!last) {
       auto sc = t.scoped("pp_comm");
       pp_comm->Recv(act_in.data(), pipe_elems, stage + 1);
     }
-    burn(p.bwd_us_per_stage_mb);
+    burn(bwd_us);
     if (!first) {
       if (spec.schedule == "gpipe") {
         auto sc = t.scoped("pp_comm");
@@ -207,6 +217,26 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
         bwd_mb(t);
         axis_traffic(t);
       }
+    } else if (spec.schedule == "zb") {
+      // ---- ZB-H1 zero-bubble: execute this stage's op program from the
+      // shared greedy tables (schedule.hpp zb_ops, mirroring the JAX
+      // tier's core/schedule.py zb_tables).  F hops up, the input-grad
+      // half B hops down (slot-indexed Isends as in 1f1b), and the local
+      // weight-grad half W burns without any hop — the op that fills the
+      // 1f1b drain bubble. ----
+      for (const ZBOp& op : zb_ops(S, M, stage)) {
+        if (op.kind == 'F') {
+          fwd_mb(t);
+          axis_traffic(t);
+        } else if (op.kind == 'B') {
+          bwd_mb(t, /*half=*/true);
+          axis_traffic(t);
+        } else {
+          burn(p.bwd_us_per_stage_mb / 2);
+        }
+      }
+      if (up_pending) { pp_comm->Wait(0); up_pending = false; }
+      if (down_pending) { pp_comm->Wait(1); down_pending = false; }
     } else {
       // ---- 1f1b: per-stage warmup, steady pairs, cooldown ----
       const int warm = std::min(S - 1 - stage, M);
@@ -264,12 +294,14 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
 // three proxy mains in lockstep).
 inline void add_schedule_arg(Args& args) {
   args.optional_str("schedule", "gpipe",
-                    "pipeline schedule: gpipe (reference parity) or 1f1b");
+                    "pipeline schedule: gpipe (reference parity), 1f1b, "
+                    "or zb (ZB-H1 zero-bubble)");
 }
 
 inline void set_schedule(HybridSpec& spec, const Args& args) {
   spec.schedule = args.str("schedule");
-  if (spec.schedule != "gpipe" && spec.schedule != "1f1b")
+  if (spec.schedule != "gpipe" && spec.schedule != "1f1b" &&
+      spec.schedule != "zb")
     throw std::runtime_error("unknown schedule: " + spec.schedule);
 }
 

@@ -280,15 +280,34 @@ def test_native_1f1b_schedule(native_bin):
     from dlnetbench_tpu.metrics.parser import validate_record
 
     recs = {}
-    for sch in ("gpipe", "1f1b"):
+    for sch in ("gpipe", "1f1b", "zb"):
         rec = run_proxy(native_bin, "hybrid_2d", "--num_stages", 4,
                         "--num_microbatches", 8, "--schedule", sch,
                         model="llama3_8b_16_bfloat16", world=8)
         validate_record(rec)
         assert rec["global"]["schedule"] == sch
         recs[sch] = rec
-    for a, b in zip(recs["gpipe"]["ranks"], recs["1f1b"]["ranks"]):
-        assert len(a["pp_comm"]) == len(b["pp_comm"])  # same hop totals
+    for other in ("1f1b", "zb"):
+        for a, b in zip(recs["gpipe"]["ranks"], recs[other]["ranks"]):
+            assert len(a["pp_comm"]) == len(b["pp_comm"])  # same hop totals
+
+
+def test_native_zb_beats_two_phase_wall(native_bin):
+    """ZB-H1's weight-grad ticks fill the drain bubble: with burns
+    dominating (time_scale high enough that sleeps dwarf comm), the zb
+    iteration must run measurably under the 1f1b/gpipe wall.  S=4, M=4:
+    zb clock = 3M + S - 1 = 15 units vs 3(M + S - 1) = 21 — ratio 0.71."""
+    times = {}
+    for sch in ("1f1b", "zb"):
+        rec = run_proxy(native_bin, "hybrid_2d", "--num_stages", 4,
+                        "--num_microbatches", 4, "--dp", 1,
+                        "--schedule", sch, "--time_scale", "0.05",
+                        "--runs", 3, world=4)
+        times[sch] = min(rec["ranks"][0]["runtimes"])
+    ratio = times["zb"] / times["1f1b"]
+    assert ratio < 0.9, (
+        f"zb/1f1b runtime ratio {ratio:.3f}; expected ~0.71 — the "
+        f"weight-grad ticks are not filling the bubble")
 
 
 # ---------------------------------------------------------------------

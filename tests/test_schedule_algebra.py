@@ -106,6 +106,40 @@ def test_moe_schedule():
                               num_expert_shards=3)
 
 
+@pytest.mark.parametrize("S,M", [(1, 4), (2, 4), (4, 4), (4, 8), (8, 8)])
+def test_zb_tables_valid_and_zero_bubble(S, M):
+    """ZB-H1 greedy tables: every dependency lands strictly after the
+    tick that produced it, every stage runs exactly M of each op, and the
+    makespan hits the analytic 3M + (S-1) unit ticks — versus
+    3(M + S - 1) for 1F1B with a 2-unit backward (the zero-bubble win)."""
+    tb = schedule.zb_tables(S, M)
+    f_t = {s: [] for s in range(S)}
+    b_t = {s: [] for s in range(S)}
+    w_count = {s: 0 for s in range(S)}
+    for t in range(tb.ticks):
+        for s in tb.f_stages[t]:
+            f_t[s].append(t)
+        for s in tb.b_stages[t]:
+            b_t[s].append(t)
+        for s in tb.w_stages[t]:
+            w_count[s] += 1
+        # a stage runs at most one unit op per tick
+        ops = tb.f_stages[t] + tb.b_stages[t] + tb.w_stages[t]
+        assert len(ops) == len(set(ops))
+    for s in range(S):
+        assert len(f_t[s]) == M and len(b_t[s]) == M and w_count[s] == M
+    for s in range(1, S):      # F(k)@s strictly after F(k)@(s-1)
+        for k in range(M):
+            assert f_t[s][k] > f_t[s - 1][k]
+    for s in range(S - 1):     # B(k)@s strictly after B(k)@(s+1)
+        for k in range(M):
+            assert b_t[s][k] > b_t[s + 1][k]
+    assert tb.ticks == 3 * M + (S - 1)
+    # hop tables exclude the edge stages that have no neighbor
+    assert all(S - 1 not in tick for tick in tb.f_senders(S))
+    assert all(0 not in tick for tick in tb.b_senders())
+
+
 def test_sequence_schedule():
     s = _stats()
     card = load_model_card("llama3_8b")
