@@ -440,9 +440,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         "ticks_per_direction": ticks_per_direction,
         # pipeline clock in UNIT ticks (1 unit = fwd = half-bwd, the stat
         # model's bwd = 2 x fwd): gpipe/1f1b span (M+S-1) fwd ticks plus
-        # (M+S-1) 2-unit bwd ticks = 3(M+S-1); zb's greedy table is
-        # 3M + (S-1) single-unit ticks.  Dividing runtime by this gives a
-        # schedule-comparable per-unit cost (the zero-bubble gain).
+        # (M+S-1) 2-unit bwd ticks = 3(M+S-1); zb reports its greedy
+        # table's real makespan (3M + S - 1 when M is not tiny).
+        # Dividing runtime by this gives a schedule-comparable per-unit
+        # cost (the zero-bubble gain).
         "ticks_total": zb.ticks if zb is not None
         else 3 * ticks_per_direction,
         "pp_permute_ticks": pp_permute_ticks,

@@ -101,12 +101,31 @@ def run_plan(plan, args, records: Path) -> int:
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (repo, env.get("PYTHONPATH")) if p)
 
+    native_bin = Path(repo) / "native" / "build" / "bin"
+    if args.tier == "native" and not (native_bin / "dp").exists():
+        raise SystemExit(
+            f"--tier native needs the built binaries in {native_bin} "
+            f"(cmake -S native -B native/build -G Ninja && "
+            f"ninja -C native/build)")
+
     failed = 0
     for i, (proxy, flags) in enumerate(plan):
-        argv = [sys.executable, "-m", "dlnetbench_tpu.cli", proxy,
-                "--out", str(records), "--platform", args.platform,
-                "-r", str(args.runs), "-w", "1", "--no_topology",
-                "--tag", f"proxy={proxy}"]
+        flags = dict(flags)
+        if args.tier == "native":
+            # same study on the C++ tier: per-proxy binary, threaded shm
+            # fabric, explicit --world (the python tier infers it from
+            # the device mesh; the dp scaling axis "d" IS the world)
+            world = flags.pop("d", args.devices)
+            argv = [str(native_bin / proxy),
+                    "--model", flags.pop("model"),
+                    "--world", str(world), "--out", str(records),
+                    "--runs", str(args.runs), "--warmup", "1",
+                    "--no_topology", "--base_path", repo]
+        else:
+            argv = [sys.executable, "-m", "dlnetbench_tpu.cli", proxy,
+                    "--out", str(records), "--platform", args.platform,
+                    "-r", str(args.runs), "-w", "1", "--no_topology",
+                    "--tag", f"proxy={proxy}"]
         if not args.full_scale:
             argv += ["--size_scale", str(args.size_scale),
                      "--time_scale", str(args.time_scale)]
@@ -209,6 +228,9 @@ def main() -> int:
                     help="world size (CPU: virtual device count)")
     ap.add_argument("--platform", default="cpu", choices=("cpu", "tpu"),
                     help="cpu = virtual mesh dev box; tpu = real slice")
+    ap.add_argument("--tier", default="jax", choices=("jax", "native"),
+                    help="jax = python CLI over the device mesh; native = "
+                         "the C++17 binaries (threaded shm fabric)")
     ap.add_argument("--models", default=f"{DENSE},{MOE}",
                     help="comma-separated stats-file names")
     ap.add_argument("--runs", type=int, default=3)
@@ -222,6 +244,11 @@ def main() -> int:
                     help="skip the sweep; re-analyze an existing "
                          "records.jsonl in --out_dir")
     args = ap.parse_args()
+    if args.tier == "native" and args.platform != "cpu":
+        ap.error("--tier native runs the C++ binaries on the threaded shm "
+                 "fabric (host CPU); --platform tpu applies only to the "
+                 "jax tier. For TPU runs on the native tier use the "
+                 "binaries' --backend pjrt directly.")
 
     args.out_dir.mkdir(parents=True, exist_ok=True)
     records = args.out_dir / "records.jsonl"

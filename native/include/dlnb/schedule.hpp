@@ -169,15 +169,16 @@ struct ZBOp {
   i64 mb;     // microbatch index
 };
 
-inline std::vector<ZBOp> zb_ops(i64 num_stages, i64 num_microbatches,
-                                i64 stage) {
+// Core greedy simulation; returns the makespan in ticks and, when
+// `stage` >= 0, that stage's ops in execution order via `mine`.
+inline i64 zb_simulate(i64 num_stages, i64 num_microbatches, i64 stage,
+                       std::vector<ZBOp>* mine) {
   const i64 S = num_stages, M = num_microbatches;
   if (S <= 0 || M <= 0)
     throw std::invalid_argument("zb_ops: S and M must be positive");
   std::vector<std::vector<i64>> f_tick(S, std::vector<i64>(M, -1));
   std::vector<std::vector<i64>> b_tick(S, std::vector<i64>(M, -1));
   std::vector<i64> nf(S, 0), nb(S, 0), nw(S, 0);
-  std::vector<ZBOp> mine;
   i64 t = 0;
   auto done = [&] {
     for (i64 s = 0; s < S; ++s)
@@ -191,7 +192,7 @@ inline std::vector<ZBOp> zb_ops(i64 num_stages, i64 num_microbatches,
           (s == S - 1 || (b_tick[s + 1][k] >= 0 && b_tick[s + 1][k] < t))) {
         b_tick[s][k] = t;
         ++nb[s];
-        if (s == stage) mine.push_back({'B', k});
+        if (s == stage && mine) mine->push_back({'B', k});
         continue;
       }
       k = nf[s];
@@ -199,18 +200,31 @@ inline std::vector<ZBOp> zb_ops(i64 num_stages, i64 num_microbatches,
           (s == 0 || (f_tick[s - 1][k] >= 0 && f_tick[s - 1][k] < t))) {
         f_tick[s][k] = t;
         ++nf[s];
-        if (s == stage) mine.push_back({'F', k});
+        if (s == stage && mine) mine->push_back({'F', k});
         continue;
       }
       if (nw[s] < nb[s]) {
         ++nw[s];
-        if (s == stage) mine.push_back({'W', nw[s] - 1});
+        if (s == stage && mine) mine->push_back({'W', nw[s] - 1});
       }
     }
     if (++t > 4 * (M + S))
-      throw std::runtime_error("zb_ops failed to converge");
+      throw std::runtime_error("zb_simulate failed to converge");
   }
+  return t;
+}
+
+inline std::vector<ZBOp> zb_ops(i64 num_stages, i64 num_microbatches,
+                                i64 stage) {
+  std::vector<ZBOp> mine;
+  zb_simulate(num_stages, num_microbatches, stage, &mine);
   return mine;
+}
+
+// Makespan of the greedy program in unit ticks (== the JAX tier's
+// zb_tables(...).ticks; 3M + S - 1 when M >= S-ish, longer for tiny M).
+inline i64 zb_ticks(i64 num_stages, i64 num_microbatches) {
+  return zb_simulate(num_stages, num_microbatches, -1, nullptr);
 }
 
 // ----------------------------------------------------------------- MoE/EP

@@ -109,18 +109,25 @@ class Inbox {
     cv_.notify_all();
   }
 
-  // Blocking take of the first frame matching `pred`.
+  // Blocking take of the first frame matching `pred`.  Queued frames are
+  // matched BEFORE the error flag is consulted: a peer's EOF arrives
+  // after everything it sent (TCP FIN orders after data), so an op whose
+  // frames already landed must still complete — only waits that can
+  // never be satisfied fail.  (Without this, a rank finishing its last
+  // collective and exiting promptly would poison slower peers' inboxes
+  // while their final frames sat matched in the queue.)
   template <typename Pred>
   Frame take(const Pred& pred) {
     std::unique_lock<std::mutex> lk(m_);
     std::deque<Frame>::iterator it;
-    cv_.wait(lk, [&] {
-      if (!error_.empty()) return true;
+    auto find = [&] {
       for (it = frames_.begin(); it != frames_.end(); ++it)
         if (pred(it->h)) return true;
       return false;
-    });
-    if (!error_.empty()) throw std::runtime_error("tcp fabric: " + error_);
+    };
+    cv_.wait(lk, [&] { return find() || !error_.empty(); });
+    if (!find())
+      throw std::runtime_error("tcp fabric: " + error_);
     Frame f = std::move(*it);
     frames_.erase(it);
     return f;

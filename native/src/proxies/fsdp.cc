@@ -42,10 +42,24 @@ int main(int argc, char** argv) {
         static_cast<i64>(shard_elems * dtype_bytes(env.dtype));
     meta["schedule_shard_bytes"] = static_cast<i64>(
         sched.shard_size * env.stats.bytes_per_element);
-    meta["unit_bytes"] = static_cast<i64>(
+    i64 unit_bytes = static_cast<i64>(
         shard_elems * sched.sharding_factor * dtype_bytes(env.dtype));
+    meta["unit_bytes"] = unit_bytes;
     meta["fwd_us_per_unit"] = sched.fwd_us_per_unit * env.cfg.time_scale;
     meta["bwd_us_per_unit"] = sched.bwd_us_per_unit * env.cfg.time_scale;
+    {
+      // blocking timers only: "allgather" brackets the one initial
+      // blocking gather, "reduce_scatter" all U scatters; the
+      // allgather_wait_* timers measure exposed tails of async gathers
+      // (bandwidth from a wait would read as infinite under overlap)
+      Json cm = Json::object();
+      cm["allgather"] = comm_timer(comm_component(
+          "allgather", sched.sharding_factor, unit_bytes));
+      cm["reduce_scatter"] = comm_timer(comm_component(
+          "reduce_scatter", sched.sharding_factor,
+          sched.num_units * unit_bytes));
+      meta["comm_model"] = cm;
+    }
 
     return run_proxy_main(
         "fsdp", env, meta,

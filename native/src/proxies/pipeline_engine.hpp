@@ -57,7 +57,7 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
   // the JAX tier's ticks_total so cross-tier analyses divide alike)
   meta["ticks_total"] =
       spec.schedule == "zb"
-          ? 3 * p.num_microbatches + p.grid.pp - 1
+          ? zb_ticks(p.grid.pp, p.num_microbatches)
           : 3 * (p.num_microbatches + p.grid.pp - 1);
   meta["dp"] = p.grid.dp;
   meta["layers_per_stage"] = p.layers_per_stage;
@@ -81,6 +81,40 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
         scale_count(spec.nonexpert_sync, size_scale) * dtype_bytes(dtype));
     meta["expert_sync_bytes"] = static_cast<i64>(
         scale_count(spec.expert_sync, size_scale) * dtype_bytes(dtype));
+  }
+  {
+    // per-iteration bytes per blocking timer (analysis/bandwidth.py).
+    // pp_comm: one activation message per microbatch per edge per
+    // direction; middle stages bracket BOTH their recv and their send in
+    // the timer, so their per-rank busbw reads conservatively (time
+    // spans 2x the declared one-direction bytes).
+    const i64 esz = static_cast<i64>(dtype_bytes(dtype));
+    const i64 M = p.num_microbatches;
+    Json cm = Json::object();
+    cm["pp_comm"] = comm_timer(comm_component(
+        "p2p", p.grid.pp,
+        2 * M * scale_count(p.pipe_msg_elems, size_scale) * esz));
+    if (spec.is_moe) {
+      cm["ep_comm"] = comm_timer(comm_component(
+          "alltoall", spec.ep,
+          2 * M * spec.a2a_per_direction *
+              scale_count(spec.a2a_elems, size_scale) * esz));
+      cm["dp_ep_comm"] = comm_timer(comm_component(
+          "allreduce", spec.ep,
+          scale_count(spec.nonexpert_sync, size_scale) * esz));
+      cm["dp_comm"] = comm_timer(comm_component(
+          "allreduce", p.grid.dp,
+          scale_count(spec.expert_sync, size_scale) * esz));
+    } else {
+      cm["dp_comm"] = comm_timer(comm_component(
+          "allreduce", p.grid.dp,
+          scale_count(p.dp_sync_elems, size_scale) * esz));
+      if (p.grid.tp > 1)
+        cm["tp_comm"] = comm_timer(comm_component(
+            "allreduce", p.grid.tp,
+            4 * M * scale_count(p.tp_msg_elems, size_scale) * esz));
+    }
+    meta["comm_model"] = cm;
   }
 }
 
@@ -205,6 +239,10 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
     }
   };
 
+  // zb's op program is a pure function of (S, M, stage): built once,
+  // outside the measured region (the greedy is O(S x ticks))
+  const std::vector<ZBOp> zb_program =
+      spec.schedule == "zb" ? zb_ops(S, M, stage) : std::vector<ZBOp>{};
   run = run_measured(env.cfg, *world, ts, [&](TimerSet& t) {
     if (spec.schedule == "gpipe") {
       // ---- phase 1: all microbatches forward (hybrid_2d.cpp:106-133),
@@ -224,7 +262,7 @@ inline Json hybrid_rank_body(const HybridSpec& spec, const ProxyEnv& env,
       // half B hops down (slot-indexed Isends as in 1f1b), and the local
       // weight-grad half W burns without any hop — the op that fills the
       // 1f1b drain bubble. ----
-      for (const ZBOp& op : zb_ops(S, M, stage)) {
+      for (const ZBOp& op : zb_program) {
         if (op.kind == 'F') {
           fwd_mb(t);
           axis_traffic(t);

@@ -188,6 +188,26 @@ inline std::unique_ptr<Fabric> make_fabric(const ProxyEnv& env) {
   return std::make_unique<ShmFabric>(env.world, env.dtype);
 }
 
+// One component of a timer's communication model (analysis/bandwidth.py
+// schema: the bytes a timed region moves per iteration, with the group
+// size for the busbw correction factor).  Declared only on BLOCKING
+// timers — wait-tail timers (dp's barrier, fsdp's allgather waits)
+// measure exposure, not transfer time, and would misreport bandwidth.
+inline Json comm_component(const std::string& kind,
+                           std::int64_t group, std::int64_t bytes) {
+  Json c = Json::object();
+  c["kind"] = kind;
+  c["group"] = group;
+  c["bytes"] = bytes;
+  return c;
+}
+
+inline Json comm_timer(const Json& first) {
+  Json arr = Json::array();
+  arr.push_back(first);
+  return arr;
+}
+
 inline ModelCard load_card_for(const ProxyEnv& env) {
   std::string arch = arch_name_from_stats_name(env.model_name);
   return load_model_card(

@@ -48,6 +48,18 @@ int main(int argc, char** argv) {
         static_cast<i64>(sched.kv_block_elems * sched.bytes_per_element);
     meta["attn_us_per_block"] = sched.attn_us_per_block * env.cfg.time_scale;
     meta["attn_time_source"] = sched.attn_time_source;
+    {
+      i64 shifts = 2 * layers * (sp - 1);  // fwd + bwd ring passes
+      Json cm = Json::object();
+      cm["ring_comm"] = comm_timer(comm_component(
+          "p2p", sp, shifts * kv_elems *
+                         static_cast<i64>(dtype_bytes(env.dtype))));
+      if (dp > 1)
+        cm["dp_comm"] = comm_timer(comm_component(
+            "allreduce", dp,
+            grad_elems * static_cast<i64>(dtype_bytes(env.dtype))));
+      meta["comm_model"] = cm;
+    }
 
     return run_proxy_main(
         "ring_attention", env, meta,

@@ -47,6 +47,19 @@ int main(int argc, char** argv) {
         static_cast<i64>(a2a_per_rank * sp * dtype_bytes(env.dtype));
     meta["schedule_a2a_bytes"] =
         static_cast<i64>(sched.a2a_elems * sched.bytes_per_element);
+    {
+      i64 n_a2a = 4 * layers;  // 2 reshards per layer, fwd + bwd
+      Json cm = Json::object();
+      cm["a2a_comm"] = comm_timer(comm_component(
+          "alltoall", sp,
+          n_a2a * a2a_per_rank * sp *
+              static_cast<i64>(dtype_bytes(env.dtype))));
+      if (dp > 1)
+        cm["dp_comm"] = comm_timer(comm_component(
+            "allreduce", dp,
+            grad_elems * static_cast<i64>(dtype_bytes(env.dtype))));
+      meta["comm_model"] = cm;
+    }
 
     return run_proxy_main(
         "ulysses", env, meta,

@@ -126,8 +126,9 @@ def test_extra_schedules_run(eight_devices, mode_build, kw, schedule):
 
 def test_zb_tick_accounting(eight_devices):
     """The zb record advertises the zero-bubble clock: 3M + (S-1) unit
-    ticks (vs 2(M+S-1) ticks for the 2-phase schedules) and the same
-    edge-message invariant as every other schedule."""
+    ticks, vs the 2-phase schedules' 3(M+S-1) (their 2(M+S-1) ticks count
+    a 2-unit backward tick double) — and the same edge-message invariant
+    as every other schedule."""
     stats = _stats("llama3_8b_16_bfloat16")
     card = load_model_card("llama3_8b")
     bundle = hybrid_2d.build(stats, card, CFG, num_stages=4,

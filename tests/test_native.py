@@ -358,6 +358,42 @@ def test_native_tcp_selftest(native_bin):
         assert f"rank {r} OK" in out
 
 
+def test_native_tcp_peer_death_detected(native_bin, tmp_path):
+    """Failure detection (SURVEY.md §5.3: the reference has none — a dead
+    rank hangs the job at the vendor's mercy): when a TCP-fabric peer
+    dies mid-run, the survivor must FAIL with a diagnostic, not hang."""
+    import time
+
+    port = _free_port()
+
+    def spawn(r):
+        return subprocess.Popen(
+            [str(native_bin / "dp"), "--model", "gpt2_l_16_bfloat16",
+             "--world", "2", "--backend", "tcp", "--rank", str(r),
+             "--coordinator", f"127.0.0.1:{port}", "--num_buckets", "2",
+             "--time_scale", "0.2", "--size_scale", "0.00001",
+             "--runs", "500", "--warmup", "1", "--no_topology",
+             "--base_path", str(REPO)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    # ~38 ms/iteration x 500 runs ≈ 19 s of measured runs: the kill at
+    # t=2 s lands deep inside them, far from startup and teardown
+    survivor, victim = spawn(0), spawn(1)
+    try:
+        time.sleep(2.0)
+        victim.kill()
+        victim.communicate()
+        out = survivor.communicate(timeout=60)[0]
+    finally:
+        survivor.kill()
+    assert survivor.returncode != 0, \
+        f"survivor exited 0 after peer death:\n{out}"
+    # either detection path is fine: the reader thread failing blocked
+    # collectives ("disconnected mid-run") or a send hitting the dead
+    # peer's closed socket first ("peer gone")
+    assert "disconnected mid-run" in out or "peer gone" in out, out
+
+
 def test_native_dp_over_tcp_and_merge(native_bin, tmp_path):
     """dp across 2 processes: each emits its own record (own timers,
     process identity), metrics.merge reassembles the full rank set."""

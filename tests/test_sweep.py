@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import os
 import sys
+from pathlib import Path
 
 import pytest
 
@@ -106,6 +107,37 @@ def test_pod_study_end_to_end(tmp_path):
     for png in ("dp_runtime_scaling", "dp_barrier_by_bucket",
                 "pareto_proxies"):
         assert (tmp_path / f"{png}.png").stat().st_size > 0
+
+
+@pytest.mark.slow
+def test_pod_study_native_tier(tmp_path):
+    """The same north-star study driven through the C++ binaries
+    (--tier native): every proxy runs on the threaded shm fabric and the
+    analysis layer ingests the native records identically."""
+    import shutil
+    import subprocess
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        pytest.skip("cmake/ninja not available")
+    repo = Path(__file__).resolve().parent.parent
+    if not (repo / "native" / "build" / "bin" / "dp").exists():
+        subprocess.run(["cmake", "-S", str(repo / "native"), "-B",
+                        str(repo / "native" / "build"), "-G", "Ninja"],
+                       check=True, capture_output=True)
+        subprocess.run(["ninja", "-C", str(repo / "native" / "build")],
+                       check=True, capture_output=True)
+    proc = subprocess.run(
+        [sys.executable, "examples/pod_study.py", "--tier", "native",
+         "--out_dir", str(tmp_path), "--devices", "8", "--runs", "1",
+         "--models", "mixtral_8x7b_16_bfloat16"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "XLA_FLAGS": ""},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "effective bandwidth per collective" in proc.stdout
+    for proxy in ("fsdp", "hybrid_2d", "hybrid_3d_moe", "ring_attention",
+                  "ulysses"):
+        assert proxy in proc.stdout, f"{proxy} missing from native study"
+    assert (tmp_path / "bandwidth_summary.csv").stat().st_size > 0
 
 
 @pytest.mark.slow
