@@ -152,6 +152,59 @@ def moe_dense(x2d, w_router, w_gate, w_up, w_down, top_k: int):
     return jnp.einsum("ted,te->td", y, combine).astype(x2d.dtype)
 
 
+def moe_dispatch(x2d, w_router, num_experts: int, top_k: int,
+                 capacity_factor: float):
+    """Capacity-based token dispatch (GShard/Switch style), shared by the
+    single-device sparse MoE below and the EP-sharded SPMD step
+    (models/spmd.py _moe_block — identical math, with all_to_alls
+    inserted around the expert compute).  Tokens land in per-expert
+    buffers of C = floor(T*k/E * capacity_factor) slots via a
+    cumsum-position one-hot; tokens beyond capacity are dropped (their
+    combine weight is zero, the residual carries them).
+
+    Returns (xe [E, C, d] f32 expert inputs, disp [T, E, C] dispatch
+    one-hots, gate [T, E] combine weights); combine with
+    ``moe_combine``."""
+    t, _ = x2d.shape
+    e = num_experts
+    weights, idx = moe_router(x2d, w_router, top_k)         # [T,k] each
+    cap = max(1, int(capacity_factor * t * top_k / e))
+
+    onehot = jax.nn.one_hot(idx, e, dtype=_F32)             # [T, k, E]
+    gate = jnp.sum(onehot * weights[..., None], axis=1)     # [T, E]
+    mask = jnp.sum(onehot, axis=1)                          # [T, E] 0/1
+    pos = jnp.cumsum(mask, axis=0) - 1.0                    # arrival order
+    keep = mask * (pos < cap)
+    disp = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=_F32) \
+        * keep[..., None]                                   # [T, E, C]
+    xe = jnp.einsum("tec,td->ecd", disp, x2d.astype(_F32))  # [E, C, d]
+    return xe, disp, gate
+
+
+def moe_combine(out, disp, gate):
+    """Scatter per-expert outputs [E, C, d] back to tokens [T, d] with
+    the dispatch one-hots and combine weights from ``moe_dispatch``."""
+    return jnp.einsum("ecd,tec->td", out, disp * gate[..., None])
+
+
+def moe_sparse(x2d, w_router, w_gate, w_up, w_down, top_k: int,
+               capacity_factor: float = 1.25):
+    """Capacity-based sparse MoE for single-device execution.  Expert
+    FLOPs are E*C*ffn ~ k*cf*T*ffn instead of moe_dense's E*T*ffn.  At
+    capacity_factor >= E/top_k nothing drops and the result matches
+    moe_dense exactly (tests/test_models.py pins this)."""
+    e = w_gate.shape[0]
+    xe, disp, gate = moe_dispatch(x2d, w_router, e, top_k, capacity_factor)
+    xe = xe.astype(x2d.dtype)
+    h = jax.nn.silu(jnp.einsum("ecd,edh->ech", xe, w_gate,
+                               preferred_element_type=_F32))
+    h = h * jnp.einsum("ecd,edh->ech", xe, w_up,
+                       preferred_element_type=_F32)
+    out = jnp.einsum("ech,ehd->ecd", h.astype(x2d.dtype), w_down,
+                     preferred_element_type=_F32)           # [E, C, d]
+    return moe_combine(out, disp, gate).astype(x2d.dtype)
+
+
 def cross_entropy(logits, targets):
     """Mean token cross-entropy; logits [.., V] in any dtype, fp32 inside.
     Computed as mean(logsumexp - logits[target]) so the full [.., V]

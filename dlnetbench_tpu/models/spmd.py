@@ -190,22 +190,12 @@ def _replicated_axes(spec: P) -> tuple:
 def _moe_block(cfg: SpmdConfig, tp: int, y, lp):
     """y: [mb, S/tp, d] local tokens; experts sharded over tp (EP)."""
     mb, s_loc, d = y.shape
-    t = mb * s_loc
-    e = cfg.num_experts
-    x2 = y.reshape(t, d)
-    weights, idx = Lyr.moe_router(x2, lp["w_router"], cfg.top_k)
-    cap = max(1, int(cfg.capacity_factor * t * cfg.top_k / e))
-
-    # capacity-based one-hot dispatch (GShard style): token t -> expert e
-    onehot = jax.nn.one_hot(idx, e, dtype=_F32)            # [T, k, E]
-    gate = jnp.sum(onehot * weights[..., None], axis=1)     # [T, E]
-    mask = jnp.sum(onehot, axis=1)                          # [T, E] 0/1
-    pos = jnp.cumsum(mask, axis=0) - 1.0                    # arrival order
-    keep = mask * (pos < cap)
-    disp = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=_F32) \
-        * keep[..., None]                                   # [T, E, C]
-
-    ein = jnp.einsum("tec,td->ecd", disp, x2.astype(_F32))  # [E, C, d]
+    x2 = y.reshape(mb * s_loc, d)
+    # capacity-based one-hot dispatch (GShard style) — the shared math in
+    # models/layers.py, so the single-device sparse MoE and this
+    # EP-sharded path can never drift apart
+    ein, disp, gate = Lyr.moe_dispatch(x2, lp["w_router"], cfg.num_experts,
+                                       cfg.top_k, cfg.capacity_factor)
     # EP all_to_all: [E, C, d] -> [E/tp, C*tp, d] (each rank gets its experts'
     # tokens from every peer — the hybrid_3d_moe dispatch A2A)
     if tp > 1:
@@ -221,7 +211,7 @@ def _moe_block(cfg: SpmdConfig, tp: int, y, lp):
     if tp > 1:  # combine A2A (reverse reshard)
         out = lax.all_to_all(out, AXIS_TP, split_axis=1, concat_axis=0,
                              tiled=True)
-    y2 = jnp.einsum("ecd,tec->td", out, (disp * gate[..., None]))
+    y2 = Lyr.moe_combine(out, disp, gate)
     return y2.reshape(mb, s_loc, d).astype(y.dtype)
 
 

@@ -14,6 +14,7 @@ times can be compared against the roofline predictions (see bench.py).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 
 import jax
@@ -54,11 +55,20 @@ class TransformerConfig:
     logits_f32: bool = True        # emit f32 logits (training-grade CE
                              # numerics); False keeps them bf16 — halves
                              # the [B, S, V] logits traffic for benches
+    moe_impl: str = "dense"        # "dense" (every expert computes every
+                             # selected token — exact, E/k x the FLOPs) or
+                             # "sparse" (capacity-based dispatch, GShard
+                             # style: ~k*cf*T*ffn FLOPs, over-capacity
+                             # tokens dropped — the production semantics)
+    moe_capacity_factor: float = 1.25
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}; "
                              f"expected 'full' or 'dots'")
+        if self.moe_impl not in ("dense", "sparse"):
+            raise ValueError(f"unknown moe_impl {self.moe_impl!r}; "
+                             f"expected 'dense' or 'sparse'")
 
     @classmethod
     def from_card(cls, card: ModelCard, *, seq_len: int | None = None,
@@ -169,9 +179,13 @@ def _block(cfg: TransformerConfig, x, lp, positions):
     if cfg.gated:
         y = L.rmsnorm(x, lp["norm2"])
         if cfg.num_experts > 1:
-            y2 = L.moe_dense(y.reshape(b * s, d), lp["w_router"],
-                             lp["w_gate"], lp["w_up"], lp["w_down"],
-                             cfg.top_k).reshape(b, s, d)
+            moe = (L.moe_dense if cfg.moe_impl == "dense"
+                   else functools.partial(
+                       L.moe_sparse,
+                       capacity_factor=cfg.moe_capacity_factor))
+            y2 = moe(y.reshape(b * s, d), lp["w_router"],
+                     lp["w_gate"], lp["w_up"], lp["w_down"],
+                     cfg.top_k).reshape(b, s, d)
         else:
             y2 = L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
     else:

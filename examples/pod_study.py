@@ -110,6 +110,7 @@ def run_plan(plan, args, records: Path) -> int:
 
     failed = 0
     for i, (proxy, flags) in enumerate(plan):
+        desc = " ".join(f"{k}={v}" for k, v in flags.items())
         flags = dict(flags)
         if args.tier == "native":
             # same study on the C++ tier: per-proxy binary, threaded shm
@@ -131,7 +132,6 @@ def run_plan(plan, args, records: Path) -> int:
                      "--time_scale", str(args.time_scale)]
         for k, v in flags.items():
             argv += [f"--{k}", str(v)]
-        desc = " ".join(f"{k}={v}" for k, v in flags.items())
         print(f"[{i + 1}/{len(plan)}] {proxy} {desc}", flush=True)
         proc = subprocess.run(argv, env=env, stdout=subprocess.DEVNULL)
         if proc.returncode != 0:

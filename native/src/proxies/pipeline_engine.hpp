@@ -53,8 +53,9 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
   // (M + S - 1) ticks per direction, not M — same clock as the JAX tier
   meta["ticks_per_direction"] = p.num_microbatches + p.grid.pp - 1;
   // pipeline clock in UNIT ticks (1 unit = fwd = half-bwd): the 2-phase
-  // schedules span 3(M+S-1); zb's greedy table is 3M + (S-1) (mirrors
-  // the JAX tier's ticks_total so cross-tier analyses divide alike)
+  // schedules span 3(M+S-1); zb reports its greedy table's REAL makespan
+  // (3M + S - 1 only when M isn't tiny — zb_ticks, matching the JAX
+  // tier's ticks_total so cross-tier analyses divide alike)
   meta["ticks_total"] =
       spec.schedule == "zb"
           ? zb_ticks(p.grid.pp, p.num_microbatches)

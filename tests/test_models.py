@@ -48,6 +48,55 @@ def test_moe_forward():
     assert np.all(np.isfinite(np.asarray(logits)))
 
 
+def test_moe_sparse_matches_dense_at_full_capacity():
+    """At capacity_factor >= E/top_k no token drops, so the capacity-based
+    dispatch must reproduce the dense-dispatch result exactly (modulo
+    accumulation order)."""
+    import dataclasses
+    cfg = _tiny_cfg("mixtral_8x7b")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                cfg.vocab_size)
+    dense = tfm.forward(params, tokens, cfg)
+    sparse_cfg = dataclasses.replace(
+        cfg, moe_impl="sparse",
+        moe_capacity_factor=cfg.num_experts / cfg.top_k)
+    sparse = tfm.forward(params, tokens, sparse_cfg)
+    np.testing.assert_allclose(np.asarray(dense, np.float32),
+                               np.asarray(sparse, np.float32),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_moe_sparse_trains_and_drops_gracefully():
+    """At the production capacity factor (1.25) some tokens drop; the
+    forward stays finite and the loss still falls under SGD (dropped
+    tokens ride the residual)."""
+    import dataclasses
+    cfg = dataclasses.replace(_tiny_cfg("mixtral_8x7b"), moe_impl="sparse")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 17), 0,
+                                cfg.vocab_size)
+
+    @jax.jit
+    def step(p):
+        loss, g = jax.value_and_grad(tfm.loss_fn)(p, tokens, cfg)
+        return jax.tree.map(lambda a, b: a - 0.05 * b.astype(a.dtype),
+                            p, g), loss
+
+    losses = []
+    for _ in range(8):
+        params, loss = step(params)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_unknown_impl_rejected():
+    import dataclasses
+    with pytest.raises(ValueError, match="moe_impl"):
+        dataclasses.replace(_tiny_cfg("mixtral_8x7b"), moe_impl="topk")
+
+
 def test_causality():
     """Changing a future token must not affect earlier logits."""
     cfg = _tiny_cfg()
