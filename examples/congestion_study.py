@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""Interference study — what the `_loop` congestor binaries exist for.
+
+The reference builds a `_loop` variant of every proxy (infinite run loop,
+`-DPROXY_LOOP`, reference Makefile.common:96-109, dp.cpp:251-256) to
+generate *sustained* background traffic for interference studies
+(SURVEY.md §5.3).  This script runs that study shape end to end on one
+machine using the native TCP fabric, whose frames share the kernel
+loopback path the way cluster jobs share fabric links:
+
+  1. measure the dp proxy across two OS processes (solo baseline),
+  2. start a dp_loop congestor pair on the same host,
+  3. measure dp again under load,
+  4. report runtime and exposed-comm (barrier) inflation.
+
+    python examples/congestion_study.py --out_dir /tmp/congestion
+
+On a real cluster the same pairing applies unchanged: launch the `_loop`
+binary on neighboring hosts and point both jobs' coordinators at their
+own ranks-0.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+# runnable from a clone without installation
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+REPO = Path(__file__).resolve().parent.parent
+BIN = REPO / "native" / "build" / "bin"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_pair(binary: str, extra: list[str], outs: list[Path] | None,
+                args) -> list[subprocess.Popen]:
+    port = free_port()
+    procs = []
+    for r in range(2):
+        argv = [str(BIN / binary), "--model", args.model,
+                "--world", "2", "--backend", "tcp", "--rank", str(r),
+                "--coordinator", f"127.0.0.1:{port}",
+                "--time_scale", str(args.time_scale),
+                "--size_scale", str(args.size_scale),
+                "--no_topology", "--base_path", str(REPO)] + extra
+        if outs is not None:
+            argv += ["--out", str(outs[r])]
+        # own process group: if THIS script is killed mid-study (test
+        # timeout, ^C), killpg still reaps the children — an orphaned
+        # `_loop` binary would otherwise saturate the host forever
+        procs.append(subprocess.Popen(
+            argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True))
+    return procs
+
+
+def kill_group(procs: list[subprocess.Popen]) -> None:
+    for p in procs:
+        if p.poll() is None:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        p.wait()
+
+
+def measure(tag: str, out_dir: Path, args) -> dict:
+    # one record file per rank (the multi-host emission model; concurrent
+    # appends to one file could interleave), merged afterwards
+    outs = [out_dir / f"{tag}_p{r}.jsonl" for r in range(2)]
+    for o in outs:
+        o.unlink(missing_ok=True)
+    procs = launch_pair("dp", ["--num_buckets", str(args.num_buckets),
+                               "--runs", str(args.runs), "--warmup", "1"],
+                        outs, args)
+    try:
+        for p in procs:
+            if p.wait(timeout=600) != 0:
+                raise SystemExit(f"{tag}: dp rank exited {p.returncode}")
+    finally:
+        kill_group(procs)  # reap a surviving sibling on any failure
+    from dlnetbench_tpu.metrics.merge import merge_records
+    from dlnetbench_tpu.metrics.parser import load_records
+    merged = merge_records([r for o in outs for r in load_records(o)])
+    runtimes = [t for row in merged["ranks"] for t in row["runtimes"]]
+    barriers = [t for row in merged["ranks"] for t in row["barrier_time"]]
+    return {"tag": tag,
+            "runtime_us": sum(runtimes) / len(runtimes),
+            "barrier_us": sum(barriers) / len(barriers)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out_dir", type=Path, default=Path("/tmp/congestion"))
+    ap.add_argument("--model", default="gpt2_l_16_bfloat16")
+    ap.add_argument("--num_buckets", type=int, default=4)
+    ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--time_scale", type=float, default=1e-3)
+    ap.add_argument("--size_scale", type=float, default=3e-3,
+                    help="large enough buckets that loopback bandwidth, "
+                         "not latency, dominates the allreduce")
+    args = ap.parse_args()
+
+    if not (BIN / "dp_loop").exists():
+        raise SystemExit(
+            f"needs the built native binaries in {BIN} "
+            f"(cmake -S native -B native/build -G Ninja && "
+            f"ninja -C native/build)")
+    args.out_dir.mkdir(parents=True, exist_ok=True)
+
+    solo = measure("solo", args.out_dir, args)
+
+    # sustained background traffic: the _loop binary never returns —
+    # start it, let its warmup pass, measure under load, kill it
+    congestors = launch_pair(
+        "dp_loop", ["--num_buckets", str(args.num_buckets)], None, args)
+    try:
+        time.sleep(1.0)
+        dead = [p for p in congestors if p.poll() is not None]
+        if dead:
+            raise SystemExit("congestor died during startup")
+        congested = measure("congested", args.out_dir, args)
+    finally:
+        kill_group(congestors)
+
+    report = {
+        "solo": solo, "congested": congested,
+        "runtime_inflation":
+            congested["runtime_us"] / max(solo["runtime_us"], 1e-9),
+        "barrier_inflation":
+            congested["barrier_us"] / max(solo["barrier_us"], 1e-9),
+    }
+    (args.out_dir / "report.json").write_text(json.dumps(report, indent=2))
+    print(f"solo:      runtime {solo['runtime_us']:12.1f} us   "
+          f"barrier {solo['barrier_us']:10.1f} us")
+    print(f"congested: runtime {congested['runtime_us']:12.1f} us   "
+          f"barrier {congested['barrier_us']:10.1f} us")
+    print(f"inflation: runtime x{report['runtime_inflation']:.2f}   "
+          f"barrier x{report['barrier_inflation']:.2f}")
+    print(f"wrote {args.out_dir}/report.json")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -394,6 +394,27 @@ def test_native_tcp_peer_death_detected(native_bin, tmp_path):
     assert "disconnected mid-run" in out or "peer gone" in out, out
 
 
+@pytest.mark.slow
+def test_congestion_study_end_to_end(native_bin, tmp_path):
+    """examples/congestion_study.py (the `_loop` congestors' purpose,
+    SURVEY.md §5.3) must run the solo + under-load measurement pair and
+    write a finite report.  No inflation threshold is asserted — the
+    contention magnitude is host-dependent; the study's job is to
+    measure it, the test's job is that the machinery works."""
+    import sys
+    proc = subprocess.run(
+        [sys.executable, "examples/congestion_study.py",
+         "--out_dir", str(tmp_path), "--runs", "3"],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads((tmp_path / "report.json").read_text())
+    for key in ("solo", "congested"):
+        assert report[key]["runtime_us"] > 0
+        assert report[key]["barrier_us"] > 0
+    assert report["runtime_inflation"] > 0
+    assert "inflation" in proc.stdout
+
+
 def test_native_dp_over_tcp_and_merge(native_bin, tmp_path):
     """dp across 2 processes: each emits its own record (own timers,
     process identity), metrics.merge reassembles the full rank set."""
