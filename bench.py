@@ -55,17 +55,20 @@ def main() -> int:
     # bwd kernel at 183 TF/s), custom-VJP rmsnorm (the autodiff
     # norm-backward fusion alone cost ~15% of the step), bf16 logits
     # (~0.5%: halves the [B,S,V] logits traffic; CE still reduces in
-    # f32 — surfaced in the output as logits_dtype), and B=2 x S=6144:
-    # at fixed token count (12288, the most that fits no-remat), longer
-    # sequences win — flash computes only the causal half of the S^2
-    # attention matmuls while the roofline (like standard MFU accounting)
-    # budgets them in full, so the measured/ideal ratio improves with the
-    # attention fraction (B=3 S=4096: 0.70; B=2 S=6144: 0.72).  Measured
-    # dead ends, for the record: fused-QKV via concat (-2%: concat HBM
-    # traffic), param donation (0%: XLA already aliases the scan carry),
-    # barriered rmsnorm input or output (-0.5 to -1.5%: splits fusions
-    # XLA had right), B=2 S=2048 (0.66), B=1 S=8192 (0.68, half the
-    # tokens), B=1 S=12288 / B=2 S=8192 / B=4 S=4096 (OOM).
+    # f32 — surfaced in the output as logits_dtype), B=2 x S=6144 (at
+    # fixed token count — 12288, the most that fits no-remat — longer
+    # sequences win: flash computes only the causal half of the S^2
+    # attention matmuls while the roofline, like standard MFU accounting,
+    # budgets them in full; B=3 S=4096: 0.70, B=2 S=6144: 0.72), and a
+    # 32 MiB XLA scoped-VMEM limit via per-compile compiler_options
+    # (+3.5%: the 16 MiB default cramps tiling of the big backward
+    # fusions; 24 MiB +3%, 40-64 MiB +3.2%, 32 MiB best at 0.75).
+    # Measured dead ends, for the record: fused-QKV via concat (-2%:
+    # concat HBM traffic), param donation (0%: XLA already aliases the
+    # scan carry), barriered rmsnorm input or output (-0.5 to -1.5%:
+    # splits fusions XLA had right), B=2 S=2048 (0.66), B=1 S=8192
+    # (0.68, half the tokens), B=1 S=12288 / B=2 S=8192 / B=4 S=4096 /
+    # B=2 S=7168 with the VMEM option (OOM).
     cfg = dataclasses.replace(tfm.TransformerConfig.from_card(card),
                               scan_layers=False, logits_f32=False)
 
@@ -74,8 +77,7 @@ def main() -> int:
 
     K = 10  # train steps chained inside ONE program
 
-    @jax.jit
-    def train_k(p, t):
+    def train_k_fn(p, t):
         # K optimizer steps under a single dispatch: on the tunnel backend
         # every dispatch costs ~2-7 ms of host->device latency that a real
         # training loop (async dispatch, local runtime) never serializes
@@ -85,6 +87,13 @@ def main() -> int:
             p = jax.tree.map(lambda a, b: a - 1e-3 * b.astype(a.dtype), p, g)
             return p, loss
         return jax.lax.scan(body, p, None, length=K)
+
+    # per-compile compiler option (env XLA_FLAGS can't carry backend
+    # flags through the tunnel's compile helper; compiler_options can);
+    # TPU-only flag, so gate on the backend for CPU-mesh runs
+    opts = ({"xla_tpu_scoped_vmem_limit_kib": "32768"}
+            if jax.default_backend() == "tpu" else None)
+    train_k = jax.jit(train_k_fn, compiler_options=opts)
 
     params2, losses = train_k(params, tokens)  # compile
     losses[-1].item()   # true fence (block_until_ready only acks dispatch
