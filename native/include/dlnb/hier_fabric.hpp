@@ -1,0 +1,760 @@
+// Hierarchical rank fabric: per-process PJRT devices (ICI role) composed
+// with an inter-process TCP mesh (DCN role) — the native tier's
+// multi-host DEVICE path.
+//
+// The reference's native tier goes multi-node by bootstrapping a vendor
+// communicator over MPI ranks and running device-buffer collectives
+// across nodes (reference cpp/data_parallel/dp.cpp:166-189: MPI_Init +
+// ncclUniqueId broadcast -> ncclCommInitRank; cpp/proxy_classes.hpp:
+// 136-253 drives NCCL on GPU memory).  On TPU the same composition is a
+// two-level fabric, matching how real TPU pods are wired (ICI inside a
+// slice, DCN between slices):
+//
+//   * each OS process owns a PjrtFabric over its LOCAL devices — every
+//     local collective phase executes as one compiled XLA module on
+//     device (PluginExecutor on real libtpu; HostExecutor in CI, same
+//     CollectiveProgram semantics);
+//   * processes are joined by the TcpFabric's bootstrap + full-mesh
+//     sockets (tcp_backend.hpp, the ncclUniqueId role);
+//   * a collective on a group spanning processes runs hierarchically:
+//     intra-process collective on device -> ONE thread per (process,
+//     group) combines the partials over TCP -> the result is scattered
+//     back to every local member.  Groups contained in one process never
+//     touch the wire.
+//
+// Per-op composition (G = group size, m = local members, P = processes
+// hosting the group):
+//   Allreduce        local AR (device) -> TCP AR of the m-way partial
+//                    (count elements on the wire, the bandwidth-optimal
+//                    two-level reduction) -> copy to members.
+//   ReduceScatter    local AR of all G blocks -> TCP AR of the partial ->
+//                    each member takes its block.  (DCN moves G blocks —
+//                    an AR-based reduce-scatter; records stamp
+//                    dcn_algo so bandwidth analyses can tell.)
+//   Allgather /      local AG (device) -> TCP AG of the process's packed
+//   Alltoall /       member blocks (padded to the group's max local
+//   RingShift        membership so counts are uniform) -> reassemble in
+//                    global group-rank order -> distribute.
+//   Barrier          local barrier -> TCP barrier among the group's
+//                    processes.
+//   Send/Recv        local pairs ride the in-process mailbox; cross-
+//                    process pairs ride a TCP frame tagged with both
+//                    endpoints' group ranks (p2p_transport "host+tcp").
+//
+// Communicator splits are collective over the GLOBAL world: every local
+// rank thread calls split, the local PjrtFabric split partitions the
+// local devices, local colors are allgathered across processes over a
+// control communicator, and every process derives the same global groups
+// and the same TCP comm-id sequence (the MPI_Comm_split contract, as in
+// tcp_backend.hpp's split).
+//
+// CLI: --backend pjrt --procs P --coordinator host:port --rank p
+// (world stays the GLOBAL rank count; each process runs world/P rank
+// threads over its own devices).  Records carry this process's ranks
+// only; dlnetbench_tpu.metrics.merge reassembles the run.
+#pragma once
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstring>
+#include <functional>
+#include <limits>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "dlnb/communicator.hpp"
+#include "dlnb/fabric.hpp"
+#include "dlnb/pjrt_fabric.hpp"
+#include "dlnb/tcp_backend.hpp"
+#include "dlnb/tensor.hpp"
+
+namespace dlnb {
+namespace hier {
+
+// All local members of one group arrive with their (op, count, extra)
+// and buffer pointers; the LAST arriver runs the DCN phase exactly once
+// (it sees every member's src/dst/scratch and writes the results);
+// everyone departs only after it finished.  Mismatched op/count/extra
+// across the local members aborts — same contract as the shm and pjrt
+// rendezvous.
+class Rendezvous {
+ public:
+  explicit Rendezvous(int n) : n_(n), dsts_(n), scratch_(n) {}
+
+  // The DCN phase consumes only dsts (local-phase results) and scratches
+  // (gathered/reduced staging); member src buffers were already folded in
+  // by the local device collective.
+  using ExecFn = std::function<void(const std::vector<void*>&,
+                                    const std::vector<void*>&)>;
+
+  void collective(int midx, int op, std::int64_t count, std::int64_t extra,
+                  void* dst, void* scratch, const ExecFn& exec) {
+    std::unique_lock<std::mutex> lk(m_);
+    std::uint64_t my_gen = gen_;
+    dsts_[midx] = dst;
+    scratch_[midx] = scratch;
+    if (arrived_ == 0) {
+      op_ = op;
+      count_ = count;
+      extra_ = extra;
+    } else if (op_ != op || count_ != count || extra_ != extra) {
+      mismatch_ = true;
+    }
+    if (++arrived_ == n_) {
+      if (!mismatch_) {
+        lk.unlock();
+        try {
+          exec(dsts_, scratch_);
+        } catch (...) {
+          lk.lock();
+          error_ = std::current_exception();
+          lk.unlock();
+        }
+        lk.lock();
+      }
+      exec_done_ = true;
+      cv_.notify_all();
+    } else {
+      cv_.wait(lk, [&] {
+        return gen_ == my_gen && arrived_ == n_ && exec_done_;
+      });
+    }
+    bool bad = mismatch_;
+    std::exception_ptr err = error_;
+    if (++departed_ == n_) {
+      arrived_ = 0;
+      departed_ = 0;
+      mismatch_ = false;
+      exec_done_ = false;
+      error_ = nullptr;
+      ++gen_;
+      cv_.notify_all();
+    } else {
+      cv_.wait(lk, [&] { return gen_ != my_gen; });
+    }
+    lk.unlock();
+    if (bad)
+      throw std::runtime_error(
+          "hier collective mismatch: local members disagree on op/count");
+    if (err) std::rethrow_exception(err);
+  }
+
+ private:
+  int n_;
+  std::mutex m_;
+  std::condition_variable cv_;
+  std::vector<void*> dsts_;
+  std::vector<void*> scratch_;
+  int arrived_ = 0;
+  int departed_ = 0;
+  bool exec_done_ = false;
+  bool mismatch_ = false;
+  int op_ = 0;
+  std::int64_t count_ = 0;
+  std::int64_t extra_ = 0;
+  std::exception_ptr error_;
+  std::uint64_t gen_ = 0;
+};
+
+// One split's shared state in THIS process: the global group map plus,
+// per locally-hosted group, the rendezvous and (for spanning groups)
+// the TCP communicator among the group's processes.
+struct GroupSet {
+  struct Info {
+    std::vector<int> procs;                        // ascending proc ranks
+    std::vector<std::vector<int>> members_by_proc; // parallel to procs
+    int maxm = 0;                                  // max local membership
+  };
+  struct LocalGroup {
+    std::vector<int> local_members;  // global ranks here, ascending
+    std::unique_ptr<TcpCommunicator> tcp;  // null for single-proc groups
+    std::vector<std::unique_ptr<Rendezvous>> rdv;  // [0 .. num_slots]
+  };
+
+  int world = 0, local = 0, nprocs = 1, my_proc = 0;
+  std::vector<std::vector<int>> groups;  // global ranks, by color asc
+  std::vector<int> group_of, grank_of;   // by global rank
+  std::vector<Info> info;                // by group index
+  std::vector<std::unique_ptr<LocalGroup>> local_groups;  // null if none
+
+  int proc_of(int global_rank) const { return global_rank / local; }
+};
+
+}  // namespace hier
+
+class HierFabric;
+
+// Per-rank-thread view of one group: ProxyCommunicator over the
+// two-level fabric.  `sub_` is this rank's communicator on the local
+// device fabric (same color partition restricted to local ranks).
+class HierCommunicator : public ProxyCommunicator {
+ public:
+  HierCommunicator(std::shared_ptr<hier::GroupSet> set,
+                   std::unique_ptr<ProxyCommunicator> sub, int global_rank,
+                   DType dtype, int num_slots, std::string name)
+      : set_(std::move(set)),
+        sub_(std::move(sub)),
+        grk_(global_rank),
+        dtype_(dtype),
+        num_slots_(num_slots),
+        name_(std::move(name)),
+        workers_(num_slots) {
+    gidx_ = set_->group_of[grk_];
+    lg_ = set_->local_groups[gidx_].get();
+    for (std::size_t k = 0; k < lg_->local_members.size(); ++k)
+      if (lg_->local_members[k] == grk_) midx_ = static_cast<int>(k);
+  }
+
+  ~HierCommunicator() override {
+    for (auto& w : workers_) w.stop();
+  }
+
+  int rank() const override { return set_->grank_of[grk_]; }
+  int size() const override {
+    return static_cast<int>(set_->groups[gidx_].size());
+  }
+  std::string name() const override { return name_; }
+  DType dtype() const override { return dtype_; }
+
+  void Allreduce(const void* src, void* dst, std::int64_t count) override {
+    run_collective(num_slots_, pjrtfab::Op::Allreduce, count, 0, src, dst);
+  }
+  void Allgather(const void* src, void* dst, std::int64_t cpr) override {
+    run_collective(num_slots_, pjrtfab::Op::Allgather, cpr, 0, src, dst);
+  }
+  void ReduceScatterBlock(const void* src, void* dst,
+                          std::int64_t cpr) override {
+    run_collective(num_slots_, pjrtfab::Op::ReduceScatterBlock, cpr, 0, src,
+                   dst);
+  }
+  void Alltoall(const void* src, void* dst, std::int64_t cpr) override {
+    run_collective(num_slots_, pjrtfab::Op::Alltoall, cpr, 0, src, dst);
+  }
+  void Barrier() override {
+    run_collective(num_slots_, pjrtfab::Op::Barrier, 0, 0, nullptr, nullptr);
+  }
+  void RingShift(const void* src, void* dst, std::int64_t count,
+                 int shift = 1) override {
+    run_collective(num_slots_, pjrtfab::Op::RingShift, count, shift, src,
+                   dst);
+  }
+
+  // ---- p2p: in-process mailbox or cross-process TCP frame ----
+  void Send(const void* src, std::int64_t count, int dst_rank,
+            int tag = 0) override {
+    int dst_global = set_->groups[gidx_].at(dst_rank);
+    if (set_->proc_of(dst_global) == set_->my_proc) {
+      sub_->Send(src, count, local_index(dst_global), tag);
+    } else {
+      require_tcp("Send");
+      lg_->tcp->Send(src, count, proc_index(set_->proc_of(dst_global)),
+                     p2p_tag(rank(), dst_rank, tag));
+    }
+  }
+  void Recv(void* dst, std::int64_t count, int src_rank,
+            int tag = 0) override {
+    int src_global = set_->groups[gidx_].at(src_rank);
+    if (set_->proc_of(src_global) == set_->my_proc) {
+      sub_->Recv(dst, count, local_index(src_global), tag);
+    } else {
+      require_tcp("Recv");
+      lg_->tcp->Recv(dst, count, proc_index(set_->proc_of(src_global)),
+                     p2p_tag(src_rank, rank(), tag));
+    }
+  }
+
+  // ---- nonblocking, slot-indexed ----
+  void Iallreduce(const void* src, void* dst, std::int64_t count,
+                  int slot) override {
+    enqueue(slot, [=] {
+      run_collective(slot, pjrtfab::Op::Allreduce, count, 0, src, dst);
+    });
+  }
+  void Iallgather(const void* src, void* dst, std::int64_t cpr,
+                  int slot) override {
+    enqueue(slot, [=] {
+      run_collective(slot, pjrtfab::Op::Allgather, cpr, 0, src, dst);
+    });
+  }
+  void Isend(const void* src, std::int64_t count, int dst_rank, int slot,
+             int tag = -1) override {
+    int t = tag >= 0 ? tag : 1 + slot;
+    enqueue(slot, [=] { Send(src, count, dst_rank, t); });
+  }
+  void Irecv(void* dst, std::int64_t count, int src_rank, int slot,
+             int tag = -1) override {
+    int t = tag >= 0 ? tag : 1 + slot;
+    enqueue(slot, [=] { Recv(dst, count, src_rank, t); });
+  }
+  void Wait(int slot) override { worker(slot).wait(); }
+  void WaitAll(int num_slots) override {
+    for (int i = 0; i < num_slots && i < num_slots_; ++i) workers_[i].wait();
+  }
+
+ private:
+  shm::SlotWorker& worker(int slot) {
+    if (slot < 0 || slot >= num_slots_)
+      throw std::out_of_range("slot " + std::to_string(slot) +
+                              " out of range");
+    return workers_[slot];
+  }
+  void enqueue(int slot, std::function<void()> fn) {
+    worker(slot).enqueue(std::move(fn));
+  }
+  void require_tcp(const char* what) const {
+    if (!lg_->tcp)
+      throw std::logic_error(std::string("hier ") + what +
+                             ": group has no TCP comm (single-process "
+                             "group asked for a remote peer?)");
+  }
+  // group rank of `global` within the local sub-communicator (local
+  // members ascend by global rank in both partitions)
+  int local_index(int global) const {
+    for (std::size_t k = 0; k < lg_->local_members.size(); ++k)
+      if (lg_->local_members[k] == global) return static_cast<int>(k);
+    throw std::logic_error("hier: rank not local");
+  }
+  // this group's TCP comm indexes its member processes in ascending order
+  int proc_index(int proc) const {
+    const auto& procs = set_->info[gidx_].procs;
+    for (std::size_t i = 0; i < procs.size(); ++i)
+      if (procs[i] == proc) return static_cast<int>(i);
+    throw std::logic_error("hier: process not in group");
+  }
+  // cross-process p2p frames carry both endpoints so concurrent member
+  // threads of one process never cross-match.  User tags must stay below
+  // the 8192 stride (slot-derived tags are small, kRingShiftTag = 7001)
+  // and the encoding must fit the frame's uint32 op field — both are
+  // enforced, not assumed, or aliased tags would match wrong frames.
+  int p2p_tag(int src_grank, int dst_grank, int tag) const {
+    if (tag < 0 || tag >= 8192)
+      throw std::invalid_argument(
+          "hier p2p: tag " + std::to_string(tag) +
+          " outside [0, 8192) cannot cross the process boundary");
+    std::int64_t enc =
+        (static_cast<std::int64_t>(src_grank) * size() + dst_grank) * 8192 +
+        tag;
+    if (enc > std::numeric_limits<int>::max())
+      throw std::invalid_argument(
+          "hier p2p: encoded tag overflows for group size " +
+          std::to_string(size()));
+    return static_cast<int>(enc);
+  }
+
+  // Local device phase, slot-aligned: blocking Hier calls ride the sub
+  // comm's blocking path; slotted calls ride the SAME sub slot so
+  // concurrent Hier slots map onto distinct local rendezvous (the
+  // stream-per-index discipline end to end).
+  void sub_allreduce(int slot, const void* s, void* d, std::int64_t n) {
+    if (slot >= num_slots_) {
+      sub_->Allreduce(s, d, n);
+    } else {
+      sub_->Iallreduce(s, d, n, slot);
+      sub_->Wait(slot);
+    }
+  }
+  void sub_allgather(int slot, const void* s, void* d, std::int64_t n) {
+    if (slot >= num_slots_) {
+      sub_->Allgather(s, d, n);
+    } else {
+      sub_->Iallgather(s, d, n, slot);
+      sub_->Wait(slot);
+    }
+  }
+  void tcp_allreduce(int slot, const void* s, void* d, std::int64_t n) {
+    if (slot >= num_slots_) {
+      lg_->tcp->Allreduce(s, d, n);
+    } else {
+      lg_->tcp->Iallreduce(s, d, n, slot);
+      lg_->tcp->Wait(slot);
+    }
+  }
+  void tcp_allgather(int slot, const void* s, void* d, std::int64_t n) {
+    if (slot >= num_slots_) {
+      lg_->tcp->Allgather(s, d, n);
+    } else {
+      lg_->tcp->Iallgather(s, d, n, slot);
+      lg_->tcp->Wait(slot);
+    }
+  }
+
+  // Resolve a pointer to every GLOBAL group member's gathered block of
+  // `block_bytes`, from the local sub-allgather result (single-process
+  // groups) or a padded TCP allgather of each process's packed members
+  // (spanning groups).  `storage` owns the wire buffer.
+  void gather_member_blocks(int slot, const void* local_gathered,
+                            std::size_t block_bytes,
+                            std::vector<char>& storage,
+                            std::vector<const char*>& ptrs) {
+    const auto& gi = set_->info[gidx_];
+    const auto& members = lg_->local_members;
+    const int G = size();
+    ptrs.assign(G, nullptr);
+    if (gi.procs.size() == 1) {
+      const char* base = static_cast<const char*>(local_gathered);
+      for (std::size_t k = 0; k < members.size(); ++k)
+        ptrs[set_->grank_of[members[k]]] = base + k * block_bytes;
+      return;
+    }
+    const std::size_t pad = static_cast<std::size_t>(gi.maxm) * block_bytes;
+    std::vector<char> packed(pad, 0);
+    std::memcpy(packed.data(), local_gathered,
+                members.size() * block_bytes);
+    storage.resize(gi.procs.size() * pad);
+    const std::size_t esz = dtype_bytes(dtype_);
+    tcp_allgather(slot, packed.data(), storage.data(),
+                  static_cast<std::int64_t>(pad / esz));
+    for (std::size_t qi = 0; qi < gi.procs.size(); ++qi) {
+      const auto& mems = gi.members_by_proc[qi];
+      for (std::size_t k = 0; k < mems.size(); ++k)
+        ptrs[set_->grank_of[mems[k]]] =
+            storage.data() + qi * pad + k * block_bytes;
+    }
+  }
+
+  void run_collective(int slot, pjrtfab::Op op, std::int64_t count,
+                      std::int64_t extra, const void* src, void* dst) {
+    const std::int64_t G = size();
+    const std::size_t esz = dtype_bytes(dtype_);
+    const std::size_t m = lg_->local_members.size();
+    const bool spanning = set_->info[gidx_].procs.size() > 1;
+
+    // ---- phase 1: local device collective (every member thread) ----
+    std::vector<char> scratch;
+    switch (op) {
+      case pjrtfab::Op::Allreduce:
+        sub_allreduce(slot, src, dst, count);
+        break;
+      case pjrtfab::Op::Allgather:
+        scratch.resize(m * count * esz);
+        sub_allgather(slot, src, scratch.data(), count);
+        break;
+      case pjrtfab::Op::ReduceScatterBlock:
+        scratch.resize(static_cast<std::size_t>(G) * count * esz);
+        sub_allreduce(slot, src, scratch.data(), G * count);
+        break;
+      case pjrtfab::Op::Alltoall:
+        scratch.resize(m * G * count * esz);
+        sub_allgather(slot, src, scratch.data(), G * count);
+        break;
+      case pjrtfab::Op::RingShift:
+        scratch.resize(m * count * esz);
+        sub_allgather(slot, src, scratch.data(), count);
+        break;
+      case pjrtfab::Op::Barrier:
+        sub_->Barrier();
+        break;
+    }
+
+    // ---- phase 2: rendezvous; last arriver runs the DCN combine ----
+    auto* self = this;
+    lg_->rdv[slot < num_slots_ ? slot : num_slots_]->collective(
+        midx_, static_cast<int>(op), count, extra, dst, scratch.data(),
+        [self, slot, op, count, extra, G, esz, spanning](
+            const std::vector<void*>& dsts,
+            const std::vector<void*>& scratches) {
+          self->dcn_phase(slot, op, count, extra, G, esz, spanning, dsts,
+                          scratches);
+        });
+  }
+
+  void dcn_phase(int slot, pjrtfab::Op op, std::int64_t count,
+                 std::int64_t extra, std::int64_t G, std::size_t esz,
+                 bool spanning, const std::vector<void*>& dsts,
+                 const std::vector<void*>& scratches) {
+    const auto& members = lg_->local_members;
+    switch (op) {
+      case pjrtfab::Op::Barrier:
+        if (spanning) lg_->tcp->Barrier();
+        break;
+      case pjrtfab::Op::Allreduce: {
+        if (!spanning) break;  // local sum IS the group sum
+        std::vector<char> tmp(count * esz);
+        tcp_allreduce(slot, dsts[0], tmp.data(), count);
+        for (void* d : dsts) std::memcpy(d, tmp.data(), tmp.size());
+        break;
+      }
+      case pjrtfab::Op::ReduceScatterBlock: {
+        const char* full = static_cast<const char*>(scratches[0]);
+        std::vector<char> tmp;
+        if (spanning) {  // AR-based reduce-scatter on the DCN leg
+          tmp.resize(static_cast<std::size_t>(G) * count * esz);
+          tcp_allreduce(slot, full, tmp.data(), G * count);
+          full = tmp.data();
+        }
+        for (std::size_t k = 0; k < members.size(); ++k)
+          std::memcpy(dsts[k],
+                      full + static_cast<std::size_t>(
+                                 set_->grank_of[members[k]]) *
+                                 count * esz,
+                      count * esz);
+        break;
+      }
+      case pjrtfab::Op::Allgather: {
+        std::vector<char> storage;
+        std::vector<const char*> ptrs;
+        gather_member_blocks(slot, scratches[0], count * esz, storage, ptrs);
+        for (void* d : dsts)
+          for (std::int64_t j = 0; j < G; ++j)
+            std::memcpy(static_cast<char*>(d) + j * count * esz, ptrs[j],
+                        count * esz);
+        break;
+      }
+      case pjrtfab::Op::Alltoall: {
+        std::vector<char> storage;
+        std::vector<const char*> ptrs;  // each member's FULL src (G blocks)
+        gather_member_blocks(slot, scratches[0],
+                             static_cast<std::size_t>(G) * count * esz,
+                             storage, ptrs);
+        for (std::size_t k = 0; k < members.size(); ++k) {
+          std::size_t gk = static_cast<std::size_t>(
+              set_->grank_of[members[k]]);
+          for (std::int64_t j = 0; j < G; ++j)
+            std::memcpy(static_cast<char*>(dsts[k]) + j * count * esz,
+                        ptrs[j] + gk * count * esz, count * esz);
+        }
+        break;
+      }
+      case pjrtfab::Op::RingShift: {
+        std::vector<char> storage;
+        std::vector<const char*> ptrs;
+        gather_member_blocks(slot, scratches[0], count * esz, storage, ptrs);
+        for (std::size_t k = 0; k < members.size(); ++k) {
+          std::int64_t gk = set_->grank_of[members[k]];
+          std::int64_t from = ((gk - extra) % G + G) % G;
+          std::memcpy(dsts[k], ptrs[from], count * esz);
+        }
+        break;
+      }
+    }
+  }
+
+  std::shared_ptr<hier::GroupSet> set_;
+  std::unique_ptr<ProxyCommunicator> sub_;
+  int grk_;
+  int gidx_ = 0;
+  int midx_ = 0;
+  hier::GroupSet::LocalGroup* lg_ = nullptr;
+  DType dtype_;
+  int num_slots_;
+  std::string name_;
+  std::vector<shm::SlotWorker> workers_;
+};
+
+// The two-level world: local device fabric + TCP process mesh.
+class HierFabric : public Fabric {
+ public:
+  HierFabric(const std::string& coordinator, int nprocs, int proc_rank,
+             int global_world, DType dtype,
+             std::unique_ptr<CollectiveExecutor> exec, int num_slots = 32)
+      : world_(global_world),
+        nprocs_(nprocs),
+        proc_rank_(proc_rank),
+        dtype_(dtype),
+        num_slots_(num_slots),
+        tcp_(coordinator, nprocs, proc_rank, dtype),
+        local_(checked_local(global_world, nprocs), dtype, std::move(exec),
+               num_slots) {
+    L_ = global_world / nprocs;
+    base_ = proc_rank * L_;
+    // control comm (f32 — exact for small split colors) created first so
+    // every process's comm-id sequence aligns
+    ctrl_ = make_tcp_comm(all_procs(), DType::F32, "hier_ctrl");
+    world_set_ = build_set(std::vector<int>(world_, 0), "hier_world");
+  }
+
+  int world_size() const override { return world_; }
+  DType dtype() const override { return dtype_; }
+  std::string backend() const override { return "pjrt"; }
+  CollectiveExecutor& executor() { return local_.executor(); }
+
+  std::unique_ptr<ProxyCommunicator> world_comm(int rank) override {
+    return std::make_unique<HierCommunicator>(
+        world_set_, local_.world_comm(rank - base_), rank, dtype_,
+        num_slots_, "hier_world");
+  }
+
+  // Collective over the GLOBAL world: local split on the device fabric,
+  // colors allgathered across processes, same groups + same TCP comm ids
+  // derived everywhere.
+  std::unique_ptr<ProxyCommunicator> split(
+      int world_rank, int color, const std::string& name) override {
+    auto sub = local_.split(world_rank - base_, color, name + "_ici");
+    std::shared_ptr<hier::GroupSet> set;
+    std::uint64_t seq;
+    {
+      std::unique_lock<std::mutex> lk(split_m_);
+      if (split_arrived_ == 0) split_colors_.assign(L_, 0);
+      split_colors_[world_rank - base_] = color;
+      seq = split_seq_;
+      if (++split_arrived_ == L_) {
+        try {
+          std::vector<int> world_colors(world_, 0);
+          if (nprocs_ > 1) {
+            std::vector<float> mine(L_), all(world_);
+            for (int i = 0; i < L_; ++i)
+              mine[i] = static_cast<float>(split_colors_[i]);
+            ctrl_->Allgather(mine.data(), all.data(), L_);
+            for (int r = 0; r < world_; ++r)
+              world_colors[r] = static_cast<int>(all[r]);
+          } else {
+            world_colors = split_colors_;
+          }
+          split_sets_[seq] = build_set(world_colors, name);
+        } catch (...) {
+          split_sets_[seq] = nullptr;
+          split_arrived_ = 0;
+          ++split_seq_;
+          split_cv_.notify_all();
+          throw;
+        }
+        split_arrived_ = 0;
+        ++split_seq_;
+        split_cv_.notify_all();
+      } else {
+        split_cv_.wait(lk, [&] { return split_seq_ > seq; });
+      }
+      set = split_sets_.at(seq);
+    }
+    if (!set)
+      throw std::runtime_error(
+          "hier split: group construction failed on another thread");
+    return std::make_unique<HierCommunicator>(std::move(set), std::move(sub),
+                                              world_rank, dtype_, num_slots_,
+                                              name);
+  }
+
+  // This process runs its local ranks as threads (global rank = base + t).
+  void launch(const std::function<void(int)>& body) override {
+    local_.launch([&](int lr) { body(base_ + lr); });
+  }
+
+  std::vector<int> local_ranks() const override {
+    std::vector<int> out(L_);
+    for (int i = 0; i < L_; ++i) out[i] = base_ + i;
+    return out;
+  }
+  int process_index() const override { return proc_rank_; }
+
+  void burn(int rank, double us, double time_scale) override {
+    local_.burn(rank - base_, us, time_scale);
+  }
+
+  void describe(Json& meta, Json& mesh) const override {
+    local_.describe(meta, mesh);
+    meta["backend"] = "pjrt";
+    meta["num_processes"] = nprocs_;
+    meta["local_world"] = L_;
+    meta["dcn_transport"] = "tcp";
+    meta["p2p_transport"] = "host+tcp";
+    // the DCN leg of gather-style ops moves padded member blocks and the
+    // reduce-scatter leg moves all G blocks — busbw math must not apply
+    // ring correction factors to these records
+    meta["dcn_algo"] = "hierarchical";
+    mesh["hierarchy"] = "ici+dcn";
+  }
+
+ private:
+  static int checked_local(int world, int nprocs) {
+    if (nprocs <= 0 || world <= 0 || world % nprocs != 0)
+      throw std::invalid_argument(
+          "hier fabric: world must be a positive multiple of --procs");
+    return world / nprocs;
+  }
+
+  std::vector<int> all_procs() const {
+    std::vector<int> p(nprocs_);
+    for (int i = 0; i < nprocs_; ++i) p[i] = i;
+    return p;
+  }
+
+  std::unique_ptr<TcpCommunicator> make_tcp_comm(std::vector<int> procs,
+                                                 DType dt,
+                                                 const std::string& name) {
+    std::uint32_t id = tcp_.allocate_comm_id();
+    bool mine = false;
+    for (int p : procs) mine |= (p == proc_rank_);
+    if (!mine) return nullptr;  // id stays allocated to keep alignment
+    return std::make_unique<TcpCommunicator>(&tcp_, id, std::move(procs),
+                                             proc_rank_, dt, num_slots_,
+                                             name);
+  }
+
+  std::shared_ptr<hier::GroupSet> build_set(
+      const std::vector<int>& world_colors, const std::string& name) {
+    auto set = std::make_shared<hier::GroupSet>();
+    set->world = world_;
+    set->local = L_;
+    set->nprocs = nprocs_;
+    set->my_proc = proc_rank_;
+    set->group_of.resize(world_);
+    set->grank_of.resize(world_);
+    std::map<int, std::vector<int>> by_color;
+    for (int r = 0; r < world_; ++r) by_color[world_colors[r]].push_back(r);
+    for (auto& [c, members] : by_color) {
+      int gi = static_cast<int>(set->groups.size());
+      hier::GroupSet::Info info;
+      for (std::size_t k = 0; k < members.size(); ++k) {
+        set->group_of[members[k]] = gi;
+        set->grank_of[members[k]] = static_cast<int>(k);
+        int p = members[k] / L_;
+        if (info.procs.empty() || info.procs.back() != p) {
+          info.procs.push_back(p);
+          info.members_by_proc.emplace_back();
+        }
+        info.members_by_proc.back().push_back(members[k]);
+      }
+      for (const auto& mems : info.members_by_proc)
+        info.maxm = std::max(info.maxm, static_cast<int>(mems.size()));
+      set->groups.push_back(members);
+      set->info.push_back(std::move(info));
+    }
+    set->local_groups.resize(set->groups.size());
+    for (std::size_t gi = 0; gi < set->groups.size(); ++gi) {
+      const auto& info = set->info[gi];
+      // spanning groups allocate a TCP comm id in every process (even
+      // non-members) so the id sequence stays aligned fabric-wide
+      std::unique_ptr<TcpCommunicator> tcp;
+      if (info.procs.size() > 1)
+        tcp = make_tcp_comm(info.procs, dtype_,
+                            name + "_dcn" + std::to_string(gi));
+      bool mine = false;
+      for (int p : info.procs) mine |= (p == proc_rank_);
+      if (!mine) continue;
+      auto lg = std::make_unique<hier::GroupSet::LocalGroup>();
+      for (int r : set->groups[gi])
+        if (set->proc_of(r) == proc_rank_) lg->local_members.push_back(r);
+      lg->tcp = std::move(tcp);
+      for (int s = 0; s <= num_slots_; ++s)
+        lg->rdv.push_back(std::make_unique<hier::Rendezvous>(
+            static_cast<int>(lg->local_members.size())));
+      set->local_groups[gi] = std::move(lg);
+    }
+    return set;
+  }
+
+  int world_;
+  int nprocs_;
+  int proc_rank_;
+  int L_ = 1;
+  int base_ = 0;
+  DType dtype_;
+  int num_slots_;
+  TcpFabric tcp_;
+  PjrtFabric local_;
+  std::unique_ptr<TcpCommunicator> ctrl_;
+  std::shared_ptr<hier::GroupSet> world_set_;
+
+  std::mutex split_m_;
+  std::condition_variable split_cv_;
+  std::vector<int> split_colors_;
+  int split_arrived_ = 0;
+  std::uint64_t split_seq_ = 0;
+  std::map<std::uint64_t, std::shared_ptr<hier::GroupSet>> split_sets_;
+};
+
+}  // namespace dlnb

@@ -335,6 +335,12 @@ class TcpFabric : public Fabric {
 
   tcp::Inbox& inbox() { return inbox_; }
 
+  // Reserve the next communicator id without creating a communicator —
+  // layered fabrics (hier_fabric.hpp) construct TcpCommunicators with
+  // explicit member lists and keep ids aligned across processes by
+  // allocating in the same deterministic order everywhere.
+  std::uint32_t allocate_comm_id() { return ++next_comm_id_; }
+
   void send_frame(int dst, const tcp::FrameHeader& h, const void* payload) {
     if (dst == rank_) {  // self-delivery (degenerate groups, self-sends)
       tcp::Inbox::Frame f;

@@ -24,6 +24,7 @@
 #include "dlnb/args.hpp"
 #include "dlnb/fabric.hpp"
 #include "dlnb/harness.hpp"
+#include "dlnb/hier_fabric.hpp"
 #include "dlnb/model_data.hpp"
 #include "dlnb/pjrt_fabric.hpp"
 #include "dlnb/shm_backend.hpp"
@@ -67,8 +68,9 @@ struct ProxyEnv {
   std::string backend = "shm";      // shm | pjrt | tcp
   std::string pjrt_plugin;          // --pjrt_plugin override
   std::vector<int> devices;         // --devices list (reference -d)
-  std::string coordinator;          // tcp: rank 0's host:port
-  int proc_rank = 0;                // tcp: this process's rank
+  std::string coordinator;          // tcp/hier: rank 0's host:port
+  int proc_rank = 0;                // tcp/hier: this process's rank
+  int procs = 1;                    // pjrt: OS processes (hier fabric if >1)
 };
 
 // "0,2,3" -> {0,2,3} (reference parse_devices, cpp/utils.hpp:62-71).
@@ -116,9 +118,14 @@ inline void add_common_args(Args& args) {
                     "device-index list for the pjrt backend, e.g. 0,2,3 "
                     "(reference -d)")
       .optional_str("coordinator", "",
-                    "tcp backend: rank 0's listen address host:port "
-                    "(the ncclUniqueId bootstrap role, dp.cpp:183-188)")
-      .optional_int("rank", 0, "tcp backend: this process's rank")
+                    "tcp/multi-process pjrt: rank 0's listen address "
+                    "host:port (the ncclUniqueId bootstrap role, "
+                    "dp.cpp:183-188)")
+      .optional_int("rank", 0, "tcp/multi-process pjrt: this process's rank")
+      .optional_int("procs", 1,
+                    "pjrt backend: number of OS processes; >1 composes "
+                    "per-process devices (ICI) with a TCP mesh (DCN) — "
+                    "the reference's multi-node NCCL mode, dp.cpp:166-189")
       .flag("loop", "run the schedule forever (congestor mode)")
       .flag("no_topology", "skip the startup fabric-topology graph");
 }
@@ -148,6 +155,7 @@ inline ProxyEnv make_env(const Args& args) {
   env.devices = parse_device_list(args.str("devices"));
   env.coordinator = args.str("coordinator");
   env.proc_rank = static_cast<int>(args.integer("rank"));
+  env.procs = static_cast<int>(args.integer("procs"));
   if (env.backend != "shm" && env.backend != "pjrt" &&
       env.backend != "tcp")
     throw std::runtime_error("unknown --backend '" + env.backend +
@@ -157,16 +165,32 @@ inline ProxyEnv make_env(const Args& args) {
         "--backend tcp needs --coordinator host:port (rank 0 listens "
         "there) and --rank");
   if (env.world <= 0) throw std::runtime_error("--world must be positive");
+  if (env.procs < 1) throw std::runtime_error("--procs must be >= 1");
+  if (env.procs > 1) {
+    if (env.backend != "pjrt")
+      throw std::runtime_error(
+          "--procs > 1 requires --backend pjrt (the hierarchical ICI+DCN "
+          "fabric; the tcp backend is one-rank-per-process already)");
+    if (env.world % env.procs != 0)
+      throw std::runtime_error("--world must be a multiple of --procs");
+    if (env.coordinator.empty())
+      throw std::runtime_error(
+          "--procs > 1 needs --coordinator host:port and --rank");
+    if (env.proc_rank < 0 || env.proc_rank >= env.procs)
+      throw std::runtime_error("--rank must be in [0, --procs)");
+  }
+  // with multiple processes, each process drives world/procs local devices
+  int local_world = env.world / env.procs;
   if (!env.devices.empty()) {
     if (env.backend != "pjrt")
       throw std::runtime_error(
           "--devices only applies to --backend pjrt (the shm fabric has no "
           "devices)");
-    if (static_cast<int>(env.devices.size()) < env.world)
+    if (static_cast<int>(env.devices.size()) < local_world)
       throw std::runtime_error("--devices lists " +
                                std::to_string(env.devices.size()) +
-                               " device(s) for world " +
-                               std::to_string(env.world));
+                               " device(s) for local world " +
+                               std::to_string(local_world));
     std::set<int> uniq(env.devices.begin(), env.devices.end());
     if (uniq.size() != env.devices.size())
       throw std::runtime_error(
@@ -177,6 +201,11 @@ inline ProxyEnv make_env(const Args& args) {
 }
 
 inline std::unique_ptr<Fabric> make_fabric(const ProxyEnv& env) {
+  if (env.backend == "pjrt" && env.procs > 1)
+    return std::make_unique<HierFabric>(
+        env.coordinator, env.procs, env.proc_rank, env.world, env.dtype,
+        make_pjrt_executor(env.world / env.procs, env.pjrt_plugin,
+                           env.devices, std::cerr));
   if (env.backend == "pjrt")
     return std::make_unique<PjrtFabric>(
         env.world, env.dtype,
