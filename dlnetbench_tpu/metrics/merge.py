@@ -30,8 +30,10 @@ from dlnetbench_tpu.metrics.parser import load_records, validate_record
 # global keys that legitimately differ between the emitting processes:
 # per-process measurements (each process calibrates its own burn kernel)
 # and host-local identity — never evidence of records from different runs
-_VOLATILE_GLOBALS = {"energy_source", "burn_ns_per_iter", "cache_hits",
-                     "cache_misses"}
+# (energy_scope rides with energy_source: a host without a counter emits
+# neither key, and that heterogeneity must not abort the merge)
+_VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
+                     "cache_hits", "cache_misses"}
 
 
 def _comparable_global(g: dict) -> dict:

@@ -96,9 +96,17 @@ def measure(tag: str, out_dir: Path, args) -> dict:
     merged = merge_records([r for o in outs for r in load_records(o)])
     runtimes = [t for row in merged["ranks"] for t in row["runtimes"]]
     barriers = [t for row in merged["ranks"] for t in row["barrier_time"]]
-    return {"tag": tag,
-            "runtime_us": sum(runtimes) / len(runtimes),
-            "barrier_us": sum(barriers) / len(barriers)}
+    out = {"tag": tag,
+           "runtime_us": sum(runtimes) / len(runtimes),
+           "barrier_us": sum(barriers) / len(barriers)}
+    # per-process host energy when the native chain found a counter
+    # (energy.hpp: RAPL/hwmon; absent on rigs without one)
+    joules = [j for row in merged["ranks"]
+              for j in row.get("energy_consumed", [])]
+    if joules:
+        out["energy_j_per_run"] = sum(joules) / len(joules)
+        out["energy_source"] = merged["global"].get("energy_source")
+    return out
 
 
 def main() -> int:
@@ -144,6 +152,12 @@ def main() -> int:
         "barrier_inflation":
             congested["barrier_us"] / max(solo["barrier_us"], 1e-9),
     }
+    if "energy_j_per_run" in solo and "energy_j_per_run" in congested:
+        # the study's energy question: how many extra joules does the
+        # same work cost under interference (reference Pareto axis)
+        report["energy_inflation"] = (
+            congested["energy_j_per_run"]
+            / max(solo["energy_j_per_run"], 1e-9))
     (args.out_dir / "report.json").write_text(json.dumps(report, indent=2))
     print(f"solo:      runtime {solo['runtime_us']:12.1f} us   "
           f"barrier {solo['barrier_us']:10.1f} us")
@@ -151,6 +165,11 @@ def main() -> int:
           f"barrier {congested['barrier_us']:10.1f} us")
     print(f"inflation: runtime x{report['runtime_inflation']:.2f}   "
           f"barrier x{report['barrier_inflation']:.2f}")
+    if "energy_inflation" in report:
+        print(f"energy:    solo {solo['energy_j_per_run']:.3f} J/run   "
+              f"congested {congested['energy_j_per_run']:.3f} J/run   "
+              f"x{report['energy_inflation']:.2f} "
+              f"({solo.get('energy_source')})")
     print(f"wrote {args.out_dir}/report.json")
     return 0
 

@@ -12,6 +12,7 @@
 // kernels; the native PJRT backend can layer those in the same slot.
 #pragma once
 
+#include <algorithm>
 #include <chrono>
 #include <cmath>
 #include <cstring>
@@ -22,6 +23,7 @@
 #include <vector>
 
 #include "dlnb/communicator.hpp"
+#include "dlnb/energy.hpp"
 #include "dlnb/timers.hpp"
 
 namespace dlnb {
@@ -120,11 +122,24 @@ inline RankRun run_measured(
   }
 
   timers.clear();  // reference clears timer vectors pre-measurement
+
+  // Per-run energy brackets (reference per-rank energy_consumed arrays,
+  // plots/parser.py:172): energy is a HOST counter, so only the process's
+  // designated rank records it — proxies pass the world communicator
+  // here, whose rank() is the global rank proxy_runner designated.
+  auto& meter = energy::Meter::instance();
+  bool record_energy =
+      meter.available() && meter.recording_rank.load() == sync_comm.rank();
   for (int r = 0; r < out.runs; ++r) {
+    double e0 = record_energy ? meter.read_joules() : 0.0;
     auto t0 = Clock::now();
     step(timers);
     timers.record("runtimes", us_since(t0));
+    if (record_energy)
+      timers.record("energy_consumed",
+                    std::max(0.0, meter.read_joules() - e0));
   }
+  if (record_energy) meter.relax();
   return out;
 }
 

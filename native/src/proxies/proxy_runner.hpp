@@ -257,6 +257,11 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
 
   std::unique_ptr<Fabric> fab_ptr = make_fabric(env);
   Fabric& fab = *fab_ptr;
+  // host energy channel: the process's first local rank brackets its
+  // runs (reference PROXY_ENERGY_PROFILING role; see energy.hpp scope)
+  auto& meter = energy::Meter::instance();
+  if (meter.available())
+    meter.recording_rank.store(fab.local_ranks().front());
   std::vector<TimerSet> timers(env.world);
   std::vector<RankRun> runs(env.world);
   std::vector<Json> extras(env.world);
@@ -282,6 +287,12 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
   meta["model"] = env.model_name;
   meta["world_size"] = env.world;
   meta["dtype"] = dtype_name(env.dtype);
+  if (meter.available()) {
+    // which sensor produced energy_consumed — misattribution must be
+    // visible in the record, not silent (energy.py run_proxy parity)
+    meta["energy_source"] = meter.source();
+    meta["energy_scope"] = "process";
+  }
   meta["time_scale"] = env.cfg.time_scale;
   meta["size_scale"] = env.cfg.size_scale;
   Json mesh = Json::object();
