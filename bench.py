@@ -112,13 +112,25 @@ def main() -> int:
     achieved = total_flops / step_s
     vs_baseline = roofline_s / step_s  # 1.0 = running at the roofline
 
+    # Causal-honest accounting (VERDICT r2): the roofline — like standard
+    # MFU convention (and the reference, python/model_stats.py:128) —
+    # credits the S^2 score/AV matmuls in FULL, but the causal flash
+    # kernel executes only the lower-triangular half.  vs_baseline_causal
+    # divides those credited score FLOPs by 2, so it is the utilization
+    # of FLOPs the chip actually ran.
+    causal_elided = card.num_layers * 2 * BATCH * SEQ * SEQ * card.embed_dim
+    executed_ratio = (fwd_flops - causal_elided) / fwd_flops
+    vs_baseline_causal = vs_baseline * executed_ratio
+
     print(json.dumps({
         "metric": f"llama3_8b-shaped {LAYERS}L train step, B={BATCH} S={SEQ}, "
                   f"{dev.device_kind} ({hw_key})",
         "value": round(step_s * 1e3, 3),
         "unit": "ms",
         "vs_baseline": round(vs_baseline, 4),
+        "vs_baseline_causal": round(vs_baseline_causal, 4),
         "tflops_achieved": round(achieved / 1e12, 2),
+        "tflops_executed": round(achieved * executed_ratio / 1e12, 2),
         "loss": round(float(loss), 4),
         "logits_dtype": "float32" if cfg.logits_f32 else "bfloat16",
     }))

@@ -53,6 +53,12 @@ def effective_bandwidth(records: list[dict]):
                 bus_total = sum(c["bytes"] * bus_factor(c["kind"],
                                                         c["group"])
                                 for c in components)
+                # a component may declare its figure a lower bound (e.g.
+                # the native engine's pp_comm: middle stages bracket both
+                # their recv and send in the timer, so busbw reads ~2x
+                # low there) — surfaced as a column, not a code comment
+                bound = ("lower" if any(c.get("bound") == "lower"
+                                        for c in components) else "exact")
                 for run, t_us in enumerate(times):
                     if not t_us > 0:
                         continue
@@ -68,15 +74,18 @@ def effective_bandwidth(records: list[dict]):
                         "time_us": float(t_us),
                         "algbw_GBps": total / (t_us * 1e-6) / 1e9,
                         "busbw_GBps": bus_total / (t_us * 1e-6) / 1e9,
+                        "bound": bound,
                     })
     return pd.DataFrame(rows)
 
 
 def bandwidth_summary(records: list[dict]):
-    """Mean per (section, model, collective): the north-star table."""
+    """Mean per (section, model, collective): the north-star table.
+    Carries the ``bound`` marker so lower-bound rows stay labeled."""
     bw = effective_bandwidth(records)
     if bw.empty:
         return bw
-    return (bw.groupby(["section", "model", "collective", "group_size"])
+    return (bw.groupby(["section", "model", "collective", "group_size",
+                        "bound"])
             [["time_us", "msg_bytes", "algbw_GBps", "busbw_GBps"]]
             .mean().reset_index())

@@ -290,6 +290,23 @@ class ZBTables:
         return [[s for s in tick if s > 0] for tick in self.b_stages]
 
 
+def zb_unit_ticks(tables: "ZBTables", bwd_units: float = 2.0) -> float:
+    """Makespan of the tick-synchronous ZB table in FORWARD units, with
+    the backward weight derived from the stats rather than hardcoded:
+    F costs 1 unit, B and W each cost half a backward (bwd_units / 2).
+    The engine is tick-synchronous, so each tick costs its largest
+    resident op.  With the stat model's bwd = 2 x fwd (bwd_units == 2)
+    every tick costs 1 and this equals ``tables.ticks``; a stats file
+    with a different bwd/fwd ratio changes the weights instead of
+    silently skewing cross-schedule comparisons."""
+    half = bwd_units / 2.0
+    total = 0.0
+    for ft, bt, wt in zip(tables.f_stages, tables.b_stages,
+                          tables.w_stages):
+        total += max(1.0 if ft else 0.0, half if (bt or wt) else 0.0)
+    return total
+
+
 def zb_tables(num_stages: int, num_microbatches: int) -> ZBTables:
     """Tick-synchronous greedy construction of ZB-H1: every stage runs at
     most one unit op per tick with priority B > F > W.  Dependencies:

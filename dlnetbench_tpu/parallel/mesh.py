@@ -94,6 +94,10 @@ def describe_mesh(mesh: Mesh) -> dict:
         "platform": devs[0].platform,
         "device_kind": devs[0].device_kind,
         "num_hosts": len({d.process_index for d in devs}),
+        # explicit marker (not just platform: cpu): collectives on a
+        # virtual host mesh move loopback/thread bytes, and bandwidth
+        # numbers derived from them must never be read as fabric numbers
+        "fabric": "virtual" if devs[0].platform == "cpu" else "real",
     }
     coords = []
     for d in devs:

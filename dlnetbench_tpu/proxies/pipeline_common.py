@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 from dlnetbench_tpu.core.model_card import ModelCard
 from dlnetbench_tpu.core.model_stats import ModelStats
 from dlnetbench_tpu.core.schedule import (
-    moe_schedule, pipeline_schedule, zb_tables)
+    moe_schedule, pipeline_schedule, zb_tables, zb_unit_ticks)
 from dlnetbench_tpu.parallel import collectives as col
 from dlnetbench_tpu.parallel.buffers import scaled_elems, sharded_zeros
 from dlnetbench_tpu.parallel.mesh import (
@@ -173,6 +173,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
     # zb: ZB-H1 greedy tick tables (F / input-grad B / weight-grad W);
     # only F and B hop (W is the local weight-grad half)
     zb = zb_tables(S, M) if schedule == "zb" else None
+    # backward weight in forward units, from the stats (2.0 for the stat
+    # model's bwd = 2 x fwd convention; see ticks_total below)
+    bwd_units = (sched.bwd_us_per_stage_mb / sched.fwd_us_per_stage_mb
+                 if sched.fwd_us_per_stage_mb > 0 else 2.0)
     if schedule == "gpipe":
         _sender_tables = (gp_fwd_senders, gp_bwd_senders)
     elif schedule == "zb":
@@ -438,14 +442,16 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         # both schedules pay the (S-1)-tick fill/drain bubble; analysis can
         # divide runtime by this to recover per-tick cost
         "ticks_per_direction": ticks_per_direction,
-        # pipeline clock in UNIT ticks (1 unit = fwd = half-bwd, the stat
-        # model's bwd = 2 x fwd): gpipe/1f1b span (M+S-1) fwd ticks plus
-        # (M+S-1) 2-unit bwd ticks = 3(M+S-1); zb reports its greedy
-        # table's real makespan (3M + S - 1 when M is not tiny).
-        # Dividing runtime by this gives a schedule-comparable per-unit
-        # cost (the zero-bubble gain).
-        "ticks_total": zb.ticks if zb is not None
-        else 3 * ticks_per_direction,
+        # pipeline clock in UNIT ticks (1 unit = one fwd): gpipe/1f1b
+        # span (M+S-1) fwd ticks plus (M+S-1) bwd ticks; zb reports its
+        # greedy table's real weighted makespan (3M + S - 1 when M is
+        # not tiny and bwd = 2 x fwd).  The backward weight is DERIVED
+        # from the stats' bwd/fwd ratio, not hardcoded — a stats file
+        # breaking the 2x convention changes the weights, not the
+        # honesty.  Dividing runtime by this gives a schedule-comparable
+        # per-unit cost (the zero-bubble gain).
+        "ticks_total": (zb_unit_ticks(zb, bwd_units) if zb is not None
+                        else (1.0 + bwd_units) * ticks_per_direction),
         "pp_permute_ticks": pp_permute_ticks,
         "pp_edge_messages": pp_edge_messages,
         "layers_per_stage": sched.layers_per_stage,

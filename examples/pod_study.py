@@ -168,9 +168,12 @@ def report(args, records: Path) -> None:
         bw = pd.concat(per_point, ignore_index=True)
         # one line per (proxy, model, world, collective): the per-iteration
         # exposed time and the standard busbw figure
+        # 'bound' rides along: "lower" rows (e.g. the native engine's
+        # middle-stage pp_comm) must stay labeled in the table and CSV
         cols = ["proxy", "model", "world", "sched", "collective",
-                "group_size", "time_us", "algbw_GBps", "busbw_GBps"]
-        bw = (bw.groupby(cols[:6], as_index=False)[cols[6:]].mean()
+                "group_size", "bound", "time_us", "algbw_GBps",
+                "busbw_GBps"]
+        bw = (bw.groupby(cols[:7], as_index=False)[cols[7:]].mean()
               .sort_values(["proxy", "model", "world", "sched"]))[cols]
         print("\n=== effective bandwidth per collective "
               "(mean over ranks/runs) ===")

@@ -36,6 +36,7 @@
 // — runs in CI without a TPU.
 #pragma once
 
+#include <atomic>
 #include <chrono>
 #include <cmath>
 #include <condition_variable>
@@ -129,6 +130,11 @@ class HostExecutor : public CollectiveExecutor {
         break;
       case CollOp::ReduceScatter: {
         for (const auto& g : groups) {
+          // a non-divisible count would silently truncate the tail; the
+          // reference pads explicitly (fsdp.cpp:251-255) and the schedule
+          // layer here does too — the executor must not paper over a
+          // caller that didn't
+          check_divisible(n_in, g.size(), "ReduceScatter");
           std::int64_t block = n_in / static_cast<std::int64_t>(g.size());
           for (std::size_t k = 0; k < g.size(); ++k)
             for (std::int64_t i = 0; i < block; ++i) {
@@ -142,6 +148,7 @@ class HostExecutor : public CollectiveExecutor {
       }
       case CollOp::AllToAll: {
         for (const auto& g : groups) {
+          check_divisible(n_in, g.size(), "AllToAll");
           std::int64_t block = n_in / static_cast<std::int64_t>(g.size());
           for (std::size_t p = 0; p < g.size(); ++p)
             for (std::size_t q = 0; q < g.size(); ++q)
@@ -176,6 +183,16 @@ class HostExecutor : public CollectiveExecutor {
   }
 
  private:
+  static void check_divisible(std::int64_t n_in, std::size_t group,
+                              const char* op) {
+    if (group && n_in % static_cast<std::int64_t>(group) != 0)
+      throw std::invalid_argument(
+          std::string("HostExecutor ") + op + ": count " +
+          std::to_string(n_in) + " not divisible by group size " +
+          std::to_string(group) + " (pad the buffer like the schedule "
+          "layer does)");
+  }
+
   mutable std::mutex m_;
   std::set<std::string> seen_;
   std::size_t hits_ = 0, misses_ = 0;
@@ -201,14 +218,25 @@ class PluginExecutor : public CollectiveExecutor {
   // two-point scheme as the JAX tier (proxies/burn.py calibrate()).
   bool device_burn(int rank, double us) override {
     if (us <= 0) return true;
-    if (rank < 0 || rank >= ctx_.num_devices()) return false;
+    if (rank < 0 || rank >= ctx_.num_devices()) {
+      // caller will host-sleep instead; the record must not claim pure
+      // device burn if that ever happens (unreachable via proxy_runner,
+      // which sizes the executor to the world, but PluginExecutor is
+      // also a library API)
+      fell_back_.store(true, std::memory_order_relaxed);
+      return false;
+    }
     calibrate_once();
     auto iters = static_cast<std::int32_t>(
         std::max(1.0, std::round(us * 1000.0 / ns_per_iter_)));
     ctx_.run_burn(rank, iters, kBurnWidth);
     return true;
   }
-  std::string compute_mode() const override { return "device_burn"; }
+  std::string compute_mode() const override {
+    return fell_back_.load(std::memory_order_relaxed)
+               ? "device_burn+host_sleep"
+               : "device_burn";
+  }
   double burn_ns_per_iter() const override { return ns_per_iter_; }
 
   int num_devices() const { return ctx_.num_devices(); }
@@ -243,6 +271,7 @@ class PluginExecutor : public CollectiveExecutor {
   PjrtContext ctx_;
   std::once_flag calibrated_;
   double ns_per_iter_ = 0.0;
+  mutable std::atomic<bool> fell_back_{false};
 };
 #endif  // DLNB_HAVE_PJRT
 

@@ -170,9 +170,12 @@ struct ZBOp {
 };
 
 // Core greedy simulation; returns the makespan in ticks and, when
-// `stage` >= 0, that stage's ops in execution order via `mine`.
+// `stage` >= 0, that stage's ops in execution order via `mine`.  When
+// `tick_kinds` is given, appends one entry per tick: bit0 = some stage
+// ran F, bit1 = some stage ran B or W (for the weighted unit makespan).
 inline i64 zb_simulate(i64 num_stages, i64 num_microbatches, i64 stage,
-                       std::vector<ZBOp>* mine) {
+                       std::vector<ZBOp>* mine,
+                       std::vector<unsigned char>* tick_kinds = nullptr) {
   const i64 S = num_stages, M = num_microbatches;
   if (S <= 0 || M <= 0)
     throw std::invalid_argument("zb_ops: S and M must be positive");
@@ -186,12 +189,14 @@ inline i64 zb_simulate(i64 num_stages, i64 num_microbatches, i64 stage,
     return true;
   };
   while (!done()) {
+    unsigned char kinds = 0;
     for (i64 s = 0; s < S; ++s) {
       i64 k = nb[s];
       if (k < nf[s] &&
           (s == S - 1 || (b_tick[s + 1][k] >= 0 && b_tick[s + 1][k] < t))) {
         b_tick[s][k] = t;
         ++nb[s];
+        kinds |= 2;
         if (s == stage && mine) mine->push_back({'B', k});
         continue;
       }
@@ -200,14 +205,17 @@ inline i64 zb_simulate(i64 num_stages, i64 num_microbatches, i64 stage,
           (s == 0 || (f_tick[s - 1][k] >= 0 && f_tick[s - 1][k] < t))) {
         f_tick[s][k] = t;
         ++nf[s];
+        kinds |= 1;
         if (s == stage && mine) mine->push_back({'F', k});
         continue;
       }
       if (nw[s] < nb[s]) {
         ++nw[s];
+        kinds |= 2;
         if (s == stage && mine) mine->push_back({'W', nw[s] - 1});
       }
     }
+    if (tick_kinds) tick_kinds->push_back(kinds);
     if (++t > 4 * (M + S))
       throw std::runtime_error("zb_simulate failed to converge");
   }
@@ -225,6 +233,22 @@ inline std::vector<ZBOp> zb_ops(i64 num_stages, i64 num_microbatches,
 // zb_tables(...).ticks; 3M + S - 1 when M >= S-ish, longer for tiny M).
 inline i64 zb_ticks(i64 num_stages, i64 num_microbatches) {
   return zb_simulate(num_stages, num_microbatches, -1, nullptr);
+}
+
+// Weighted makespan in FORWARD units (== the JAX tier's zb_unit_ticks):
+// F costs 1, B and W each cost half a backward (bwd_units / 2, DERIVED
+// from the stats' bwd/fwd ratio rather than hardcoding the 2x
+// convention); the engine is tick-synchronous, so each tick costs its
+// largest resident op.  Equals zb_ticks when bwd_units == 2.
+inline double zb_unit_ticks(i64 num_stages, i64 num_microbatches,
+                            double bwd_units) {
+  std::vector<unsigned char> kinds;
+  zb_simulate(num_stages, num_microbatches, -1, nullptr, &kinds);
+  const double half = bwd_units / 2.0;
+  double total = 0.0;
+  for (unsigned char k : kinds)
+    total += std::max((k & 1) ? 1.0 : 0.0, (k & 2) ? half : 0.0);
+  return total;
 }
 
 // ----------------------------------------------------------------- MoE/EP

@@ -52,14 +52,19 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
   // (reference hybrid_2d.cpp:106-133), so measured runtime spans
   // (M + S - 1) ticks per direction, not M — same clock as the JAX tier
   meta["ticks_per_direction"] = p.num_microbatches + p.grid.pp - 1;
-  // pipeline clock in UNIT ticks (1 unit = fwd = half-bwd): the 2-phase
-  // schedules span 3(M+S-1); zb reports its greedy table's REAL makespan
-  // (3M + S - 1 only when M isn't tiny — zb_ticks, matching the JAX
-  // tier's ticks_total so cross-tier analyses divide alike)
+  // pipeline clock in UNIT ticks (1 unit = one fwd): the 2-phase
+  // schedules span (1 + r)(M+S-1) and zb its greedy table's REAL
+  // weighted makespan, where r = the stats' bwd/fwd ratio (2.0 for the
+  // stat model — derived, not hardcoded, so a stats file breaking the
+  // 2x convention reweights instead of skewing comparisons; matches the
+  // JAX tier's ticks_total so cross-tier analyses divide alike)
+  const double bwd_units = p.fwd_us_per_stage_mb > 0
+                               ? p.bwd_us_per_stage_mb / p.fwd_us_per_stage_mb
+                               : 2.0;
   meta["ticks_total"] =
       spec.schedule == "zb"
-          ? zb_ticks(p.grid.pp, p.num_microbatches)
-          : 3 * (p.num_microbatches + p.grid.pp - 1);
+          ? zb_unit_ticks(p.grid.pp, p.num_microbatches, bwd_units)
+          : (1.0 + bwd_units) * (p.num_microbatches + p.grid.pp - 1);
   meta["dp"] = p.grid.dp;
   meta["layers_per_stage"] = p.layers_per_stage;
   meta["pipe_msg_bytes"] = static_cast<i64>(
@@ -88,13 +93,16 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
     // pp_comm: one activation message per microbatch per edge per
     // direction; middle stages bracket BOTH their recv and their send in
     // the timer, so their per-rank busbw reads conservatively (time
-    // spans 2x the declared one-direction bytes).
+    // spans 2x the declared one-direction bytes) — declared as a LOWER
+    // bound so the emitted table carries the caveat, not just this
+    // comment.
     const i64 esz = static_cast<i64>(dtype_bytes(dtype));
     const i64 M = p.num_microbatches;
     Json cm = Json::object();
     cm["pp_comm"] = comm_timer(comm_component(
         "p2p", p.grid.pp,
-        2 * M * scale_count(p.pipe_msg_elems, size_scale) * esz));
+        2 * M * scale_count(p.pipe_msg_elems, size_scale) * esz,
+        /*bound=*/"lower"));
     if (spec.is_moe) {
       cm["ep_comm"] = comm_timer(comm_component(
           "alltoall", spec.ep,

@@ -223,11 +223,16 @@ inline std::unique_ptr<Fabric> make_fabric(const ProxyEnv& env) {
 // timers — wait-tail timers (dp's barrier, fsdp's allgather waits)
 // measure exposure, not transfer time, and would misreport bandwidth.
 inline Json comm_component(const std::string& kind,
-                           std::int64_t group, std::int64_t bytes) {
+                           std::int64_t group, std::int64_t bytes,
+                           const std::string& bound = "") {
   Json c = Json::object();
   c["kind"] = kind;
   c["group"] = group;
   c["bytes"] = bytes;
+  // "lower" marks a deliberately conservative declaration (e.g. middle
+  // pipeline stages timing recv+send against one direction's bytes);
+  // analysis/bandwidth.py surfaces it as a table column
+  if (!bound.empty()) c["bound"] = bound;
   return c;
 }
 

@@ -302,8 +302,12 @@ def test_native_zb_beats_two_phase_wall(native_bin):
         rec = run_proxy(native_bin, "hybrid_2d", "--num_stages", 4,
                         "--num_microbatches", 4, "--dp", 1,
                         "--schedule", sch, "--time_scale", "0.05",
-                        "--runs", 3, world=4)
-        times[sch] = min(rec["ranks"][0]["runtimes"])
+                        "--runs", 5, world=4)
+        # min over ALL ranks x runs: the best observation is the one
+        # closest to the schedule's clock; per-run jitter on a loaded CI
+        # host only ever inflates sleep-driven runtimes
+        times[sch] = min(t for row in rec["ranks"]
+                         for t in row["runtimes"])
     ratio = times["zb"] / times["1f1b"]
     assert ratio < 0.9, (
         f"zb/1f1b runtime ratio {ratio:.3f}; expected ~0.71 — the "
