@@ -59,6 +59,25 @@ def effective_bandwidth(records: list[dict]):
                 # low there) — surfaced as a column, not a code comment
                 bound = ("lower" if any(c.get("bound") == "lower"
                                         for c in components) else "exact")
+                # TCP-tier allreduces below the ring threshold ran the
+                # pairwise FULL MESH — (n-1) x count on the wire, an
+                # algorithm no real fabric runs — so the ring-model
+                # busbw correction does not describe them: refuse the
+                # figure instead of publishing a wrong one.  The
+                # threshold is per MESSAGE, so aggregated multi-op
+                # timers divide by their declared op count; 2-rank
+                # groups are exempt (mesh and ring wire cost coincide
+                # at n=2, which is also why the fabric never rings
+                # there).
+                ring_thr = g.get("tcp_ring_threshold_bytes")
+                fullmesh = (ring_thr is not None and
+                            any(c["kind"] == "allreduce"
+                                and int(c["group"]) > 2
+                                and c["bytes"] / max(int(c.get("ops", 1)),
+                                                     1) < ring_thr
+                                for c in components))
+                if fullmesh:
+                    bound = "fullmesh"
                 for run, t_us in enumerate(times):
                     if not t_us > 0:
                         continue
@@ -73,7 +92,9 @@ def effective_bandwidth(records: list[dict]):
                         "msg_bytes": float(total),
                         "time_us": float(t_us),
                         "algbw_GBps": total / (t_us * 1e-6) / 1e9,
-                        "busbw_GBps": bus_total / (t_us * 1e-6) / 1e9,
+                        "busbw_GBps": (float("nan") if bound == "fullmesh"
+                                       else bus_total / (t_us * 1e-6)
+                                       / 1e9),
                         "bound": bound,
                     })
     return pd.DataFrame(rows)

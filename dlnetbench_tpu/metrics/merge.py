@@ -33,7 +33,7 @@ from dlnetbench_tpu.metrics.parser import load_records, validate_record
 # (energy_scope rides with energy_source: a host without a counter emits
 # neither key, and that heterogeneity must not abort the merge)
 _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
-                     "cache_hits", "cache_misses"}
+                     "cache_hits", "cache_misses", "tcp_bytes_sent"}
 
 
 def _comparable_global(g: dict) -> dict:

@@ -290,9 +290,23 @@ class HierCommunicator : public ProxyCommunicator {
     int t = tag >= 0 ? tag : 1 + slot;
     enqueue(slot, [=] { Recv(dst, count, src_rank, t); });
   }
-  void Wait(int slot) override { worker(slot).wait(); }
+  void Wait(int slot) override {
+    try {
+      worker(slot).wait();
+    } catch (...) {
+      shm::quiesce(workers_);
+      throw;
+    }
+  }
   void WaitAll(int num_slots) override {
-    for (int i = 0; i < num_slots && i < num_slots_; ++i) workers_[i].wait();
+    for (int i = 0; i < num_slots && i < num_slots_; ++i) {
+      try {
+        workers_[i].wait();
+      } catch (...) {
+        shm::quiesce(workers_);
+        throw;
+      }
+    }
   }
 
  private:

@@ -493,9 +493,23 @@ class PjrtCommunicator : public ProxyCommunicator {
       mailbox().recv(src_rank, rank(), t, dst, count * dtype_bytes(dtype_));
     });
   }
-  void Wait(int slot) override { worker(slot).wait(); }
+  void Wait(int slot) override {
+    try {
+      worker(slot).wait();
+    } catch (...) {
+      shm::quiesce(workers_);
+      throw;
+    }
+  }
   void WaitAll(int num_slots) override {
-    for (int i = 0; i < num_slots && i < num_slots_; ++i) workers_[i].wait();
+    for (int i = 0; i < num_slots && i < num_slots_; ++i) {
+      try {
+        workers_[i].wait();
+      } catch (...) {
+        shm::quiesce(workers_);
+        throw;
+      }
+    }
   }
 
  private:

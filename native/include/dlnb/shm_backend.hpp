@@ -258,6 +258,17 @@ class SlotWorker {
   std::thread thread_;
 };
 
+// Stop every worker, completing any in-flight task first.  Wait paths
+// call this before rethrowing a slot's error so no sibling worker is
+// still touching caller-owned buffers while the error unwinds them (the
+// use-after-free window otherwise opened by one async op failing while
+// others run).  stop() is restartable: later enqueues bring a worker
+// back.
+inline void quiesce(std::vector<SlotWorker>& workers) {
+  for (auto& w : workers) w.stop();
+}
+
+
 }  // namespace shm
 
 class ShmFabric;
@@ -344,9 +355,23 @@ class ShmCommunicator : public ProxyCommunicator {
                              count * dtype_bytes(dtype_));
     });
   }
-  void Wait(int slot) override { worker(slot).wait(); }
+  void Wait(int slot) override {
+    try {
+      worker(slot).wait();
+    } catch (...) {
+      shm::quiesce(workers_);
+      throw;
+    }
+  }
   void WaitAll(int num_slots) override {
-    for (int i = 0; i < num_slots && i < num_slots_; ++i) workers_[i].wait();
+    for (int i = 0; i < num_slots && i < num_slots_; ++i) {
+      try {
+        workers_[i].wait();
+      } catch (...) {
+        shm::quiesce(workers_);
+        throw;
+      }
+    }
   }
 
  private:

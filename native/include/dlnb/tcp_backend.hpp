@@ -90,6 +90,15 @@ inline bool recv_all(int fd, void* data, std::size_t n) {
 
 // Received frames, keyed for matching.  Collectives match on
 // (comm, slot, seq, src); p2p matches on (comm, tag, src) in FIFO order.
+//
+// Failure is tracked PER PEER: a take waiting on rank X fails only when
+// X itself is gone, never because some OTHER rank finished its work and
+// exited cleanly.  (The subtle race this kills: rank A blocks on rank
+// B's barrier frame, still in flight, while rank C — already done —
+// exits; a global error flag would fail A's wait even though B is alive
+// and its frame lands a moment later.  Per-peer tracking is sound
+// because TCP orders a socket's FIN after its data: by the time we see
+// X's EOF, everything X sent US has been pushed.)
 class Inbox {
  public:
   struct Frame {
@@ -103,21 +112,26 @@ class Inbox {
     cv_.notify_all();
   }
 
-  void fail(const std::string& why) {
+  // Mark `peer` dead (EOF or reader error); takes waiting on that peer
+  // fail after draining any frames it already delivered.
+  void fail(int peer, const std::string& why) {
     std::lock_guard<std::mutex> lk(m_);
-    error_ = why;
+    dead_.emplace(peer, why);
     cv_.notify_all();
   }
 
-  // Blocking take of the first frame matching `pred`.  Queued frames are
-  // matched BEFORE the error flag is consulted: a peer's EOF arrives
-  // after everything it sent (TCP FIN orders after data), so an op whose
-  // frames already landed must still complete — only waits that can
-  // never be satisfied fail.  (Without this, a rank finishing its last
-  // collective and exiting promptly would poison slower peers' inboxes
-  // while their final frames sat matched in the queue.)
+  // Blocking take of the first frame matching `pred`, which must only
+  // accept frames from world rank `want_src` (all matching here is
+  // per-source).  Queued frames are matched BEFORE the death flag is
+  // consulted, so an op whose frames already landed still completes.
+  // `also_dep` lists ranks the awaited frame TRANSITIVELY depends on
+  // (a ring step's data has passed through every group member): their
+  // death fails the wait too, even though want_src itself is alive —
+  // otherwise a mid-ring death would hang non-neighbors until the
+  // failure cascaded around the ring via process exits.
   template <typename Pred>
-  Frame take(const Pred& pred) {
+  Frame take(int want_src, const Pred& pred,
+             const std::vector<int>& also_dep = {}) {
     std::unique_lock<std::mutex> lk(m_);
     std::deque<Frame>::iterator it;
     auto find = [&] {
@@ -125,9 +139,22 @@ class Inbox {
         if (pred(it->h)) return true;
       return false;
     };
-    cv_.wait(lk, [&] { return find() || !error_.empty(); });
+    const int* dead_dep = nullptr;
+    auto failed = [&] {
+      if (dead_.count(want_src)) {
+        dead_dep = &want_src;
+        return true;
+      }
+      for (const int& d : also_dep)
+        if (dead_.count(d)) {
+          dead_dep = &d;
+          return true;
+        }
+      return false;
+    };
+    cv_.wait(lk, [&] { return find() || failed(); });
     if (!find())
-      throw std::runtime_error("tcp fabric: " + error_);
+      throw std::runtime_error("tcp fabric: " + dead_.at(*dead_dep));
     Frame f = std::move(*it);
     frames_.erase(it);
     return f;
@@ -137,7 +164,7 @@ class Inbox {
   std::mutex m_;
   std::condition_variable cv_;
   std::deque<Frame> frames_;
-  std::string error_;
+  std::map<int, std::string> dead_;
 };
 
 }  // namespace tcp
@@ -216,15 +243,31 @@ class TcpCommunicator : public ProxyCommunicator {
     int t = tag >= 0 ? tag : 1 + slot;
     enqueue(slot, [=] { Recv(dst, count, src_rank, t); });
   }
-  void Wait(int slot) override { worker(slot).wait(); }
+  void Wait(int slot) override {
+    try {
+      worker(slot).wait();
+    } catch (...) {
+      shm::quiesce(workers_);
+      throw;
+    }
+  }
   void WaitAll(int num_slots) override {
-    for (int i = 0; i < num_slots && i < num_slots_; ++i) workers_[i].wait();
+    for (int i = 0; i < num_slots && i < num_slots_; ++i) {
+      try {
+        workers_[i].wait();
+      } catch (...) {
+        shm::quiesce(workers_);
+        throw;
+      }
+    }
   }
 
  private:
   friend class TcpFabric;
   void collective(int slot, shm::OpKind op, std::int64_t count,
                   const void* src, void* dst);
+  void ring_allreduce(int slot, std::int64_t count, const void* src,
+                      void* dst);
 
   shm::SlotWorker& worker(int slot) {
     if (slot < 0 || slot >= num_slots_)
@@ -264,6 +307,11 @@ class TcpFabric : public Fabric {
         fds_(world_size, -1) {
     if (world_size <= 0 || rank < 0 || rank >= world_size)
       throw std::invalid_argument("tcp fabric: bad world/rank");
+    // NOTE: the override must be set identically on every process — the
+    // algorithm choice is part of the collective's wire protocol
+    if (const char* env = std::getenv("DLNB_TCP_RING_THRESHOLD");
+        env && *env)
+      ring_threshold_bytes_ = static_cast<std::size_t>(std::stoll(env));
     if (world_size > 1) bootstrap(coordinator);
     for (int r = 0; r < world_; ++r)
       if (r != rank_) start_reader(r);
@@ -329,9 +377,24 @@ class TcpFabric : public Fabric {
     meta["device"] = "cpu";
     meta["compute_mode"] = "host_sleep";
     meta["num_processes"] = world_;
+    // allreduces at/above this many bytes ride the bandwidth-optimal
+    // ring (2(n-1)/n x count on the wire); smaller ones and the
+    // gather-style ops use the pairwise full mesh (which for
+    // allgather/reduce-scatter/alltoall already moves the optimal
+    // (n-1)/n x bytes).  analysis/bandwidth.py refuses busbw for
+    // allreduce timers below the threshold — full-mesh allreduce moves
+    // (n-1) x count and is not an algorithm any real fabric runs.
+    meta["tcp_ring_threshold_bytes"] =
+        static_cast<std::int64_t>(ring_threshold_bytes_);
+    // this process's payload+header bytes actually written to sockets —
+    // lets tests pin the algorithm's wire cost without timing flakiness
+    meta["tcp_bytes_sent"] = static_cast<std::int64_t>(
+        bytes_sent_.load(std::memory_order_relaxed));
     mesh["platform"] = "tcp";
     mesh["device_kind"] = "process-rank";
   }
+
+  std::size_t ring_threshold_bytes() const { return ring_threshold_bytes_; }
 
   tcp::Inbox& inbox() { return inbox_; }
 
@@ -353,6 +416,7 @@ class TcpFabric : public Fabric {
     std::lock_guard<std::mutex> lk(send_m_[dst]);
     tcp::send_all(fds_[dst], &h, sizeof h);
     if (h.bytes) tcp::send_all(fds_[dst], payload, h.bytes);
+    bytes_sent_.fetch_add(sizeof h + h.bytes, std::memory_order_relaxed);
   }
 
  private:
@@ -499,10 +563,12 @@ class TcpFabric : public Fabric {
           tcp::FrameHeader h;
           if (!tcp::recv_all(fds_[peer], &h, sizeof h)) {
             // EOF: silent only during our own orderly teardown — a peer
-            // dying mid-run must fail blocked collectives, not hang them
+            // dying mid-run must fail waits on THAT peer (its own sent
+            // frames were delivered before the FIN), without poisoning
+            // waits on still-alive ranks
             if (!closing_.load(std::memory_order_acquire))
-              inbox_.fail("rank " + std::to_string(peer) +
-                          " disconnected mid-run");
+              inbox_.fail(peer, "rank " + std::to_string(peer) +
+                                    " disconnected mid-run");
             return;
           }
           tcp::Inbox::Frame f;
@@ -514,8 +580,8 @@ class TcpFabric : public Fabric {
         }
       } catch (const std::exception& e) {
         if (!closing_.load(std::memory_order_acquire))
-          inbox_.fail(std::string("reader for rank ") + std::to_string(peer) +
-                      ": " + e.what());
+          inbox_.fail(peer, std::string("reader for rank ") +
+                                std::to_string(peer) + ": " + e.what());
       }
     });
   }
@@ -530,6 +596,8 @@ class TcpFabric : public Fabric {
   tcp::Inbox inbox_;
   std::atomic<std::uint32_t> next_comm_id_{0};
   std::atomic<bool> closing_{false};
+  std::size_t ring_threshold_bytes_ = 64 * 1024;
+  std::atomic<std::uint64_t> bytes_sent_{0};
 };
 
 // ---- TcpCommunicator method bodies needing the fabric ----
@@ -551,10 +619,11 @@ inline void TcpCommunicator::Recv(void* dst, std::int64_t count,
   std::uint32_t want_src = static_cast<std::uint32_t>(members_.at(src_rank));
   std::uint32_t want_tag = static_cast<std::uint32_t>(tag);
   std::uint32_t cid = comm_id_;
-  auto f = fab_->inbox().take([&](const tcp::FrameHeader& h) {
-    return h.kind == static_cast<std::uint32_t>(tcp::FrameKind::P2P) &&
-           h.comm_id == cid && h.src == want_src && h.op == want_tag;
-  });
+  auto f = fab_->inbox().take(
+      static_cast<int>(want_src), [&](const tcp::FrameHeader& h) {
+        return h.kind == static_cast<std::uint32_t>(tcp::FrameKind::P2P) &&
+               h.comm_id == cid && h.src == want_src && h.op == want_tag;
+      });
   std::size_t want = static_cast<std::size_t>(count) * dtype_bytes(dtype_);
   if (f.payload.size() != want)
     throw std::runtime_error("tcp p2p size mismatch: got " +
@@ -568,6 +637,15 @@ inline void TcpCommunicator::collective(int slot, shm::OpKind op,
                                         void* dst) {
   const int n = size();
   const std::size_t esz = dtype_bytes(dtype_);
+  // Large allreduces ride the bandwidth-optimal ring: the full mesh
+  // moves (n-1) x count per rank where a ring moves 2(n-1)/n x count —
+  // at n=8 a 4x difference no real fabric's algorithm would show.  The
+  // gather-style ops keep the pairwise mesh (already (n-1)/n-optimal);
+  // small allreduces stay full-mesh (latency-bound: 1 round trip vs the
+  // ring's 2(n-1) serial steps).
+  if (op == shm::OpKind::Allreduce && n > 2 &&
+      static_cast<std::size_t>(count) * esz >= fab_->ring_threshold_bytes())
+    return ring_allreduce(slot, count, src, dst);
   std::uint32_t seq;
   {
     std::lock_guard<std::mutex> lk(seq_m_);
@@ -613,7 +691,7 @@ inline void TcpCommunicator::collective(int slot, shm::OpKind op,
     int peer = members_[g];
     if (peer == wrank_) continue;
     std::uint32_t want_src = static_cast<std::uint32_t>(peer);
-    auto f = fab_->inbox().take([&](const tcp::FrameHeader& fh) {
+    auto f = fab_->inbox().take(peer, [&](const tcp::FrameHeader& fh) {
       return fh.kind == static_cast<std::uint32_t>(tcp::FrameKind::Coll) &&
              fh.comm_id == comm_id_ &&
              fh.slot == static_cast<std::uint32_t>(slot) && fh.seq == seq &&
@@ -677,6 +755,106 @@ inline void TcpCommunicator::collective(int slot, shm::OpKind op,
         std::memcpy(out + static_cast<std::size_t>(g) * blk, buf.data(), blk);
       break;
     }
+  }
+}
+
+// Ring allreduce (the NCCL/ICI algorithm): n-1 reduce-scatter steps —
+// each rank passes a partial-sum block to its successor, accumulating
+// the block it receives — then n-1 allgather steps rotating the
+// completed blocks.  After the first phase rank r owns the fully
+// reduced block (r+1) mod n (the standard rotation).  Each step is one
+// frame to the successor matched by (comm, slot, seq, src); every rank
+// advances the slot's sequence counter by the same 2(n-1), so later
+// collectives on the slot stay aligned.  The per-peer reader threads
+// drain sockets independently of this rank's send, so a blocking
+// send_all can never deadlock against a peer doing the same.
+inline void TcpCommunicator::ring_allreduce(int slot, std::int64_t count,
+                                            const void* src, void* dst) {
+  const int n = size();
+  const std::size_t esz = dtype_bytes(dtype_);
+  const std::int64_t block = (count + n - 1) / n;
+  auto blen = [&](std::int64_t bi) {
+    std::int64_t left = count - bi * block;
+    return left < 0 ? 0 : (left > block ? block : left);
+  };
+  if (dst != src)
+    std::memcpy(dst, src, static_cast<std::size_t>(count) * esz);
+  std::uint32_t base;
+  {
+    std::lock_guard<std::mutex> lk(seq_m_);
+    base = seq_[static_cast<std::size_t>(slot)];
+    seq_[static_cast<std::size_t>(slot)] +=
+        2 * static_cast<std::uint32_t>(n - 1);
+  }
+  const int to = members_[(grank_ + 1) % n];
+  const int from = members_[(grank_ - 1 + n) % n];
+
+  auto send_block = [&](std::int64_t bi, std::uint32_t seq) {
+    tcp::FrameHeader h{};
+    h.kind = static_cast<std::uint32_t>(tcp::FrameKind::Coll);
+    h.comm_id = comm_id_;
+    h.slot = static_cast<std::uint32_t>(slot);
+    h.seq = seq;
+    h.op = static_cast<std::uint32_t>(shm::OpKind::Allreduce);
+    h.src = static_cast<std::uint32_t>(wrank_);
+    h.count = static_cast<std::uint64_t>(count);
+    h.bytes = static_cast<std::uint64_t>(blen(bi)) * esz;
+    fab_->send_frame(to, h,
+                     static_cast<const char*>(dst) +
+                         static_cast<std::size_t>(bi) * block * esz);
+  };
+  // ring data has passed through every member: any member's death must
+  // fail this wait, not just the immediate predecessor's
+  std::vector<int> ring_deps;
+  for (int m : members_)
+    if (m != wrank_ && m != from) ring_deps.push_back(m);
+  auto recv_block = [&](std::uint32_t seq) {
+    auto f = fab_->inbox().take(
+        from,
+        [&](const tcp::FrameHeader& fh) {
+          return fh.kind ==
+                     static_cast<std::uint32_t>(tcp::FrameKind::Coll) &&
+                 fh.comm_id == comm_id_ &&
+                 fh.slot == static_cast<std::uint32_t>(slot) &&
+                 fh.seq == seq &&
+                 fh.src == static_cast<std::uint32_t>(from);
+        },
+        ring_deps);
+    if (static_cast<shm::OpKind>(f.h.op) != shm::OpKind::Allreduce ||
+        static_cast<std::int64_t>(f.h.count) != count)
+      throw std::runtime_error(
+          "tcp ring allreduce mismatch: ranks disagree on op/count "
+          "(is DLNB_TCP_RING_THRESHOLD set identically everywhere?)");
+    return f;
+  };
+
+  for (int step = 0; step < n - 1; ++step) {  // reduce-scatter phase
+    std::int64_t sb = ((grank_ - step) % n + n) % n;
+    std::int64_t rb = ((grank_ - step - 1) % n + n) % n;
+    send_block(sb, base + static_cast<std::uint32_t>(step));
+    auto f = recv_block(base + static_cast<std::uint32_t>(step));
+    char* d = static_cast<char*>(dst) +
+              static_cast<std::size_t>(rb) * block * esz;
+    std::int64_t len = blen(rb);
+    if (f.payload.size() != static_cast<std::size_t>(len) * esz)
+      throw std::runtime_error("tcp ring allreduce: block size mismatch");
+    for (std::int64_t i = 0; i < len; ++i)
+      store_element(d, static_cast<std::size_t>(i), dtype_,
+                    load_element(d, static_cast<std::size_t>(i), dtype_) +
+                        load_element(f.payload.data(),
+                                     static_cast<std::size_t>(i), dtype_));
+  }
+  for (int step = 0; step < n - 1; ++step) {  // allgather phase
+    std::int64_t sb = ((grank_ + 1 - step) % n + n) % n;
+    std::int64_t rb = ((grank_ - step) % n + n) % n;
+    send_block(sb, base + static_cast<std::uint32_t>(n - 1 + step));
+    auto f = recv_block(base + static_cast<std::uint32_t>(n - 1 + step));
+    std::int64_t len = blen(rb);
+    if (f.payload.size() != static_cast<std::size_t>(len) * esz)
+      throw std::runtime_error("tcp ring allreduce: block size mismatch");
+    std::memcpy(static_cast<char*>(dst) +
+                    static_cast<std::size_t>(rb) * block * esz,
+                f.payload.data(), static_cast<std::size_t>(len) * esz);
   }
 }
 

@@ -57,7 +57,8 @@ int main(int argc, char** argv) {
           "allgather", sched.sharding_factor, unit_bytes));
       cm["reduce_scatter"] = comm_timer(comm_component(
           "reduce_scatter", sched.sharding_factor,
-          sched.num_units * unit_bytes));
+          sched.num_units * unit_bytes, /*bound=*/"",
+          /*ops=*/sched.num_units));
       meta["comm_model"] = cm;
     }
 

@@ -102,12 +102,13 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
     cm["pp_comm"] = comm_timer(comm_component(
         "p2p", p.grid.pp,
         2 * M * scale_count(p.pipe_msg_elems, size_scale) * esz,
-        /*bound=*/"lower"));
+        /*bound=*/"lower", /*ops=*/2 * M));
     if (spec.is_moe) {
       cm["ep_comm"] = comm_timer(comm_component(
           "alltoall", spec.ep,
           2 * M * spec.a2a_per_direction *
-              scale_count(spec.a2a_elems, size_scale) * esz));
+              scale_count(spec.a2a_elems, size_scale) * esz,
+          /*bound=*/"", /*ops=*/2 * M * spec.a2a_per_direction));
       cm["dp_ep_comm"] = comm_timer(comm_component(
           "allreduce", spec.ep,
           scale_count(spec.nonexpert_sync, size_scale) * esz));
@@ -121,7 +122,8 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
       if (p.grid.tp > 1)
         cm["tp_comm"] = comm_timer(comm_component(
             "allreduce", p.grid.tp,
-            4 * M * scale_count(p.tp_msg_elems, size_scale) * esz));
+            4 * M * scale_count(p.tp_msg_elems, size_scale) * esz,
+            /*bound=*/"", /*ops=*/4 * M));
     }
     meta["comm_model"] = cm;
   }

@@ -224,7 +224,8 @@ inline std::unique_ptr<Fabric> make_fabric(const ProxyEnv& env) {
 // measure exposure, not transfer time, and would misreport bandwidth.
 inline Json comm_component(const std::string& kind,
                            std::int64_t group, std::int64_t bytes,
-                           const std::string& bound = "") {
+                           const std::string& bound = "",
+                           std::int64_t ops = 1) {
   Json c = Json::object();
   c["kind"] = kind;
   c["group"] = group;
@@ -233,6 +234,10 @@ inline Json comm_component(const std::string& kind,
   // pipeline stages timing recv+send against one direction's bytes);
   // analysis/bandwidth.py surfaces it as a table column
   if (!bound.empty()) c["bound"] = bound;
+  // how many same-size operations the bytes aggregate over — the
+  // per-MESSAGE size (bytes/ops) is what algorithm-selection thresholds
+  // compare against, not the per-iteration total
+  c["ops"] = ops;
   return c;
 }
 

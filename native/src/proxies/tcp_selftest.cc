@@ -93,6 +93,22 @@ int main(int argc, char** argv) {
       comm->RingShift(out.data(), in.data(), 4);
       REQUIRE(in.get(0) == static_cast<float>((rank + world - 1) % world));
     }
+    // ring allreduce: a count crossing the ring threshold (64 KiB) on a
+    // group of >2 exercises the reduce-scatter + allgather rotation,
+    // including the shorter tail block (count not divisible by world)
+    if (world > 2) {
+      const std::int64_t big = 40001;  // 160 KB of f32, odd tail
+      Tensor src(big, DType::F32), dst(big, DType::F32);
+      for (std::int64_t i = 0; i < big; ++i)
+        src.set(static_cast<std::size_t>(i),
+                static_cast<float>(rank + (i % 7)));
+      comm->Allreduce(src.data(), dst.data(), big);
+      for (std::int64_t i : {std::int64_t{0}, big / 2, big - 1}) {
+        float expect = static_cast<float>(
+            world * (world - 1) / 2 + world * (i % 7));
+        REQUIRE(dst.get(static_cast<std::size_t>(i)) == expect);
+      }
+    }
     // comm split: pairs {2k, 2k+1} reduce independently
     if (world % 2 == 0) {
       auto pair = fab.split(rank, rank / 2, "pair");
