@@ -47,6 +47,19 @@ def main() -> int:
                      ff_dim=base.ff_dim, seq_len=SEQ,
                      num_decoder_blocks=LAYERS, vocab_size=VOCAB,
                      gated_mlp=True)
+    # r3 accounting fixes: (1) vs_baseline_causal divides the credited
+    # S^2 score FLOPs by 2 (the flash kernel executes only the causal
+    # half); (2) the LM-head logits matmul is credited (see below) —
+    # r1/r2 spent its time but not its FLOPs.  Both r3 ratio keys
+    # include the LM head; only vs_baseline_decoder_only reproduces the
+    # r1/r2 formula.  r3 perf attempts, measured paired A/B on-chip:
+    # fwd flash block-shape sweep at S=6144 ((1024,2048), (3072,3072),
+    # (2048,1024), (1024,1024), (2048,3072)) — NOT kept, all within the
+    # +-8% chip/tunnel noise of (2048,2048) on 5-round medians; base-2
+    # online softmax (exp2 with log2e folded into the q scale) — KEPT
+    # in flash_attention.py on principle (one fewer VPU multiply per
+    # score element, numerics identical) though it measured neutral
+    # (0.998 median paired ratio).
     # Recipe (measured on v5e, r2): no remat (activations fit at this
     # shape; ~12% over full remat), unrolled layer loop (~5% over scan:
     # no dynamic-slice save/restore of stacked activations), flash
@@ -105,10 +118,26 @@ def main() -> int:
     step_s = statistics.median(samples)
     loss = losses[-1]
 
-    # analytic FLOPs: fwd + ~2x bwd = 3x forward (reference bwd/fwd=2 model)
-    fwd_flops = roofline.model_flops(card, BATCH)
+    # Analytic FLOPs: fwd + ~2x bwd = 3x forward (reference bwd/fwd=2
+    # model).  The forward is the decoder stack (attention + MLP, the
+    # reference's model_flops convention) PLUS the LM-head logits matmul
+    # the step executes (2*B*S*D*V): standard MFU accounting — e.g. the
+    # PaLM appendix-B formula — includes the unembedding projection, and
+    # model_bytes already streams the vocab weights, so crediting the
+    # time but not the FLOPs (as r1/r2 did) understated utilization by
+    # the head's share (~23% at V=32768, S=6144).  The baseline divisor
+    # gets the same flops through the same min(peak, AI*BW) model, so
+    # 1.0 still means "running at this chip's roofline for the work the
+    # step performs".
+    lm_head_flops = 2 * BATCH * SEQ * card.embed_dim * VOCAB
+    fwd_flops = roofline.model_flops(card, BATCH) + lm_head_flops
     total_flops = 3 * fwd_flops
-    roofline_s = 3 * roofline.forward_time_s(card, BATCH, "bfloat16", hw_key)
+    roofline_s = 3 * roofline.roofline_time_s(
+        fwd_flops, roofline.model_bytes(card, BATCH, "bfloat16"),
+        HARDWARE[hw_key], "bfloat16")
+    # old (decoder-only) convention, for cross-round comparability
+    roofline_dec_s = 3 * roofline.forward_time_s(card, BATCH, "bfloat16",
+                                                 hw_key)
     achieved = total_flops / step_s
     vs_baseline = roofline_s / step_s  # 1.0 = running at the roofline
 
@@ -118,6 +147,10 @@ def main() -> int:
     # kernel executes only the lower-triangular half.  vs_baseline_causal
     # divides those credited score FLOPs by 2, so it is the utilization
     # of FLOPs the chip actually ran.
+    # NOTE: from r3 on, vs_baseline_causal also credits the LM head (it
+    # is vs_baseline x executed_ratio on the SAME flop base); r1/r2's
+    # causal figure had no LM-head term, so compare across rounds via
+    # vs_baseline_decoder_only, not this key.
     causal_elided = card.num_layers * 2 * BATCH * SEQ * SEQ * card.embed_dim
     executed_ratio = (fwd_flops - causal_elided) / fwd_flops
     vs_baseline_causal = vs_baseline * executed_ratio
@@ -129,6 +162,9 @@ def main() -> int:
         "unit": "ms",
         "vs_baseline": round(vs_baseline, 4),
         "vs_baseline_causal": round(vs_baseline_causal, 4),
+        # r1/r2's decoder-only accounting (LM-head time spent but its
+        # flops uncredited) — kept so rounds stay comparable
+        "vs_baseline_decoder_only": round(roofline_dec_s / step_s, 4),
         "tflops_achieved": round(achieved / 1e12, 2),
         "tflops_executed": round(achieved * executed_ratio / 1e12, 2),
         "loss": round(float(loss), 4),

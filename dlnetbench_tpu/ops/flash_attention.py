@@ -41,6 +41,12 @@ _LANES = 128                 # TPU lane width; head dim padded to this
 _SUBLANES = 8                # fp32 sublane tile: row vectors (lse, D) are
                              # stored (B, H, 8, S) so blocks are (8, block_q)
 _NEG_INF = -1e30             # finite "-inf": keeps masked rows NaN-free
+_LOG2E = 1.4426950408889634  # the VPU's transcendental unit is exp2; doing
+                             # the online softmax in the base-2 domain folds
+                             # the ln2 conversion into the (free) q scale —
+                             # one fewer multiply per score element.  The
+                             # softmax is algebraically identical and the
+                             # saved lse is converted back to natural log.
 # Default block sizes are direction-specific (measured at S=4096 on v5e,
 # with the parallel dimension_semantics below): the forward kernel gains
 # ~40% from 2048-wide blocks (fewer online-softmax rescale rounds, deeper
@@ -120,7 +126,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(work)
     def _step():
-        q = q_ref[0].astype(_F32) * scale                 # [bq, dh]
+        # base-2 online softmax: scores scaled by scale*log2(e) so the
+        # transcendentals are exp2 (what the VPU natively computes);
+        # softmax ratios are unchanged
+        q = q_ref[0].astype(_F32) * (scale * _LOG2E)      # [bq, dh]
         k = k_ref[0]                                      # [bk, dh]
         s = jax.lax.dot_general(
             q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
@@ -131,8 +140,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_prev = m_ref[:, :1]                             # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)        # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)                   # [bq, 1]
-        p = jnp.exp(s - m_new)                            # [bq, bk]
+        alpha = jnp.exp2(m_prev - m_new)                  # [bq, 1]
+        p = jnp.exp2(s - m_new)                           # [bq, bk]
         l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
@@ -145,7 +154,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _emit():
         l = l_ref[:, :1]
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse = m_ref[:, 0] + jnp.log(l[:, 0])               # [bq]
+        # back to natural log for the backward kernels' exp(s - lse)
+        lse = (m_ref[:, 0] + jnp.log2(l[:, 0])) / _LOG2E   # [bq]
         lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[2:])
 
 
