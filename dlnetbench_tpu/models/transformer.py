@@ -55,6 +55,12 @@ class TransformerConfig:
     logits_f32: bool = True        # emit f32 logits (training-grade CE
                              # numerics); False keeps them bf16 — halves
                              # the [B, S, V] logits traffic for benches
+    mlp_dtype: str = "bfloat16"    # "float8" runs the (dense) MLP matmuls
+                             # in e4m3 with per-tensor dynamic scales and
+                             # bf16 master weights (ops/fp8.py) — 2x MXU
+                             # rate on fp8-capable chips (v5e 394 TF/s,
+                             # core/hardware.py); backward stays in the
+                             # master dtype (straight-through)
     moe_impl: str = "dense"        # "dense" (every expert computes every
                              # selected token — exact, E/k x the FLOPs) or
                              # "sparse" (capacity-based dispatch, GShard
@@ -69,6 +75,14 @@ class TransformerConfig:
         if self.moe_impl not in ("dense", "sparse"):
             raise ValueError(f"unknown moe_impl {self.moe_impl!r}; "
                              f"expected 'dense' or 'sparse'")
+        if self.mlp_dtype not in ("bfloat16", "float8"):
+            raise ValueError(f"unknown mlp_dtype {self.mlp_dtype!r}; "
+                             f"expected 'bfloat16' or 'float8'")
+        if self.mlp_dtype == "float8" and (self.num_experts > 1
+                                           or not self.gated):
+            raise ValueError(
+                "mlp_dtype='float8' currently covers the dense SwiGLU "
+                "path only")
 
     @classmethod
     def from_card(cls, card: ModelCard, *, seq_len: int | None = None,
@@ -186,6 +200,9 @@ def _block(cfg: TransformerConfig, x, lp, positions):
             y2 = moe(y.reshape(b * s, d), lp["w_router"],
                      lp["w_gate"], lp["w_up"], lp["w_down"],
                      cfg.top_k).reshape(b, s, d)
+        elif cfg.mlp_dtype == "float8":
+            from dlnetbench_tpu.ops.fp8 import swiglu_fp8
+            y2 = swiglu_fp8(y, lp["w_gate"], lp["w_up"], lp["w_down"])
         else:
             y2 = L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
     else:

@@ -1,0 +1,80 @@
+"""float8 (e4m3) matmul with per-tensor dynamic scales — the fp8 MLP
+compute path.
+
+The stat files model a ``float8`` dtype and v5e-class chips run fp8 at
+2x the bf16 MXU rate (core/hardware.py peak tables; the reference's
+compile-time ``PROXY_FLOAT8`` buffer selection, data_types.hpp:36-79,
+covers only communication buffers — it has no fp8 COMPUTE path at all).
+This module supplies the compute path TPU-style:
+
+  * bf16 master weights and activations; each operand is scaled by
+    max-abs / 448 (the e4m3 finite max) per tensor, cast to
+    ``float8_e4m3fn``, multiplied with f32 accumulation on the MXU, and
+    the product of the two scales is applied to the result.
+  * the backward pass is straight-through: quantization is treated as
+    identity and the gradient matmuls run in the master dtype (the
+    standard transformer-engine-style recipe for fp8 forward without
+    fp8 gradient plumbing).
+
+``fp8_dot`` is jit/vmap-compatible (shapes static, scales dynamic) and
+runs everywhere jax does — on chips without native fp8 the MXU upcasts,
+so the path is correct (and unit-testable on CPU) but not faster.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_F32 = jnp.float32
+_E4M3_MAX = 448.0      # float8_e4m3fn finite max
+
+
+def _quantize(x):
+    """Per-tensor dynamic scaling to e4m3: returns (x_q, scale) with
+    x ~= x_q * scale.  The scale is clamped away from zero so an
+    all-zero tensor stays representable."""
+    amax = jnp.max(jnp.abs(x.astype(_F32)))
+    scale = jnp.maximum(amax, 1e-12) / _E4M3_MAX
+    xq = (x.astype(_F32) / scale).astype(jnp.float8_e4m3fn)
+    return xq, scale
+
+
+@jax.custom_vjp
+def fp8_dot(x, w):
+    """[..., K] x [K, N] -> [..., N]: e4m3 operands, f32 accumulation,
+    result in x.dtype.  Backward is straight-through in the master
+    dtype."""
+    out, _ = _fp8_dot_fwd(x, w)
+    return out
+
+
+def _fp8_dot_fwd(x, w):
+    xq, sx = _quantize(x)
+    wq, sw = _quantize(w)
+    out = jnp.dot(xq, wq, preferred_element_type=_F32) * (sx * sw)
+    return out.astype(x.dtype), (x, w)
+
+
+def _fp8_dot_bwd(res, g):
+    x, w = res
+    gf = g.astype(_F32)
+    dx = jnp.dot(gf, w.astype(_F32).T).astype(x.dtype)
+    # contract all leading (batch) axes of x against g: dw [K, N]
+    lead = tuple(range(x.ndim - 1))
+    dw = jax.lax.dot_general(
+        x.astype(_F32), gf, ((lead, lead), ((), ()))).astype(w.dtype)
+    return dx, dw
+
+
+fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+def swiglu_fp8(x, w_gate, w_up, w_down):
+    """SwiGLU with all three matmuls in e4m3 (layers.swiglu's fp8
+    sibling — same bf16-rounding discipline for saved residuals)."""
+    g = fp8_dot(x, w_gate)      # already x.dtype (fp8_dot's contract)
+    u = fp8_dot(x, w_up)
+    h = (jax.nn.silu(g.astype(_F32)) * u.astype(_F32)).astype(g.dtype)
+    return fp8_dot(h, w_down)
