@@ -168,8 +168,11 @@ def main(argv: list[str] | None = None) -> int:
     if getattr(args, "max_layers", 0) < 0:
         parser.error("--max_layers must be >= 0")
 
-    # validate tags before any expensive backend/bundle work
-    variables = {}
+    # validate tags before any expensive backend/bundle work; scheduler
+    # identity (SLURM/JobSet/multislice env, DLNB_TAG_*) is collected
+    # automatically and explicit --tag flags override it
+    from dlnetbench_tpu.metrics.emit import scheduler_variables
+    variables = scheduler_variables()
     for tag in args.tag:
         key, sep, value = tag.partition("=")
         if not sep or not key:

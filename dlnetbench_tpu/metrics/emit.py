@@ -28,6 +28,31 @@ from dlnetbench_tpu.proxies.base import ProxyResult
 SCHEMA_VERSION = 1
 
 
+def scheduler_variables(environ=None) -> dict:
+    """Job variables auto-collected from the launching scheduler's
+    environment — the external-launcher hook.  The reference leans on
+    sbatchman job.variables for its sweep grids (reference
+    plots/parser.py:4,221-237); TPU fleets launch via SLURM, GKE JobSet
+    or multislice runtimes instead, so any of their identity variables
+    present in the environment are stamped into the record's
+    ``variables`` (hoisted to DataFrame columns by metrics.parser), and
+    ``DLNB_TAG_<name>=<value>`` tags arbitrary sweep axes from ANY
+    launcher without touching the command line.  Explicit ``--tag``
+    flags override same-named entries."""
+    import os
+    env = os.environ if environ is None else environ
+    out = {}
+    for k, v in env.items():
+        if k.startswith("DLNB_TAG_") and v:
+            out[k[len("DLNB_TAG_"):].lower()] = v
+    for k in ("SLURM_JOB_ID", "SLURM_PROCID", "SLURM_NNODES",
+              "JOB_COMPLETION_INDEX",      # k8s indexed Job / JobSet
+              "TPU_WORKER_ID", "MEGASCALE_SLICE_ID"):
+        if env.get(k):
+            out[k.lower()] = env[k]
+    return out
+
+
 def _process_identity() -> tuple[int, int]:
     """(this process's index, process count) — the multi-controller
     coordinates a multi-host merge keys on; (0, 1) without a runtime."""

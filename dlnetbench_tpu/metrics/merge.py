@@ -35,9 +35,22 @@ from dlnetbench_tpu.metrics.parser import load_records, validate_record
 _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      "cache_hits", "cache_misses", "tcp_bytes_sent"}
 
+# scheduler-stamped variables that identify the PROCESS, not the run
+# (metrics.emit.scheduler_variables): they legitimately differ between
+# the per-host records of one run and must not abort the merge, while
+# genuine sweep-axis variables still must match
+_PER_PROCESS_VARIABLES = {"slurm_procid", "tpu_worker_id",
+                          "job_completion_index", "megascale_slice_id"}
+
 
 def _comparable_global(g: dict) -> dict:
-    return {k: v for k, v in g.items() if k not in _VOLATILE_GLOBALS}
+    out = {k: v for k, v in g.items() if k not in _VOLATILE_GLOBALS}
+    if isinstance(out.get("variables"), dict):
+        out["variables"] = {k: v for k, v in out["variables"].items()
+                            if k not in _PER_PROCESS_VARIABLES}
+        if not out["variables"]:
+            del out["variables"]
+    return out
 
 
 def merge_records(records: list[dict]) -> dict:

@@ -13,6 +13,7 @@
 // the same.
 #pragma once
 
+#include <cctype>
 #include <fstream>
 #include <functional>
 #include <set>
@@ -297,6 +298,36 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
   meta["model"] = env.model_name;
   meta["world_size"] = env.world;
   meta["dtype"] = dtype_name(env.dtype);
+  // external-launcher job variables (the reference's sbatchman
+  // job.variables role, plots/parser.py:221-237): scheduler identity
+  // env + DLNB_TAG_<name>=<value> sweep axes, mirrored from the Python
+  // tier's metrics.emit.scheduler_variables so both tiers' records
+  // carry the same columns
+  {
+    Json vars = Json::object();
+    for (char** e = ::environ; e && *e; ++e) {  // unistd.h via harness.hpp
+      std::string kv(*e);
+      auto eq = kv.find('=');
+      if (eq == std::string::npos) continue;
+      std::string k = kv.substr(0, eq), v = kv.substr(eq + 1);
+      if (v.empty()) continue;
+      if (k.rfind("DLNB_TAG_", 0) == 0) {
+        std::string name = k.substr(9);
+        for (char& c : name) c = static_cast<char>(::tolower(c));
+        vars[name] = v;
+      }
+    }
+    for (const char* k : {"SLURM_JOB_ID", "SLURM_PROCID", "SLURM_NNODES",
+                          "JOB_COMPLETION_INDEX", "TPU_WORKER_ID",
+                          "MEGASCALE_SLICE_ID"}) {
+      if (const char* v = std::getenv(k); v && *v) {
+        std::string name(k);
+        for (char& c : name) c = static_cast<char>(::tolower(c));
+        vars[name] = std::string(v);
+      }
+    }
+    if (!vars.fields().empty()) meta["variables"] = vars;
+  }
   if (meter.available()) {
     // which sensor produced energy_consumed — misattribution must be
     // visible in the record, not silent (energy.py run_proxy parity)

@@ -723,3 +723,18 @@ def test_native_tcp_ring_peer_death_detected(native_bin, tmp_path):
         assert procs[r].returncode != 0, \
             f"rank {r} exited 0 after mid-ring peer death:\n{out}"
         assert "disconnected mid-run" in out or "peer gone" in out, out
+
+
+def test_native_scheduler_variables_in_record(native_bin):
+    """The native tier stamps the same launcher variables as the Python
+    tier (metrics.emit.scheduler_variables parity)."""
+    rec = run_proxy(native_bin, "dp", "--num_buckets", 2, world=2,
+                    env={"DLNB_TAG_protocol": "ring",
+                         "SLURM_JOB_ID": "1234"})
+    v = rec["global"]["variables"]
+    assert v["protocol"] == "ring"
+    assert v["slurm_job_id"] == "1234"
+    # parser hoists them to DataFrame columns
+    from dlnetbench_tpu.metrics.parser import records_to_dataframe
+    df = records_to_dataframe([rec])
+    assert (df["protocol"] == "ring").all()
