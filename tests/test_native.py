@@ -467,9 +467,9 @@ _HOST_EXEC = {"DLNB_PJRT_EXECUTOR": "host"}
 
 
 def _spawn_hier(native_bin, name, port, rank, *extra, world=4, procs=2,
-                out=None):
+                out=None, model="gpt2_l_16_bfloat16"):
     import os
-    cmd = [str(native_bin / name), "--model", "gpt2_l_16_bfloat16",
+    cmd = [str(native_bin / name), "--model", model,
            "--world", str(world), "--backend", "pjrt",
            "--procs", str(procs), "--rank", str(rank),
            "--coordinator", f"127.0.0.1:{port}",
@@ -518,25 +518,40 @@ def test_native_hier_selftest(native_bin):
         assert f"hier_selftest process {r} OK" in out
 
 
-@pytest.mark.parametrize("name,extra", [
-    ("dp", ("--num_buckets", 2)),
-    ("fsdp", ("--num_units", 3, "--sharding_factor", 2)),
+@pytest.mark.parametrize("name,extra,world,model", [
+    ("dp", ("--num_buckets", 2), 4, "gpt2_l_16_bfloat16"),
+    ("fsdp", ("--num_units", 3, "--sharding_factor", 2), 4,
+     "gpt2_l_16_bfloat16"),
+    # pipeline: the stage-1 -> stage-2 hop crosses the process boundary,
+    # exercising Hier's cross-process p2p (TCP frames with encoded
+    # endpoint tags)
+    ("hybrid_2d", ("--num_stages", 4, "--num_microbatches", 4), 4,
+     "gpt2_l_16_bfloat16"),
+    # MoE ZB: spanning splits + Alltoall's gather-based DCN leg + the
+    # zero-bubble schedule's p2p pattern, 2 procs x 4 local ranks
+    ("hybrid_3d_moe",
+     ("--num_stages", 2, "--num_microbatches", 2,
+      "--num_expert_shards", 2, "--schedule", "zb"), 8,
+     "mixtral_8x7b_16_bfloat16"),
 ])
-def test_native_proxy_over_hier_and_merge(native_bin, tmp_path, name, extra):
-    """dp and fsdp across 2 processes × 2 local ranks on the hier fabric:
-    local collectives on each process's executor, DCN combine over TCP,
+def test_native_proxy_over_hier_and_merge(native_bin, tmp_path, name, extra,
+                                          world, model):
+    """Proxies across 2 OS processes on the hier fabric: local
+    collectives on each process's executor, DCN combine over TCP,
     records merged by metrics.merge with the hierarchy described.
-    fsdp's allreduce_comm groups ({0,2},{1,3}) stride the process
-    boundary, so the spanning-split slotted path is exercised too."""
+    fsdp's allreduce_comm groups stride the process boundary, so the
+    spanning-split slotted path is exercised too."""
     from dlnetbench_tpu.metrics.merge import merge_files
     from dlnetbench_tpu.metrics.parser import records_to_dataframe, \
         validate_record
 
     port = _free_port()
+    local = world // 2
     outs = [tmp_path / f"p{r}.jsonl" for r in range(2)]
-    procs = [_spawn_hier(native_bin, name, port, r, *extra, out=outs[r])
+    procs = [_spawn_hier(native_bin, name, port, r, *extra, world=world,
+                         out=outs[r], model=model)
              for r in range(2)]
-    texts = [p.communicate(timeout=120)[0] for p in procs]
+    texts = [p.communicate(timeout=180)[0] for p in procs]
     for r, (p, txt) in enumerate(zip(procs, texts)):
         assert p.returncode == 0, f"process {r} failed:\n{txt}"
 
@@ -546,19 +561,21 @@ def test_native_proxy_over_hier_and_merge(native_bin, tmp_path, name, extra):
         g = rec["global"]
         assert g["backend"] == "pjrt"
         assert g["num_processes"] == 2
-        assert g["local_world"] == 2
+        assert g["local_world"] == local
         assert g["dcn_transport"] == "tcp"
         assert g["p2p_transport"] == "host+tcp"
         assert g["pjrt_executor"] == "host"
-        # each process emits only its own two global ranks
-        assert [row["rank"] for row in rec["ranks"]] == [2 * r, 2 * r + 1]
+        # each process emits only its own local ranks
+        assert [row["rank"] for row in rec["ranks"]] == \
+            list(range(r * local, (r + 1) * local))
 
     merged = merge_files(tmp_path / "merged.jsonl", outs)
     validate_record(merged)
-    assert [row["rank"] for row in merged["ranks"]] == [0, 1, 2, 3]
-    assert [row["process_index"] for row in merged["ranks"]] == [0, 0, 1, 1]
+    assert [row["rank"] for row in merged["ranks"]] == list(range(world))
+    assert [row["process_index"] for row in merged["ranks"]] == \
+        [r // local for r in range(world)]
     df = records_to_dataframe([merged])
-    assert len(df) == 4 * merged["num_runs"]
+    assert len(df) == world * merged["num_runs"]
     assert (df["runtime"] > 0).all()
 
 
