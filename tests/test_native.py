@@ -533,6 +533,10 @@ def test_native_hier_selftest(native_bin):
      ("--num_stages", 2, "--num_microbatches", 2,
       "--num_expert_shards", 2, "--schedule", "zb"), 8,
      "mixtral_8x7b_16_bfloat16"),
+    # ring attention: RingShift's KV rotation crosses the process
+    # boundary via the gather-based DCN leg
+    ("ring_attention", ("--sp", 4, "--max_layers", 2), 4,
+     "llama3_8b_16_bfloat16"),
 ])
 def test_native_proxy_over_hier_and_merge(native_bin, tmp_path, name, extra,
                                           world, model):
@@ -755,3 +759,37 @@ def test_native_scheduler_variables_in_record(native_bin):
     from dlnetbench_tpu.metrics.parser import records_to_dataframe
     df = records_to_dataframe([rec])
     assert (df["protocol"] == "ring").all()
+
+
+def test_native_hier_peer_death_detected(native_bin):
+    """Failure detection on the hierarchical fabric: when one OS process
+    of a --procs run dies mid-run, the survivor must fail fast with a
+    diagnostic (the TCP layer's per-peer death tracking propagating
+    through the DCN combine), not hang."""
+    import os
+    import time
+
+    port = _free_port()
+
+    def spawn(r):
+        return subprocess.Popen(
+            [str(native_bin / "dp"), "--model", "gpt2_l_16_bfloat16",
+             "--world", "4", "--backend", "pjrt", "--procs", "2",
+             "--rank", str(r), "--coordinator", f"127.0.0.1:{port}",
+             "--num_buckets", "2", "--time_scale", "0.2",
+             "--size_scale", "0.0001", "--runs", "500", "--warmup", "1",
+             "--no_topology", "--base_path", str(REPO)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**os.environ, **_HOST_EXEC})
+
+    survivor, victim = spawn(0), spawn(1)
+    try:
+        time.sleep(3.0)
+        victim.kill()
+        victim.communicate()
+        out = survivor.communicate(timeout=60)[0]
+    finally:
+        survivor.kill()
+    assert survivor.returncode != 0, \
+        f"survivor exited 0 after peer death:\n{out}"
+    assert "disconnected mid-run" in out or "peer gone" in out, out
