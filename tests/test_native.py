@@ -793,3 +793,51 @@ def test_native_hier_peer_death_detected(native_bin):
     assert survivor.returncode != 0, \
         f"survivor exited 0 after peer death:\n{out}"
     assert "disconnected mid-run" in out or "peer gone" in out, out
+
+
+# ---------------------------------------------------------------------
+# Race detection (SURVEY.md §5.2: the reference ships no sanitizer
+# configs at all).  The rank fabrics are thread-heavy — slot workers,
+# reader threads, rendezvous — so the repo carries a dedicated TSan
+# preset alongside the ASan/UBSan debug preset, and this (slow) test
+# builds it and runs the unit suites plus the cross-process selftest
+# under it.
+
+@pytest.mark.slow
+def test_native_tsan_fabrics(tmp_path):
+    build = NATIVE / "build-tsan"
+    subprocess.run(["cmake", "--preset", "tsan", "-S", str(NATIVE)],
+                   check=True, capture_output=True)
+    subprocess.run(["ninja", "-C", str(build), "test_comm", "test_pjrt",
+                    "tcp_selftest"], check=True, capture_output=True)
+    for t in ("test_comm", "test_pjrt"):
+        out = subprocess.run([str(build / t)], capture_output=True,
+                             text=True, timeout=600)
+        assert out.returncode == 0, f"{t} under tsan:\n{out.stdout[-2000:]}"
+        assert "ThreadSanitizer" not in out.stdout + out.stderr
+    # same port-TOCTOU retry + orphan-reaping discipline as
+    # test_native_tcp_selftest (its comment explains the race)
+    for attempt in range(3):
+        port = _free_port()
+        procs = [subprocess.Popen(
+            [str(build / "bin" / "tcp_selftest"), "--world", "4",
+             "--rank", str(r), "--coordinator", f"127.0.0.1:{port}"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            for r in range(4)]
+        outs, timed_out = [], False
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=300)[0])
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                p.kill()
+                outs.append(p.communicate()[0])
+        if all(p.returncode == 0 for p in procs):
+            break
+        port_stolen = (timed_out
+                       or any("tcp: bind failed (port" in o for o in outs))
+        if not port_stolen or attempt == 2:
+            break
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} under tsan:\n{out}"
+        assert "ThreadSanitizer" not in out, out
