@@ -1,0 +1,17 @@
+"""Small networking helpers shared by the multi-process studies."""
+from __future__ import annotations
+
+import socket
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port on loopback.
+
+    Subject to the usual TOCTOU race (another process can bind it before
+    the caller does) — users launching coordinators on it must treat a
+    bind failure as retryable, the discipline the tcp-fabric tests
+    document.
+    """
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

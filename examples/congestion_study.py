@@ -25,7 +25,6 @@ import argparse
 import json
 import os
 import signal
-import socket
 import subprocess
 import sys
 import time
@@ -33,15 +32,10 @@ from pathlib import Path
 
 # runnable from a clone without installation
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from dlnetbench_tpu.utils.net import free_port  # noqa: E402
 
 REPO = Path(__file__).resolve().parent.parent
 BIN = REPO / "native" / "build" / "bin"
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def launch_pair(binary: str, extra: list[str], outs: list[Path] | None,

@@ -36,6 +36,7 @@ from pathlib import Path
 
 # runnable from a clone without installation
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from dlnetbench_tpu.utils.net import free_port  # noqa: E402
 
 DENSE = "llama3_70b_16_bfloat16"
 MOE = "mixtral_8x7b_16_bfloat16"
@@ -113,9 +114,9 @@ def run_plan(plan, args, records: Path) -> int:
         desc = " ".join(f"{k}={v}" for k, v in flags.items())
         flags = dict(flags)
         if args.tier == "native":
-            # same study on the C++ tier: per-proxy binary, threaded shm
-            # fabric, explicit --world (the python tier infers it from
-            # the device mesh; the dp scaling axis "d" IS the world)
+            # same study on the C++ tier: per-proxy binary, explicit
+            # --world (the python tier infers it from the device mesh;
+            # the dp scaling axis "d" IS the world)
             world = flags.pop("d", args.devices)
             argv = [str(native_bin / proxy),
                     "--model", flags.pop("model"),
@@ -133,11 +134,67 @@ def run_plan(plan, args, records: Path) -> int:
         for k, v in flags.items():
             argv += [f"--{k}", str(v)]
         print(f"[{i + 1}/{len(plan)}] {proxy} {desc}", flush=True)
-        proc = subprocess.run(argv, env=env, stdout=subprocess.DEVNULL)
-        if proc.returncode != 0:
-            print(f"  FAILED rc={proc.returncode}", file=sys.stderr)
+        if args.tier == "native" and args.backend == "pjrt-hier":
+            rc = _run_hier_point(argv, world, records, env)
+        else:
+            rc = subprocess.run(argv, env=env,
+                                stdout=subprocess.DEVNULL).returncode
+        if rc != 0:
+            print(f"  FAILED rc={rc}", file=sys.stderr)
             failed += 1
     return failed
+
+
+def _run_hier_point(argv: list[str], world, records: Path, env) -> int:
+    """One study point over the hierarchical ICI x DCN fabric: two OS
+    processes, each driving its own executor (libtpu when usable, host
+    otherwise) over half the ranks, combined over the TCP mesh; their
+    per-process records are merged into the study's record stream (the
+    reference's multi-node operating mode, dp.cpp:166-189).  Returns a
+    nonzero code for ANY per-point failure (signal death, timeout, bad
+    records) so run_plan's per-point FAILED accounting sees it."""
+    if int(world) % 2 != 0:
+        print(f"  skipped (world {world} not divisible by 2 processes)",
+              file=sys.stderr)
+        return 0
+    # strip the single-record --out; each process writes its own file
+    base = [a for j, a in enumerate(argv)
+            if argv[j - 1] != "--out" and a != "--out"]
+    parts = [records.parent / f".hier_p{r}.jsonl" for r in range(2)]
+    # the freshly-probed port can be stolen before rank 0 binds it
+    # (TOCTOU) — retry on a fresh port, same discipline as the tcp
+    # fabric tests
+    for attempt in range(3):
+        for p in parts:
+            p.unlink(missing_ok=True)
+        port = free_port()
+        procs = [subprocess.Popen(
+            base + ["--backend", "pjrt", "--procs", "2", "--rank", str(r),
+                    "--coordinator", f"127.0.0.1:{port}", "--out",
+                    str(parts[r])],
+            env=env, stdout=subprocess.DEVNULL) for r in range(2)]
+        rcs = []
+        for p in procs:
+            try:
+                rcs.append(p.wait(timeout=900))
+            except subprocess.TimeoutExpired:
+                rcs.append(124)
+        if any(rcs):  # reap the sibling before retrying or reporting
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        if all(rc == 0 for rc in rcs):
+            break
+        if attempt == 2:
+            return next((abs(rc) for rc in rcs if rc != 0), 1)
+    from dlnetbench_tpu.metrics.merge import merge_files
+    try:
+        merge_files(records, parts)
+    except ValueError as e:
+        print(f"  merge failed: {e}", file=sys.stderr)
+        return 1
+    return 0
 
 
 def report(args, records: Path) -> None:
@@ -234,6 +291,12 @@ def main() -> int:
     ap.add_argument("--tier", default="jax", choices=("jax", "native"),
                     help="jax = python CLI over the device mesh; native = "
                          "the C++17 binaries (threaded shm fabric)")
+    ap.add_argument("--backend", default="shm",
+                    choices=("shm", "pjrt-hier"),
+                    help="native tier fabric: shm (threaded, one process) "
+                         "or pjrt-hier (2 OS processes, per-process "
+                         "executor + TCP DCN combine — the multi-host "
+                         "device path; records merged per point)")
     ap.add_argument("--models", default=f"{DENSE},{MOE}",
                     help="comma-separated stats-file names")
     ap.add_argument("--runs", type=int, default=3)
@@ -247,6 +310,9 @@ def main() -> int:
                     help="skip the sweep; re-analyze an existing "
                          "records.jsonl in --out_dir")
     args = ap.parse_args()
+    if args.backend == "pjrt-hier" and args.tier != "native":
+        ap.error("--backend pjrt-hier applies to --tier native (the jax "
+                 "tier composes ICI x DCN through jax.distributed instead)")
     if args.tier == "native" and args.platform != "cpu":
         ap.error("--tier native runs the C++ binaries on the threaded shm "
                  "fabric (host CPU); --platform tpu applies only to the "

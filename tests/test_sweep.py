@@ -155,3 +155,29 @@ def test_example_study_end_to_end(tmp_path):
     for png in ("runtime_by_bucket", "barrier_by_bucket", "pareto"):
         assert (tmp_path / f"{png}.png").stat().st_size > 0
     assert "mean per bucket count" in proc.stdout
+
+
+@pytest.mark.slow
+def test_pod_study_native_hier_backend(tmp_path):
+    """The north-star study over the multi-host device path: every point
+    runs as 2 OS processes (per-process executor + TCP DCN combine) and
+    the per-process records merge into the study stream."""
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, "examples/pod_study.py",
+         "--out_dir", str(tmp_path), "--tier", "native",
+         "--backend", "pjrt-hier", "--devices", "4", "--runs", "1",
+         "--models", "mixtral_8x7b_16_bfloat16"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "DLNB_PJRT_EXECUTOR": "host"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "effective bandwidth per collective" in proc.stdout
+    assert (tmp_path / "bandwidth_summary.csv").stat().st_size > 0
+    # merged records carry the hierarchy identity
+    from dlnetbench_tpu.metrics.parser import load_records
+    recs = load_records(tmp_path / "records.jsonl")
+    assert recs, "no merged records written"
+    for rec in recs:
+        assert rec["global"]["dcn_transport"] == "tcp"
+        assert rec["global"]["num_processes"] == 2
