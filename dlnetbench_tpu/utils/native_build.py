@@ -1,0 +1,75 @@
+"""Locate (building on demand) the native C++ tier's binaries.
+
+Build trees live under the system temp directory, NOT under ``native/``:
+however many test or study runs happen, the repo tree carries no
+generated CMake/Ninja state.  A hand-made in-tree ``native/build`` (the
+conventional location documented in README/CMakePresets) is still
+honoured first, so interactive users keep the usual workflow.
+
+Plays the role the reference's ``Makefile.common`` build convention
+plays for its proxy binaries (reference Makefile.common:96-109), with
+the build rooted out-of-tree instead of beside the sources.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+
+def build_root(repo: Path | str, flavor: str = "release") -> Path:
+    """Per-repo, per-flavor, per-user out-of-tree build dir under $TMPDIR."""
+    tag = hashlib.sha256(str(Path(repo).resolve()).encode()).hexdigest()[:12]
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return Path(tempfile.gettempdir()) / f"dlnb-native-{flavor}-u{uid}-{tag}"
+
+
+def _claim(root: Path) -> None:
+    """Create (0700) and ownership-check the build dir right before use.
+
+    /tmp is world-writable and the name is predictable, so another
+    local user could pre-create it with a crafted build.ninja that
+    ``ninja -C`` would then execute; checking at mkdir time (not at
+    path-computation time) closes the window.
+    """
+    root.mkdir(mode=0o700, exist_ok=True)
+    if hasattr(os, "getuid") and root.stat().st_uid != os.getuid():
+        raise RuntimeError(
+            f"{root} exists but is not owned by uid {os.getuid()}")
+
+
+def _run(cmd: list[str], what: str) -> None:
+    out = subprocess.run(cmd, capture_output=True, text=True)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"{what} failed (rc={out.returncode}):\n"
+            f"{out.stdout[-2000:]}\n{out.stderr[-4000:]}")
+
+
+def native_bin(repo: Path | str, build: bool = True) -> Path:
+    """Path to the ``bin/`` directory holding the proxy binaries.
+
+    Prefers an existing in-tree ``native/build`` (manual builds, any
+    generator — rebuilt incrementally via ``cmake --build``);
+    otherwise configures+builds the Release tree out-of-tree with
+    Ninja.  With ``build=False`` just returns where the binaries would
+    live without building anything.
+    """
+    repo = Path(repo)
+    native = repo / "native"
+    in_tree = native / "build"
+    if (in_tree / "CMakeCache.txt").exists():
+        if build:
+            _run(["cmake", "--build", str(in_tree)], "cmake --build (in-tree)")
+        return in_tree / "bin"
+    out = build_root(repo)
+    if not build:
+        return out / "bin"
+    _claim(out)
+    if not (out / "build.ninja").exists():
+        _run(["cmake", "-S", str(native), "-B", str(out), "-G", "Ninja"],
+             "cmake configure")
+    _run(["ninja", "-C", str(out)], "ninja")
+    return out / "bin"

@@ -35,7 +35,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from dlnetbench_tpu.utils.net import free_port  # noqa: E402
 
 REPO = Path(__file__).resolve().parent.parent
-BIN = REPO / "native" / "build" / "bin"
+from dlnetbench_tpu.utils.native_build import native_bin as _locate  # noqa: E402
+BIN = _locate(REPO, build=False)  # resolved for real (with build) in main()
 
 
 def launch_pair(binary: str, extra: list[str], outs: list[Path] | None,
@@ -117,11 +118,13 @@ def main() -> int:
                          "not latency, dominates the allreduce")
     args = ap.parse_args()
 
-    if not (BIN / "dp_loop").exists():
-        raise SystemExit(
-            f"needs the built native binaries in {BIN} "
-            f"(cmake -S native -B native/build -G Ninja && "
-            f"ninja -C native/build)")
+    global BIN
+    # always (re)build: incremental ninja is a no-op when current, and
+    # a silently stale cached binary would poison the study
+    try:
+        BIN = _locate(REPO)
+    except Exception as e:
+        raise SystemExit(f"could not build the native binaries: {e}")
     args.out_dir.mkdir(parents=True, exist_ok=True)
 
     solo = measure("solo", args.out_dir, args)

@@ -102,12 +102,16 @@ def run_plan(plan, args, records: Path) -> int:
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (repo, env.get("PYTHONPATH")) if p)
 
-    native_bin = Path(repo) / "native" / "build" / "bin"
-    if args.tier == "native" and not (native_bin / "dp").exists():
-        raise SystemExit(
-            f"--tier native needs the built binaries in {native_bin} "
-            f"(cmake -S native -B native/build -G Ninja && "
-            f"ninja -C native/build)")
+    from dlnetbench_tpu.utils.native_build import native_bin as _locate
+    if args.tier == "native":
+        # always (re)build: incremental ninja is a no-op when current,
+        # and a silently stale cached binary would poison the study
+        try:
+            native_bin = _locate(repo)
+        except Exception as e:
+            raise SystemExit(f"--tier native could not build: {e}")
+    else:
+        native_bin = _locate(repo, build=False)
 
     failed = 0
     for i, (proxy, flags) in enumerate(plan):

@@ -29,14 +29,9 @@ pytestmark = pytest.mark.skipif(
 
 
 @pytest.fixture(scope="session")
-def native_bin(tmp_path_factory):
-    build = NATIVE / "build"
-    if not (build / "bin" / "dp").exists():
-        subprocess.run(["cmake", "-S", str(NATIVE), "-B", str(build),
-                        "-G", "Ninja"], check=True, capture_output=True)
-    subprocess.run(["ninja", "-C", str(build)], check=True,
-                   capture_output=True)
-    return build / "bin"
+def native_bin():
+    from dlnetbench_tpu.utils.native_build import native_bin as _locate
+    return _locate(REPO)
 
 
 def run_proxy(native_bin, name, *extra, model="gpt2_l_16_bfloat16", world=4,
@@ -805,8 +800,12 @@ def test_native_hier_peer_death_detected(native_bin):
 
 @pytest.mark.slow
 def test_native_tsan_fabrics(tmp_path):
-    build = NATIVE / "build-tsan"
-    subprocess.run(["cmake", "--preset", "tsan", "-S", str(NATIVE)],
+    from dlnetbench_tpu.utils.native_build import build_root
+    build = build_root(REPO, "tsan")
+    # --preset keeps the committed TSan flags authoritative; -B only
+    # relocates the tree out of the repo (CMake: CLI overrides preset).
+    subprocess.run(["cmake", "--preset", "tsan", "-S", str(NATIVE),
+                    "-B", str(build)],
                    check=True, capture_output=True)
     subprocess.run(["ninja", "-C", str(build), "test_comm", "test_pjrt",
                     "tcp_selftest"], check=True, capture_output=True)

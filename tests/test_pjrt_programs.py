@@ -21,7 +21,6 @@ import numpy as np
 import pytest
 
 REPO = Path(__file__).resolve().parent.parent
-PROBE = REPO / "native" / "build" / "bin" / "pjrt_probe"
 
 pytestmark = pytest.mark.skipif(
     shutil.which("cmake") is None, reason="cmake not available")
@@ -29,13 +28,8 @@ pytestmark = pytest.mark.skipif(
 
 @pytest.fixture(scope="session")
 def probe(native_devices):
-    if not PROBE.exists():
-        subprocess.run(["cmake", "-S", str(REPO / "native"), "-B",
-                        str(REPO / "native" / "build"), "-G", "Ninja"],
-                       check=True, capture_output=True)
-        subprocess.run(["ninja", "-C", str(REPO / "native" / "build"),
-                        "pjrt_probe"], check=True, capture_output=True)
-    return PROBE
+    from dlnetbench_tpu.utils.native_build import native_bin
+    return native_bin(REPO) / "pjrt_probe"
 
 
 @pytest.fixture(scope="session")
@@ -175,7 +169,7 @@ def test_dp_pjrt_records_compute_mode(probe):
     (device_burn on a real plugin, host_sleep on the host executor)."""
     import json
 
-    dp = PROBE.parent / "dp"
+    dp = probe.parent / "dp"
     out = subprocess.run(
         [str(dp), "--model", "gpt2_l_16_bfloat16", "--world", "2",
          "--backend", "pjrt", "--runs", "1", "--warmup", "1",

@@ -119,12 +119,8 @@ def test_pod_study_native_tier(tmp_path):
     if shutil.which("cmake") is None or shutil.which("ninja") is None:
         pytest.skip("cmake/ninja not available")
     repo = Path(__file__).resolve().parent.parent
-    if not (repo / "native" / "build" / "bin" / "dp").exists():
-        subprocess.run(["cmake", "-S", str(repo / "native"), "-B",
-                        str(repo / "native" / "build"), "-G", "Ninja"],
-                       check=True, capture_output=True)
-        subprocess.run(["ninja", "-C", str(repo / "native" / "build")],
-                       check=True, capture_output=True)
+    from dlnetbench_tpu.utils.native_build import native_bin
+    native_bin(repo)
     proc = subprocess.run(
         [sys.executable, "examples/pod_study.py", "--tier", "native",
          "--out_dir", str(tmp_path), "--devices", "8", "--runs", "1",
