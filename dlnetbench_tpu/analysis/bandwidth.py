@@ -59,6 +59,16 @@ def effective_bandwidth(records: list[dict]):
                 # low there) — surfaced as a column, not a code comment
                 bound = ("lower" if any(c.get("bound") == "lower"
                                         for c in components) else "exact")
+                # Records from the legacy gather-based hierarchical DCN
+                # legs moved padded member blocks / all-G-block AR legs
+                # — bytes no real DCN algorithm moves — so NO correction
+                # factor describes them: refuse busbw outright.  Current
+                # hier records stamp dcn_algo "blocked" (bandwidth-true
+                # direct exchange, hier_fabric.hpp header) and stay
+                # admissible.
+                dcn_algo = g.get("dcn_algo")
+                if dcn_algo == "hierarchical":
+                    bound = "hierarchical"
                 # TCP-tier allreduces below the ring threshold ran the
                 # pairwise FULL MESH — (n-1) x count on the wire, an
                 # algorithm no real fabric runs — so the ring-model
@@ -66,18 +76,24 @@ def effective_bandwidth(records: list[dict]):
                 # figure instead of publishing a wrong one.  The
                 # threshold is per MESSAGE, so aggregated multi-op
                 # timers divide by their declared op count; 2-rank
-                # groups are exempt (mesh and ring wire cost coincide
+                # meshes are exempt (mesh and ring wire cost coincide
                 # at n=2, which is also why the fabric never rings
-                # there).
+                # there).  On hier records the mesh in question is the
+                # DCN leg among the PROCESSES (same element count as the
+                # group op), so num_processes bounds its width —
+                # conservatively refusing groups that span fewer.
                 ring_thr = g.get("tcp_ring_threshold_bytes")
-                fullmesh = (ring_thr is not None and
-                            any(c["kind"] == "allreduce"
-                                and int(c["group"]) > 2
-                                and c["bytes"] / max(int(c.get("ops", 1)),
-                                                     1) < ring_thr
-                                for c in components))
-                if fullmesh:
-                    bound = "fullmesh"
+                if ring_thr is not None and bound != "hierarchical":
+                    mesh_n = (int(g.get("num_processes", 0))
+                              if dcn_algo == "blocked" else None)
+                    fullmesh = any(
+                        c["kind"] == "allreduce"
+                        and (mesh_n or int(c["group"])) > 2
+                        and c["bytes"] / max(int(c.get("ops", 1)),
+                                             1) < ring_thr
+                        for c in components)
+                    if fullmesh:
+                        bound = "fullmesh"
                 for run, t_us in enumerate(times):
                     if not t_us > 0:
                         continue
@@ -92,7 +108,9 @@ def effective_bandwidth(records: list[dict]):
                         "msg_bytes": float(total),
                         "time_us": float(t_us),
                         "algbw_GBps": total / (t_us * 1e-6) / 1e9,
-                        "busbw_GBps": (float("nan") if bound == "fullmesh"
+                        "busbw_GBps": (float("nan")
+                                       if bound in ("fullmesh",
+                                                    "hierarchical")
                                        else bus_total / (t_us * 1e-6)
                                        / 1e9),
                         "bound": bound,

@@ -107,6 +107,27 @@ def merge_records(records: list[dict]) -> dict:
                 f"merge_records: process {proc}'s record has no rows for "
                 f"its own process_index")
         ranks.extend(local)
+
+    # energy_consumed brackets a HOST counter (RAPL/hwmon), but every
+    # process's designated rank records it — with several processes per
+    # host (the --procs N hier runs, co-hosted congestion pairs) the
+    # merged record would carry the host's energy once per process and
+    # Pareto/average analyses would double-count.  Keep one energy row
+    # per hostname: the lowest (process, rank) wins, the rest drop the
+    # key.  Rows without a hostname are conservatively left alone.
+    seen_hosts: set = set()
+    for row in sorted(ranks, key=lambda r: (r.get("process_index", 0),
+                                            r.get("rank", 0))):
+        if "energy_consumed" not in row:
+            continue
+        host = row.get("hostname")
+        if host is None:
+            continue
+        if host in seen_hosts:
+            del row["energy_consumed"]
+        else:
+            seen_hosts.add(host)
+
     ranks.sort(key=lambda row: row["rank"])
 
     merged = {k: v for k, v in base.items() if k != "ranks"}

@@ -139,7 +139,7 @@ def run_plan(plan, args, records: Path) -> int:
             argv += [f"--{k}", str(v)]
         print(f"[{i + 1}/{len(plan)}] {proxy} {desc}", flush=True)
         if args.tier == "native" and args.backend == "pjrt-hier":
-            rc = _run_hier_point(argv, world, records, env)
+            rc = _run_hier_point(argv, world, records, env, args.procs)
         else:
             rc = subprocess.run(argv, env=env,
                                 stdout=subprocess.DEVNULL).returncode
@@ -149,22 +149,24 @@ def run_plan(plan, args, records: Path) -> int:
     return failed
 
 
-def _run_hier_point(argv: list[str], world, records: Path, env) -> int:
-    """One study point over the hierarchical ICI x DCN fabric: two OS
-    processes, each driving its own executor (libtpu when usable, host
-    otherwise) over half the ranks, combined over the TCP mesh; their
-    per-process records are merged into the study's record stream (the
-    reference's multi-node operating mode, dp.cpp:166-189).  Returns a
-    nonzero code for ANY per-point failure (signal death, timeout, bad
-    records) so run_plan's per-point FAILED accounting sees it."""
-    if int(world) % 2 != 0:
-        print(f"  skipped (world {world} not divisible by 2 processes)",
-              file=sys.stderr)
+def _run_hier_point(argv: list[str], world, records: Path, env,
+                    nprocs: int = 2) -> int:
+    """One study point over the hierarchical ICI x DCN fabric: --procs
+    OS processes, each driving its own executor (libtpu when usable,
+    host otherwise) over world/procs ranks, combined over the TCP mesh;
+    their per-process records are merged into the study's record stream
+    (the reference's multi-node operating mode, dp.cpp:166-189).
+    Returns a nonzero code for ANY per-point failure (signal death,
+    timeout, bad records) so run_plan's per-point FAILED accounting
+    sees it."""
+    if int(world) % nprocs != 0:
+        print(f"  skipped (world {world} not divisible by {nprocs} "
+              f"processes)", file=sys.stderr)
         return 0
     # strip the single-record --out; each process writes its own file
     base = [a for j, a in enumerate(argv)
             if argv[j - 1] != "--out" and a != "--out"]
-    parts = [records.parent / f".hier_p{r}.jsonl" for r in range(2)]
+    parts = [records.parent / f".hier_p{r}.jsonl" for r in range(nprocs)]
     # the freshly-probed port can be stolen before rank 0 binds it
     # (TOCTOU) — retry on a fresh port, same discipline as the tcp
     # fabric tests
@@ -173,10 +175,11 @@ def _run_hier_point(argv: list[str], world, records: Path, env) -> int:
             p.unlink(missing_ok=True)
         port = free_port()
         procs = [subprocess.Popen(
-            base + ["--backend", "pjrt", "--procs", "2", "--rank", str(r),
+            base + ["--backend", "pjrt", "--procs", str(nprocs),
+                    "--rank", str(r),
                     "--coordinator", f"127.0.0.1:{port}", "--out",
                     str(parts[r])],
-            env=env, stdout=subprocess.DEVNULL) for r in range(2)]
+            env=env, stdout=subprocess.DEVNULL) for r in range(nprocs)]
         rcs = []
         for p in procs:
             try:
@@ -211,6 +214,16 @@ def report(args, records: Path) -> None:
 
     recs = load_records(records)
     df = records_to_dataframe(recs)
+
+    # honesty note (VERDICT r3 #8): hier points fall back to the HOST
+    # executor when no usable TPU plugin is present — those numbers
+    # describe a virtual mesh on this machine's CPU, not TPU devices
+    hier_hosted = sum(1 for r in recs
+                      if r.get("global", {}).get("pjrt_executor") == "host")
+    if hier_hosted:
+        print(f"note: {hier_hosted}/{len(recs)} study points ran the "
+              f"device path on the HOST executor (virtual mesh, no TPU "
+              f"plugin) — fabric numbers are loopback, not ICI/DCN")
 
     # --- north-star table: iter time + effective bus GB/s per collective
     per_point = []
@@ -298,9 +311,12 @@ def main() -> int:
     ap.add_argument("--backend", default="shm",
                     choices=("shm", "pjrt-hier"),
                     help="native tier fabric: shm (threaded, one process) "
-                         "or pjrt-hier (2 OS processes, per-process "
+                         "or pjrt-hier (--procs OS processes, per-process "
                          "executor + TCP DCN combine — the multi-host "
                          "device path; records merged per point)")
+    ap.add_argument("--procs", type=int, default=2,
+                    help="pjrt-hier: number of OS processes composing the "
+                         "DCN mesh (world must divide evenly)")
     ap.add_argument("--models", default=f"{DENSE},{MOE}",
                     help="comma-separated stats-file names")
     ap.add_argument("--runs", type=int, default=3)

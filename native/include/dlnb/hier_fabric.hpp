@@ -22,19 +22,30 @@
 //     back to every local member.  Groups contained in one process never
 //     touch the wire.
 //
-// Per-op composition (G = group size, m = local members, P = processes
-// hosting the group):
+// Per-op composition (G = group size, m = this process's local members,
+// m_q = process q's members, P = processes hosting the group).  Every
+// DCN leg is BANDWIDTH-TRUE: it moves the bytes the canonical direct
+// algorithm moves (the reference composes alltoall the same way, from
+// per-destination p2p blocks: cpp/proxy_classes.hpp:160-182), so the
+// recorded tcp_bytes_sent — and busbw derived from the timers — describe
+// an algorithm a real DCN would run (dcn_algo: "blocked").
 //   Allreduce        local AR (device) -> TCP AR of the m-way partial
 //                    (count elements on the wire, the bandwidth-optimal
-//                    two-level reduction) -> copy to members.
-//   ReduceScatter    local AR of all G blocks -> TCP AR of the partial ->
-//                    each member takes its block.  (DCN moves G blocks —
-//                    an AR-based reduce-scatter; records stamp
-//                    dcn_algo so bandwidth analyses can tell.)
-//   Allgather /      local AG (device) -> TCP AG of the process's packed
-//   Alltoall /       member blocks (padded to the group's max local
-//   RingShift        membership so counts are uniform) -> reassemble in
-//                    global group-rank order -> distribute.
+//                    two-level reduction; ring/mesh per the TCP
+//                    threshold) -> copy to members.
+//   ReduceScatter    local AR of all G blocks -> block-routed exchange:
+//                    each process sends peer q only q's members' partial
+//                    blocks (m_q x count; (G-m) x count total sent) ->
+//                    each process sums the P partials of its own blocks.
+//   Allgather        local AG -> each process sends its packed m blocks
+//                    to every peer (exact sizes, no padding) ->
+//                    reassemble in global group-rank order.
+//   Alltoall         local AG of full sources -> each process sends
+//                    peer q only the blocks destined to q's members
+//                    (m x m_q x count; m x (G-m) x count total sent).
+//   RingShift        local AG -> each process sends peer q only the
+//                    source blocks q's members rotate in (boundary
+//                    blocks only).
 //   Barrier          local barrier -> TCP barrier among the group's
 //                    processes.
 //   Send/Recv        local pairs ride the in-process mailbox; cross-
@@ -56,6 +67,8 @@
 
 #include <algorithm>
 #include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <limits>
@@ -167,7 +180,6 @@ struct GroupSet {
   struct Info {
     std::vector<int> procs;                        // ascending proc ranks
     std::vector<std::vector<int>> members_by_proc; // parallel to procs
-    int maxm = 0;                                  // max local membership
   };
   struct LocalGroup {
     std::vector<int> local_members;  // global ranks here, ascending
@@ -176,6 +188,13 @@ struct GroupSet {
   };
 
   int world = 0, local = 0, nprocs = 1, my_proc = 0;
+  // All groups the same size?  The local DEVICE phase of G-dependent
+  // ops (Alltoall / ReduceScatter move G x count locally) rides ONE
+  // compiled XLA module per process, whose shapes cannot differ across
+  // co-resident groups — when sizes are uneven those ops fall back to
+  // a host-side local phase (same DCN wire layout).  Set-wide so every
+  // rank of every process takes the same path.
+  bool uniform = true;
   std::vector<std::vector<int>> groups;  // global ranks, by color asc
   std::vector<int> group_of, grank_of;   // by global rank
   std::vector<Info> info;                // by group index
@@ -387,47 +406,65 @@ class HierCommunicator : public ProxyCommunicator {
       lg_->tcp->Wait(slot);
     }
   }
-  void tcp_allgather(int slot, const void* s, void* d, std::int64_t n) {
-    if (slot >= num_slots_) {
-      lg_->tcp->Allgather(s, d, n);
-    } else {
-      lg_->tcp->Iallgather(s, d, n, slot);
-      lg_->tcp->Wait(slot);
-    }
-  }
 
-  // Resolve a pointer to every GLOBAL group member's gathered block of
-  // `block_bytes`, from the local sub-allgather result (single-process
-  // groups) or a padded TCP allgather of each process's packed members
-  // (spanning groups).  `storage` owns the wire buffer.
-  void gather_member_blocks(int slot, const void* local_gathered,
-                            std::size_t block_bytes,
-                            std::vector<char>& storage,
-                            std::vector<const char*>& ptrs) {
+  // Where each group rank lives: process slot qi (index into
+  // info.procs) and position within that process's member list.
+  struct MemberLoc {
+    int qi = 0;
+    int idx = 0;
+  };
+  std::vector<MemberLoc> member_locs() const {
     const auto& gi = set_->info[gidx_];
-    const auto& members = lg_->local_members;
-    const int G = size();
-    ptrs.assign(G, nullptr);
-    if (gi.procs.size() == 1) {
-      const char* base = static_cast<const char*>(local_gathered);
-      for (std::size_t k = 0; k < members.size(); ++k)
-        ptrs[set_->grank_of[members[k]]] = base + k * block_bytes;
-      return;
-    }
-    const std::size_t pad = static_cast<std::size_t>(gi.maxm) * block_bytes;
-    std::vector<char> packed(pad, 0);
-    std::memcpy(packed.data(), local_gathered,
-                members.size() * block_bytes);
-    storage.resize(gi.procs.size() * pad);
-    const std::size_t esz = dtype_bytes(dtype_);
-    tcp_allgather(slot, packed.data(), storage.data(),
-                  static_cast<std::int64_t>(pad / esz));
+    std::vector<MemberLoc> loc(size());
     for (std::size_t qi = 0; qi < gi.procs.size(); ++qi) {
       const auto& mems = gi.members_by_proc[qi];
       for (std::size_t k = 0; k < mems.size(); ++k)
-        ptrs[set_->grank_of[mems[k]]] =
-            storage.data() + qi * pad + k * block_bytes;
+        loc[set_->grank_of[mems[k]]] = {static_cast<int>(qi),
+                                        static_cast<int>(k)};
     }
+    return loc;
+  }
+
+  // DCN-exchange p2p tags: one tag per (op, slot) keeps concurrent
+  // slots' frames apart; member-level p2p tags (p2p_tag) are always
+  // >= 8192 for cross-process pairs, so this space is collision-free.
+  int dcn_tag(pjrtfab::Op op, int slot) const {
+    int stride = num_slots_ + 1;
+    int tag = static_cast<int>(op) * stride +
+              (slot < num_slots_ ? slot : num_slots_);
+    if (tag >= 8192)
+      throw std::logic_error("hier: dcn tag space exhausted (num_slots "
+                             "too large)");
+    return tag;
+  }
+
+  // Block-routed direct exchange on the DCN leg: send exactly one
+  // tagged frame (possibly empty) to every other member process, then
+  // receive one from each.  `out[qi]`/`recv_elems[qi]` are ignored for
+  // this process's own slot.  Blocking sends cannot deadlock: every
+  // process's per-peer reader threads drain sockets independently.
+  std::vector<std::vector<char>> dcn_exchange(
+      pjrtfab::Op op, int slot, const std::vector<std::vector<char>>& out,
+      const std::vector<std::int64_t>& recv_elems) {
+    const auto& gi = set_->info[gidx_];
+    const std::size_t P = gi.procs.size();
+    const std::size_t esz = dtype_bytes(dtype_);
+    const int me = proc_index(set_->my_proc);
+    const int tag = dcn_tag(op, slot);
+    for (std::size_t qi = 0; qi < P; ++qi) {
+      if (static_cast<int>(qi) == me) continue;
+      lg_->tcp->Send(out[qi].data(),
+                     static_cast<std::int64_t>(out[qi].size() / esz),
+                     static_cast<int>(qi), tag);
+    }
+    std::vector<std::vector<char>> in(P);
+    for (std::size_t qi = 0; qi < P; ++qi) {
+      if (static_cast<int>(qi) == me) continue;
+      in[qi].resize(static_cast<std::size_t>(recv_elems[qi]) * esz);
+      lg_->tcp->Recv(in[qi].data(), recv_elems[qi], static_cast<int>(qi),
+                     tag);
+    }
+    return in;
   }
 
   void run_collective(int slot, pjrtfab::Op op, std::int64_t count,
@@ -449,11 +486,23 @@ class HierCommunicator : public ProxyCommunicator {
         break;
       case pjrtfab::Op::ReduceScatterBlock:
         scratch.resize(static_cast<std::size_t>(G) * count * esz);
-        sub_allreduce(slot, src, scratch.data(), G * count);
+        if (set_->uniform) {
+          sub_allreduce(slot, src, scratch.data(), G * count);
+        } else {
+          // uneven group sizes: the G x count local module shape would
+          // differ across co-resident groups — stage the raw source;
+          // dcn_phase sums the members on host
+          std::memcpy(scratch.data(), src, scratch.size());
+        }
         break;
       case pjrtfab::Op::Alltoall:
-        scratch.resize(m * G * count * esz);
-        sub_allgather(slot, src, scratch.data(), G * count);
+        if (set_->uniform) {
+          scratch.resize(m * G * count * esz);
+          sub_allgather(slot, src, scratch.data(), G * count);
+        } else {
+          scratch.resize(static_cast<std::size_t>(G) * count * esz);
+          std::memcpy(scratch.data(), src, scratch.size());
+        }
         break;
       case pjrtfab::Op::RingShift:
         scratch.resize(m * count * esz);
@@ -481,6 +530,12 @@ class HierCommunicator : public ProxyCommunicator {
                  bool spanning, const std::vector<void*>& dsts,
                  const std::vector<void*>& scratches) {
     const auto& members = lg_->local_members;
+    const auto& gi = set_->info[gidx_];
+    const std::size_t m = members.size();
+    const std::size_t blk = static_cast<std::size_t>(count) * esz;
+    // every local member's scratch holds the same local-phase result;
+    // scratches[0] is the canonical copy
+    const char* local_res = static_cast<const char*>(scratches[0]);
     switch (op) {
       case pjrtfab::Op::Barrier:
         if (spanning) lg_->tcp->Barrier();
@@ -493,54 +548,222 @@ class HierCommunicator : public ProxyCommunicator {
         break;
       }
       case pjrtfab::Op::ReduceScatterBlock: {
-        const char* full = static_cast<const char*>(scratches[0]);
-        std::vector<char> tmp;
-        if (spanning) {  // AR-based reduce-scatter on the DCN leg
-          tmp.resize(static_cast<std::size_t>(G) * count * esz);
-          tcp_allreduce(slot, full, tmp.data(), G * count);
-          full = tmp.data();
+        // local_res: this process's full G-block partial sum — from the
+        // device AR, or summed here when the split is uneven (the
+        // staged raw sources, see run_collective)
+        std::vector<char> staged;
+        if (!set_->uniform) {
+          staged.assign(local_res,
+                        local_res + static_cast<std::size_t>(G) * blk);
+          for (std::size_t k = 1; k < m; ++k) {
+            const char* s = static_cast<const char*>(scratches[k]);
+            for (std::size_t i = 0;
+                 i < static_cast<std::size_t>(G) *
+                         static_cast<std::size_t>(count);
+                 ++i)
+              store_element(staged.data(), i, dtype_,
+                            load_element(staged.data(), i, dtype_) +
+                                load_element(s, i, dtype_));
+          }
+          local_res = staged.data();
         }
-        for (std::size_t k = 0; k < members.size(); ++k)
-          std::memcpy(dsts[k],
-                      full + static_cast<std::size_t>(
-                                 set_->grank_of[members[k]]) *
-                                 count * esz,
-                      count * esz);
+        if (!spanning) {
+          for (std::size_t k = 0; k < m; ++k)
+            std::memcpy(dsts[k],
+                        local_res + static_cast<std::size_t>(
+                                        set_->grank_of[members[k]]) *
+                                        blk,
+                        blk);
+          break;
+        }
+        // block-routed reduce-scatter: peer q gets only its members'
+        // partial blocks ((G-m) x count sent); sum arriving partials of
+        // OUR blocks over the member processes
+        std::vector<std::vector<char>> out(gi.procs.size());
+        std::vector<std::int64_t> want(gi.procs.size(), 0);
+        const int me = proc_index(set_->my_proc);
+        for (std::size_t qi = 0; qi < gi.procs.size(); ++qi) {
+          if (static_cast<int>(qi) == me) continue;
+          const auto& mems = gi.members_by_proc[qi];
+          out[qi].resize(mems.size() * blk);
+          for (std::size_t j = 0; j < mems.size(); ++j)
+            std::memcpy(out[qi].data() + j * blk,
+                        local_res + static_cast<std::size_t>(
+                                        set_->grank_of[mems[j]]) *
+                                        blk,
+                        blk);
+          want[qi] = static_cast<std::int64_t>(m) * count;
+        }
+        auto in = dcn_exchange(op, slot, out, want);
+        std::vector<char> acc(m * blk);
+        for (std::size_t k = 0; k < m; ++k)
+          std::memcpy(acc.data() + k * blk,
+                      local_res + static_cast<std::size_t>(
+                                      set_->grank_of[members[k]]) *
+                                      blk,
+                      blk);
+        for (std::size_t qi = 0; qi < gi.procs.size(); ++qi) {
+          if (in[qi].empty()) continue;
+          for (std::size_t i = 0; i < m * static_cast<std::size_t>(count);
+               ++i)
+            store_element(acc.data(), i, dtype_,
+                          load_element(acc.data(), i, dtype_) +
+                              load_element(in[qi].data(), i, dtype_));
+        }
+        for (std::size_t k = 0; k < m; ++k)
+          std::memcpy(dsts[k], acc.data() + k * blk, blk);
         break;
       }
       case pjrtfab::Op::Allgather: {
-        std::vector<char> storage;
-        std::vector<const char*> ptrs;
-        gather_member_blocks(slot, scratches[0], count * esz, storage, ptrs);
+        // local_res: this process's m packed member blocks (ascending
+        // global rank = group-rank order within the process)
+        if (!spanning) {
+          for (void* d : dsts) std::memcpy(d, local_res, m * blk);
+          break;
+        }
+        // exact-size direct allgather: the packed m blocks go to every
+        // peer unpadded; reassemble in global group-rank order
+        std::vector<std::vector<char>> out(gi.procs.size());
+        std::vector<std::int64_t> want(gi.procs.size(), 0);
+        const int me = proc_index(set_->my_proc);
+        for (std::size_t qi = 0; qi < gi.procs.size(); ++qi) {
+          if (static_cast<int>(qi) == me) continue;
+          out[qi].assign(local_res, local_res + m * blk);
+          want[qi] = static_cast<std::int64_t>(
+                         gi.members_by_proc[qi].size()) *
+                     count;
+        }
+        auto in = dcn_exchange(op, slot, out, want);
+        auto loc = member_locs();
         for (void* d : dsts)
-          for (std::int64_t j = 0; j < G; ++j)
-            std::memcpy(static_cast<char*>(d) + j * count * esz, ptrs[j],
-                        count * esz);
+          for (std::int64_t j = 0; j < G; ++j) {
+            const char* src_blk =
+                loc[j].qi == me
+                    ? local_res + static_cast<std::size_t>(loc[j].idx) * blk
+                    : in[loc[j].qi].data() +
+                          static_cast<std::size_t>(loc[j].idx) * blk;
+            std::memcpy(static_cast<char*>(d) + j * blk, src_blk, blk);
+          }
         break;
       }
       case pjrtfab::Op::Alltoall: {
-        std::vector<char> storage;
-        std::vector<const char*> ptrs;  // each member's FULL src (G blocks)
-        gather_member_blocks(slot, scratches[0],
-                             static_cast<std::size_t>(G) * count * esz,
-                             storage, ptrs);
-        for (std::size_t k = 0; k < members.size(); ++k) {
-          std::size_t gk = static_cast<std::size_t>(
-              set_->grank_of[members[k]]);
-          for (std::int64_t j = 0; j < G; ++j)
-            std::memcpy(static_cast<char*>(dsts[k]) + j * count * esz,
-                        ptrs[j] + gk * count * esz, count * esz);
+        // local_res: m members x their FULL G-block sources
+        // (member-major, ascending global rank) — from the device AG,
+        // or packed here from the staged raw sources when uneven
+        std::vector<char> staged;
+        if (!set_->uniform) {
+          staged.resize(m * static_cast<std::size_t>(G) * blk);
+          for (std::size_t k = 0; k < m; ++k)
+            std::memcpy(staged.data() +
+                            k * static_cast<std::size_t>(G) * blk,
+                        scratches[k], static_cast<std::size_t>(G) * blk);
+          local_res = staged.data();
+        }
+        auto src_of = [&](std::size_t k_local, std::int64_t dest_g) {
+          return local_res +
+                 (k_local * static_cast<std::size_t>(G) +
+                  static_cast<std::size_t>(dest_g)) *
+                     blk;
+        };
+        if (!spanning) {
+          for (std::size_t k = 0; k < m; ++k) {
+            std::int64_t gk = set_->grank_of[members[k]];
+            for (std::int64_t j = 0; j < G; ++j)
+              std::memcpy(static_cast<char*>(dsts[k]) + j * blk,
+                          src_of(static_cast<std::size_t>(j), gk), blk);
+          }
+          break;
+        }
+        // block-routed alltoall (the reference's per-destination p2p
+        // composition, proxy_classes.hpp:160-182): peer q receives only
+        // the m x m_q blocks destined to its members, packed
+        // [my member asc][q's member asc]
+        std::vector<std::vector<char>> out(gi.procs.size());
+        std::vector<std::int64_t> want(gi.procs.size(), 0);
+        const int me = proc_index(set_->my_proc);
+        for (std::size_t qi = 0; qi < gi.procs.size(); ++qi) {
+          if (static_cast<int>(qi) == me) continue;
+          const auto& mems = gi.members_by_proc[qi];
+          out[qi].resize(m * mems.size() * blk);
+          char* w = out[qi].data();
+          for (std::size_t k = 0; k < m; ++k)
+            for (std::size_t j = 0; j < mems.size(); ++j) {
+              std::memcpy(w, src_of(k, set_->grank_of[mems[j]]), blk);
+              w += blk;
+            }
+          want[qi] = static_cast<std::int64_t>(m * mems.size()) * count;
+        }
+        auto in = dcn_exchange(op, slot, out, want);
+        auto loc = member_locs();
+        for (std::size_t k = 0; k < m; ++k) {
+          std::int64_t gk = set_->grank_of[members[k]];
+          for (std::int64_t j = 0; j < G; ++j) {
+            const char* src_blk =
+                loc[j].qi == me
+                    ? src_of(static_cast<std::size_t>(loc[j].idx), gk)
+                    : in[loc[j].qi].data() +
+                          (static_cast<std::size_t>(loc[j].idx) * m + k) *
+                              blk;
+            std::memcpy(static_cast<char*>(dsts[k]) + j * blk, src_blk,
+                        blk);
+          }
         }
         break;
       }
       case pjrtfab::Op::RingShift: {
-        std::vector<char> storage;
-        std::vector<const char*> ptrs;
-        gather_member_blocks(slot, scratches[0], count * esz, storage, ptrs);
-        for (std::size_t k = 0; k < members.size(); ++k) {
-          std::int64_t gk = set_->grank_of[members[k]];
-          std::int64_t from = ((gk - extra) % G + G) % G;
-          std::memcpy(dsts[k], ptrs[from], count * esz);
+        // local_res: m packed member blocks; member gk rotates in the
+        // block of grank (gk - extra) mod G
+        auto from_of = [&](std::int64_t gk) {
+          return ((gk - extra) % G + G) % G;
+        };
+        if (!spanning) {
+          for (std::size_t k = 0; k < m; ++k) {
+            std::int64_t from = from_of(set_->grank_of[members[k]]);
+            std::memcpy(dsts[k],
+                        local_res + static_cast<std::size_t>(from) * blk,
+                        blk);
+          }
+          break;
+        }
+        // boundary-only routing: peer q gets exactly the source blocks
+        // its members rotate in from OUR members, in q's member order
+        auto loc = member_locs();
+        std::vector<std::vector<char>> out(gi.procs.size());
+        std::vector<std::int64_t> want(gi.procs.size(), 0);
+        const int me = proc_index(set_->my_proc);
+        for (std::size_t qi = 0; qi < gi.procs.size(); ++qi) {
+          if (static_cast<int>(qi) == me) continue;
+          const auto& mems = gi.members_by_proc[qi];
+          for (std::size_t j = 0; j < mems.size(); ++j) {
+            std::int64_t from = from_of(set_->grank_of[mems[j]]);
+            if (loc[from].qi != me) continue;
+            std::size_t old = out[qi].size();
+            out[qi].resize(old + blk);
+            std::memcpy(out[qi].data() + old,
+                        local_res +
+                            static_cast<std::size_t>(loc[from].idx) * blk,
+                        blk);
+          }
+          for (std::size_t k = 0; k < m; ++k)
+            if (loc[from_of(set_->grank_of[members[k]])].qi ==
+                static_cast<int>(qi))
+              want[qi] += count;
+        }
+        auto in = dcn_exchange(op, slot, out, want);
+        std::vector<std::size_t> cursor(gi.procs.size(), 0);
+        for (std::size_t k = 0; k < m; ++k) {
+          std::int64_t from = from_of(set_->grank_of[members[k]]);
+          if (loc[from].qi == me) {
+            std::memcpy(dsts[k],
+                        local_res +
+                            static_cast<std::size_t>(loc[from].idx) * blk,
+                        blk);
+          } else {
+            std::memcpy(dsts[k],
+                        in[loc[from].qi].data() + cursor[loc[from].qi],
+                        blk);
+            cursor[loc[from].qi] += blk;
+          }
         }
         break;
       }
@@ -621,6 +844,13 @@ class HierFabric : public Fabric {
           split_sets_[seq] = build_set(world_colors, name);
         } catch (...) {
           split_sets_[seq] = nullptr;
+          // the builder throws before the retrieval below, so account
+          // its share here or the last waiter's `== L_` eviction never
+          // fires and the failed seq's entries leak
+          if (++split_taken_[seq] == L_) {
+            split_sets_.erase(seq);
+            split_taken_.erase(seq);
+          }
           split_arrived_ = 0;
           ++split_seq_;
           split_cv_.notify_all();
@@ -633,6 +863,13 @@ class HierFabric : public Fabric {
         split_cv_.wait(lk, [&] { return split_seq_ > seq; });
       }
       set = split_sets_.at(seq);
+      // last local thread to retrieve this split's set erases the cache
+      // entry — a looping proxy that re-splits per iteration must not
+      // grow the map (and its live TcpCommunicators) without bound
+      if (++split_taken_[seq] == L_) {
+        split_sets_.erase(seq);
+        split_taken_.erase(seq);
+      }
     }
     if (!set)
       throw std::runtime_error(
@@ -665,10 +902,17 @@ class HierFabric : public Fabric {
     meta["local_world"] = L_;
     meta["dcn_transport"] = "tcp";
     meta["p2p_transport"] = "host+tcp";
-    // the DCN leg of gather-style ops moves padded member blocks and the
-    // reduce-scatter leg moves all G blocks — busbw math must not apply
-    // ring correction factors to these records
-    meta["dcn_algo"] = "hierarchical";
+    // every DCN leg is a block-routed direct exchange moving the
+    // canonical algorithm's bytes (header comment), so busbw correction
+    // factors apply; the allreduce leg rides the TCP ring/mesh per the
+    // threshold, which analysis/bandwidth.py needs to refuse small
+    // full-mesh allreduces — same contract as TcpFabric::describe
+    meta["dcn_algo"] = "blocked";
+    meta["tcp_ring_threshold_bytes"] =
+        static_cast<std::int64_t>(tcp_.ring_threshold_bytes());
+    // this process's actual socket bytes: lets tests pin each DCN
+    // algorithm's wire cost without timing flakiness
+    meta["tcp_bytes_sent"] = static_cast<std::int64_t>(tcp_.bytes_sent());
     mesh["hierarchy"] = "ici+dcn";
   }
 
@@ -722,11 +966,11 @@ class HierFabric : public Fabric {
         }
         info.members_by_proc.back().push_back(members[k]);
       }
-      for (const auto& mems : info.members_by_proc)
-        info.maxm = std::max(info.maxm, static_cast<int>(mems.size()));
       set->groups.push_back(members);
       set->info.push_back(std::move(info));
     }
+    for (const auto& grp : set->groups)
+      if (grp.size() != set->groups[0].size()) set->uniform = false;
     set->local_groups.resize(set->groups.size());
     for (std::size_t gi = 0; gi < set->groups.size(); ++gi) {
       const auto& info = set->info[gi];
@@ -769,6 +1013,7 @@ class HierFabric : public Fabric {
   int split_arrived_ = 0;
   std::uint64_t split_seq_ = 0;
   std::map<std::uint64_t, std::shared_ptr<hier::GroupSet>> split_sets_;
+  std::map<std::uint64_t, int> split_taken_;
 };
 
 }  // namespace dlnb

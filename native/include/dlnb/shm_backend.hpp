@@ -205,6 +205,12 @@ class SlotWorker {
   }
 
   void stop() {
+    // stop() is invoked concurrently when several error paths quiesce
+    // one communicator's SHARED workers at once (e.g. multiple async
+    // hier DCN legs failing together): joining the same std::thread
+    // from two callers is UB that deadlocks in practice, so stoppers
+    // serialize here and late arrivals find started_ already false.
+    std::lock_guard<std::mutex> sl(stop_m_);
     {
       std::lock_guard<std::mutex> lk(m_);
       if (!started_) return;
@@ -212,6 +218,7 @@ class SlotWorker {
     }
     cv_.notify_all();
     if (thread_.joinable()) thread_.join();
+    std::lock_guard<std::mutex> lk(m_);
     started_ = false;
     stopping_ = false;
   }
@@ -249,6 +256,7 @@ class SlotWorker {
   }
 
   std::mutex m_;
+  std::mutex stop_m_;  // serializes concurrent stop() callers
   std::condition_variable cv_, cv_done_;
   std::deque<std::function<void()>> q_;
   int outstanding_ = 0;

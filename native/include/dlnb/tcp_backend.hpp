@@ -39,6 +39,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <stdexcept>
 #include <string>
 #include <thread>
@@ -53,7 +54,14 @@ namespace dlnb {
 namespace tcp {
 
 // ------------------------------------------------------------- framing
-enum class FrameKind : std::uint32_t { Coll = 1, P2P = 2 };
+// Bye is the clean-goodbye frame a departing fabric sends every peer
+// before closing its sockets: it lets the receiver distinguish "rank X
+// finished its run and left" (everything X was supposed to send is
+// already ordered before the Bye) from "rank X died mid-run" (frames
+// may be lost) — the distinction the transitive ring-dependency check
+// needs to avoid false-positive aborts when a fast rank legitimately
+// exits while slower ranks are still mid-collective.
+enum class FrameKind : std::uint32_t { Coll = 1, P2P = 2, Bye = 3 };
 
 struct FrameHeader {
   std::uint32_t kind;     // FrameKind
@@ -74,6 +82,19 @@ inline void send_all(int fd, const void* data, std::size_t n) {
     p += w;
     n -= static_cast<std::size_t>(w);
   }
+}
+
+// Deterministic-interleaving test hook: delays this process's FINAL
+// allgather-phase ring receive so every peer finishes its ring and
+// exits first — the clean-early-exit interleaving the Bye protocol
+// exists for.  No-op unless the env var is set (pytest sets it on one
+// process only: test_native_tcp_ring_survives_clean_early_exit).
+inline void test_delay_final_recv() {
+  static const int ms = [] {
+    const char* e = std::getenv("DLNB_TEST_RING_FINAL_RECV_DELAY_MS");
+    return e && *e ? std::atoi(e) : 0;
+  }();
+  if (ms > 0) ::usleep(static_cast<useconds_t>(ms) * 1000);
 }
 
 inline bool recv_all(int fd, void* data, std::size_t n) {
@@ -120,15 +141,29 @@ class Inbox {
     cv_.notify_all();
   }
 
+  // Mark `peer` cleanly departed (Bye frame): everything it owed the
+  // fabric was sent before the Bye, so waits that merely DEPEND on it
+  // transitively must keep waiting (their data rides other, still-alive
+  // ranks), while a direct wait for one of its frames that never
+  // matched is a protocol desync and must error rather than hang.
+  void depart(int peer) {
+    std::lock_guard<std::mutex> lk(m_);
+    departed_.emplace(peer);
+    cv_.notify_all();
+  }
+
   // Blocking take of the first frame matching `pred`, which must only
   // accept frames from world rank `want_src` (all matching here is
   // per-source).  Queued frames are matched BEFORE the death flag is
   // consulted, so an op whose frames already landed still completes.
   // `also_dep` lists ranks the awaited frame TRANSITIVELY depends on
   // (a ring step's data has passed through every group member): their
-  // death fails the wait too, even though want_src itself is alive —
+  // DEATH fails the wait too, even though want_src itself is alive —
   // otherwise a mid-ring death would hang non-neighbors until the
-  // failure cascaded around the ring via process exits.
+  // failure cascaded around the ring via process exits.  A CLEAN
+  // departure of a dep rank does NOT fail the wait: the departed rank
+  // finished its contribution before leaving, so the awaited frame is
+  // still coming from the (alive) want_src.
   template <typename Pred>
   Frame take(int want_src, const Pred& pred,
              const std::vector<int>& also_dep = {}) {
@@ -139,12 +174,14 @@ class Inbox {
         if (pred(it->h)) return true;
       return false;
     };
-    const int* dead_dep = nullptr;
+    const int* dead_dep = nullptr;  // null at throw time => departed src
     auto failed = [&] {
+      dead_dep = nullptr;
       if (dead_.count(want_src)) {
         dead_dep = &want_src;
         return true;
       }
+      if (departed_.count(want_src)) return true;
       for (const int& d : also_dep)
         if (dead_.count(d)) {
           dead_dep = &d;
@@ -153,8 +190,14 @@ class Inbox {
       return false;
     };
     cv_.wait(lk, [&] { return find() || failed(); });
-    if (!find())
-      throw std::runtime_error("tcp fabric: " + dead_.at(*dead_dep));
+    if (!find()) {
+      if (dead_dep)
+        throw std::runtime_error("tcp fabric: " + dead_.at(*dead_dep));
+      throw std::runtime_error(
+          "tcp fabric: rank " + std::to_string(want_src) +
+          " finished its run and left, but a frame expected from it "
+          "never arrived (collective schedules desynchronized?)");
+    }
     Frame f = std::move(*it);
     frames_.erase(it);
     return f;
@@ -165,6 +208,7 @@ class Inbox {
   std::condition_variable cv_;
   std::deque<Frame> frames_;
   std::map<int, std::string> dead_;
+  std::set<int> departed_;
 };
 
 }  // namespace tcp
@@ -318,6 +362,23 @@ class TcpFabric : public Fabric {
   }
 
   ~TcpFabric() override {
+    // clean goodbye first (FrameKind::Bye): TCP orders it after every
+    // data frame this process sent, so peers can tell "finished and
+    // left" from "died mid-run" — a slower rank must keep waiting for
+    // frames from STILL-ALIVE ranks after a fast rank legitimately
+    // exits (the ring's transitive-dependency check would otherwise
+    // false-positive on the Bye'd rank's EOF)
+    for (int r = 0; r < world_; ++r) {
+      if (r == rank_ || fds_[r] < 0) continue;
+      tcp::FrameHeader h{};
+      h.kind = static_cast<std::uint32_t>(tcp::FrameKind::Bye);
+      h.src = static_cast<std::uint32_t>(rank_);
+      try {
+        send_frame(r, h, nullptr);
+      } catch (...) {
+        // peer already gone: nothing to tell it
+      }
+    }
     closing_.store(true, std::memory_order_release);
     for (int fd : fds_)
       if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
@@ -395,6 +456,12 @@ class TcpFabric : public Fabric {
   }
 
   std::size_t ring_threshold_bytes() const { return ring_threshold_bytes_; }
+
+  // payload+header bytes this process actually wrote to sockets —
+  // layered fabrics (hier_fabric.hpp) stamp it into their own records
+  std::uint64_t bytes_sent() const {
+    return bytes_sent_.load(std::memory_order_relaxed);
+  }
 
   tcp::Inbox& inbox() { return inbox_; }
 
@@ -558,18 +625,25 @@ class TcpFabric : public Fabric {
 
   void start_reader(int peer) {
     readers_.emplace_back([this, peer] {
+      bool bye = false;
       try {
         while (true) {
           tcp::FrameHeader h;
           if (!tcp::recv_all(fds_[peer], &h, sizeof h)) {
-            // EOF: silent only during our own orderly teardown — a peer
-            // dying mid-run must fail waits on THAT peer (its own sent
-            // frames were delivered before the FIN), without poisoning
-            // waits on still-alive ranks
-            if (!closing_.load(std::memory_order_acquire))
+            // EOF: silent when the peer said goodbye (clean departure,
+            // already recorded) or during our own orderly teardown — a
+            // peer dying mid-run must fail waits on THAT peer (its own
+            // sent frames were delivered before the FIN), without
+            // poisoning waits on still-alive ranks
+            if (!bye && !closing_.load(std::memory_order_acquire))
               inbox_.fail(peer, "rank " + std::to_string(peer) +
                                     " disconnected mid-run");
             return;
+          }
+          if (h.kind == static_cast<std::uint32_t>(tcp::FrameKind::Bye)) {
+            bye = true;
+            inbox_.depart(peer);
+            continue;  // keep draining until the FIN
           }
           tcp::Inbox::Frame f;
           f.h = h;
@@ -579,7 +653,9 @@ class TcpFabric : public Fabric {
           inbox_.push(std::move(f));
         }
       } catch (const std::exception& e) {
-        if (!closing_.load(std::memory_order_acquire))
+        // post-Bye socket errors (e.g. an RST racing the FIN) carry no
+        // information: everything the peer owed us already arrived
+        if (!bye && !closing_.load(std::memory_order_acquire))
           inbox_.fail(peer, std::string("reader for rank ") +
                                 std::to_string(peer) + ": " + e.what());
       }
@@ -798,10 +874,16 @@ inline void TcpCommunicator::ring_allreduce(int slot, std::int64_t count,
     h.op = static_cast<std::uint32_t>(shm::OpKind::Allreduce);
     h.src = static_cast<std::uint32_t>(wrank_);
     h.count = static_cast<std::uint64_t>(count);
-    h.bytes = static_cast<std::uint64_t>(blen(bi)) * esz;
+    std::int64_t len = blen(bi);
+    h.bytes = static_cast<std::uint64_t>(len) * esz;
+    // a zero-length tail block (count small vs n) must not even FORM the
+    // out-of-range bi*block offset pointer — UB the UBSan preset exists
+    // to catch; the frame still goes out so seq counters stay aligned
     fab_->send_frame(to, h,
-                     static_cast<const char*>(dst) +
-                         static_cast<std::size_t>(bi) * block * esz);
+                     len == 0 ? dst
+                              : static_cast<const char*>(dst) +
+                                    static_cast<std::size_t>(bi) * block *
+                                        esz);
   };
   // ring data has passed through every member: any member's death must
   // fail this wait, not just the immediate predecessor's
@@ -833,11 +915,12 @@ inline void TcpCommunicator::ring_allreduce(int slot, std::int64_t count,
     std::int64_t rb = ((grank_ - step - 1) % n + n) % n;
     send_block(sb, base + static_cast<std::uint32_t>(step));
     auto f = recv_block(base + static_cast<std::uint32_t>(step));
-    char* d = static_cast<char*>(dst) +
-              static_cast<std::size_t>(rb) * block * esz;
     std::int64_t len = blen(rb);
     if (f.payload.size() != static_cast<std::size_t>(len) * esz)
       throw std::runtime_error("tcp ring allreduce: block size mismatch");
+    if (len == 0) continue;  // zero tail block: no valid rb offset exists
+    char* d = static_cast<char*>(dst) +
+              static_cast<std::size_t>(rb) * block * esz;
     for (std::int64_t i = 0; i < len; ++i)
       store_element(d, static_cast<std::size_t>(i), dtype_,
                     load_element(d, static_cast<std::size_t>(i), dtype_) +
@@ -848,10 +931,12 @@ inline void TcpCommunicator::ring_allreduce(int slot, std::int64_t count,
     std::int64_t sb = ((grank_ + 1 - step) % n + n) % n;
     std::int64_t rb = ((grank_ - step) % n + n) % n;
     send_block(sb, base + static_cast<std::uint32_t>(n - 1 + step));
+    if (step == n - 2) tcp::test_delay_final_recv();
     auto f = recv_block(base + static_cast<std::uint32_t>(n - 1 + step));
     std::int64_t len = blen(rb);
     if (f.payload.size() != static_cast<std::size_t>(len) * esz)
       throw std::runtime_error("tcp ring allreduce: block size mismatch");
+    if (len == 0) continue;  // zero tail block: no valid rb offset exists
     std::memcpy(static_cast<char*>(dst) +
                     static_cast<std::size_t>(rb) * block * esz,
                 f.payload.data(), static_cast<std::size_t>(len) * esz);

@@ -109,6 +109,58 @@ void rank_body(int g, int world, int local, HierFabric& fab) {
     span->ReduceScatterBlock(rs_src.data(), rs_dst.data(), 1);
     REQUIRE(rs_dst.get(0) == expect);
   }
+  // split with groups of UNEVEN per-process membership spanning strict
+  // SUBSETS of the processes.  At world 12 / procs 3: group 0 = two of
+  // proc 0's ranks + all four of proc 1's (2+4 members), group 1 = two
+  // of proc 0's + two of proc 2's (procs {0,2} — a NON-adjacent
+  // subset), group 2 contained in proc 2.  Each process's LOCAL color
+  // partition stays uniform — the XLA SPMD replica_groups constraint
+  // (pjrt_fabric.hpp header) — while the DCN routing sees every
+  // uneven/subset shape.
+  {
+    auto uneven_color = [&](int r) {
+      int p = r / local, i = r % local;
+      if (world == 12 && local == 4)
+        return p == 0 ? (i < 2 ? 0 : 1) : (p == 1 ? 0 : (i < 2 ? 1 : 2));
+      return 0;  // elsewhere: one group spanning every process
+    };
+    auto unev = fab.split(g, uneven_color(g), "uneven");
+    std::vector<int> mem;
+    for (int r = 0; r < world; ++r)
+      if (uneven_color(r) == uneven_color(g)) mem.push_back(r);
+    int G = static_cast<int>(mem.size());
+    int gr = -1;
+    for (int k = 0; k < G; ++k)
+      if (mem[k] == g) gr = k;
+    REQUIRE(unev->size() == G && unev->rank() == gr);
+    // allgather: exact-size packed blocks, global group-rank order
+    Tensor one(1, DType::F32), ag(G, DType::F32);
+    one.set(0, static_cast<float>(g));
+    unev->Allgather(one.data(), ag.data(), 1);
+    for (int k = 0; k < G; ++k)
+      REQUIRE(ag.get(k) == static_cast<float>(mem[k]));
+    // alltoall: per-destination block routing
+    Tensor as(G, DType::F32), ad(G, DType::F32);
+    for (int q = 0; q < G; ++q)
+      as.set(q, static_cast<float>(100 * g + q));
+    unev->Alltoall(as.data(), ad.data(), 1);
+    for (int q = 0; q < G; ++q)
+      REQUIRE(ad.get(q) == static_cast<float>(100 * mem[q] + gr));
+    // reduce-scatter: summed partials routed to each block's owner
+    Tensor rs(G, DType::F32), rd(1, DType::F32);
+    rs.fill(static_cast<float>(g));
+    unev->ReduceScatterBlock(rs.data(), rd.data(), 1);
+    float expect = 0;
+    for (int r : mem) expect += static_cast<float>(r);
+    REQUIRE(rd.get(0) == expect);
+    // ring rotation: boundary-only block routing
+    if (G > 1) {
+      Tensor ro(2, DType::F32), ri(2, DType::F32);
+      ro.fill(static_cast<float>(g));
+      unev->RingShift(ro.data(), ri.data(), 2);
+      REQUIRE(ri.get(0) == static_cast<float>(mem[(gr + G - 1) % G]));
+    }
+  }
   // split with groups CONTAINED in one process (color = g / local: the
   // DCN leg must stay silent; group sums still correct)
   {

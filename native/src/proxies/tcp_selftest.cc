@@ -29,7 +29,12 @@ int main(int argc, char** argv) {
   Args args("tcp_selftest — cross-process fabric correctness");
   args.required_int("world", "total process count")
       .required_int("rank", "this process's rank")
-      .optional_str("coordinator", "127.0.0.1:0", "rank 0 listen host:port");
+      .optional_str("coordinator", "127.0.0.1:0", "rank 0 listen host:port")
+      .flag("final_ring",
+            "run ONLY one big ring allreduce and exit immediately — no "
+            "trailing barrier, so fast ranks leave the fabric while a "
+            "delayed rank is still mid-ring (clean-early-exit coverage "
+            "with DLNB_TEST_RING_FINAL_RECV_DELAY_MS)");
   args.parse(argc, argv);
   int world = static_cast<int>(args.integer("world"));
   int rank = static_cast<int>(args.integer("rank"));
@@ -37,6 +42,22 @@ int main(int argc, char** argv) {
   try {
     TcpFabric fab(args.str("coordinator"), world, rank, DType::F32);
     auto comm = fab.world_comm(rank);
+
+    if (args.flag_set("final_ring")) {
+      const std::int64_t big = 40001;  // >= ring threshold, odd tail
+      Tensor src(big, DType::F32), dst(big, DType::F32);
+      for (std::int64_t i = 0; i < big; ++i)
+        src.set(static_cast<std::size_t>(i),
+                static_cast<float>(rank + (i % 7)));
+      comm->Allreduce(src.data(), dst.data(), big);
+      for (std::int64_t i : {std::int64_t{0}, big / 2, big - 1}) {
+        float expect = static_cast<float>(
+            world * (world - 1) / 2 + world * (i % 7));
+        REQUIRE(dst.get(static_cast<std::size_t>(i)) == expect);
+      }
+      std::printf("tcp_selftest rank %d OK\n", rank);
+      return 0;
+    }
 
     // allreduce: sum of (r+1) over ranks
     {

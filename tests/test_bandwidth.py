@@ -55,6 +55,52 @@ def test_multi_component_two_level_sync():
     assert r["group_size"] == 4
 
 
+def test_legacy_hierarchical_records_refused():
+    """Records from the r3 gather-based hier DCN legs (dcn_algo
+    'hierarchical') moved bytes no real DCN algorithm moves: busbw must
+    be refused (NaN, bound marker), never published with ring/mesh
+    correction factors (ADVICE r3 medium)."""
+    rec = _record({"comm": [
+        {"kind": "alltoall", "group": 8, "bytes": 4000}]},
+        {"comm": [2.0]})
+    rec["global"]["dcn_algo"] = "hierarchical"
+    rec["global"]["tcp_ring_threshold_bytes"] = 65536
+    bw = effective_bandwidth([rec])
+    import math
+    assert bw.iloc[0]["bound"] == "hierarchical"
+    assert math.isnan(bw.iloc[0]["busbw_GBps"])
+    assert bw.iloc[0]["algbw_GBps"] > 0  # algbw is still honest
+
+
+def test_blocked_hier_records_admissible_with_threshold():
+    """Current hier records (dcn_algo 'blocked') are bandwidth-true:
+    busbw applies.  The small-allreduce full-mesh refusal keys on the
+    PROCESS mesh width (the DCN leg), not the group size."""
+    import math
+
+    def hier_rec(bytes_, nprocs):
+        rec = _record({"comm": [
+            {"kind": "allreduce", "group": 8, "bytes": bytes_}]},
+            {"comm": [5.0]})
+        rec["global"]["dcn_algo"] = "blocked"
+        rec["global"]["num_processes"] = nprocs
+        rec["global"]["tcp_ring_threshold_bytes"] = 65536
+        return rec
+
+    # large allreduce: ring on the DCN leg -> admissible
+    big = effective_bandwidth([hier_rec(1 << 20, 4)])
+    assert big.iloc[0]["bound"] == "exact"
+    assert big.iloc[0]["busbw_GBps"] > 0
+    # small allreduce over >2 processes: DCN full mesh -> refused
+    small = effective_bandwidth([hier_rec(4000, 4)])
+    assert small.iloc[0]["bound"] == "fullmesh"
+    assert math.isnan(small.iloc[0]["busbw_GBps"])
+    # 2 processes: mesh == ring at n=2 -> admissible even when small
+    two = effective_bandwidth([hier_rec(4000, 2)])
+    assert two.iloc[0]["bound"] == "exact"
+    assert two.iloc[0]["busbw_GBps"] > 0
+
+
 def test_zero_time_and_missing_model_skipped():
     rec = _record({"barrier_time": [
         {"kind": "allreduce", "group": 8, "bytes": 100}]},

@@ -81,6 +81,39 @@ def test_merge_tolerates_per_process_measured_globals():
     validate_record(merged)
 
 
+def test_merge_dedupes_cohosted_energy():
+    """energy_consumed brackets a HOST counter: with two processes on one
+    host (--procs runs, co-hosted congestion pairs) both record the same
+    RAPL/hwmon device, and the merge must keep ONE energy row per
+    hostname (lowest process wins) so Pareto/averages don't double-count
+    (ADVICE r3).  Distinct hosts keep their rows."""
+    def with_energy(rec, proc, host):
+        for row in rec["ranks"]:
+            row["hostname"] = host
+        first = min((r for r in rec["ranks"]
+                     if r["process_index"] == proc),
+                    key=lambda r: r["rank"])
+        first["energy_consumed"] = [5.0 + proc, 6.0 + proc]
+        return rec
+
+    # co-hosted: processes 0 and 1 share "hostA"
+    merged = merge_records([
+        with_energy(_proc_record(0), 0, "hostA"),
+        with_energy(_proc_record(1), 1, "hostA"),
+    ])
+    rows = [r for r in merged["ranks"] if "energy_consumed" in r]
+    assert len(rows) == 1 and rows[0]["process_index"] == 0
+    assert rows[0]["energy_consumed"] == [5.0, 6.0]
+
+    # distinct hosts: both rows survive
+    merged = merge_records([
+        with_energy(_proc_record(0), 0, "hostA"),
+        with_energy(_proc_record(1), 1, "hostB"),
+    ])
+    rows = [r for r in merged["ranks"] if "energy_consumed" in r]
+    assert len(rows) == 2
+
+
 def test_merge_rejects_mismatched_num_runs():
     bad = _proc_record(1)
     bad["num_runs"] = 5
