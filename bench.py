@@ -15,7 +15,6 @@ the final line; the fp8 result also rides inside it as "fp8_mlp"):
 """
 from __future__ import annotations
 
-import dataclasses
 import json
 import statistics
 import sys
@@ -23,17 +22,13 @@ import sys
 import jax
 import jax.numpy as jnp
 
-BATCH = 2
-SEQ = 6144     # long enough that the Pallas flash-attention path engages
-LAYERS = 4
-VOCAB = 32768
+from dlnetbench_tpu.models.bench_step import BATCH, SEQ, LAYERS, VOCAB
 
 
 def main() -> int:
     from dlnetbench_tpu.core.hardware import HARDWARE
-    from dlnetbench_tpu.core.model_card import ModelCard, load_model_card
     from dlnetbench_tpu.core import roofline
-    from dlnetbench_tpu.models import transformer as tfm
+    from dlnetbench_tpu.models import bench_step
     from dlnetbench_tpu.utils.timing import time_callable
 
     dev = jax.devices()[0]
@@ -42,13 +37,6 @@ def main() -> int:
     hw_key = next((k for k in HARDWARE
                    if k.startswith("tpu") and k.replace("tpu_", "") in kind),
                   "tpu_v5e")
-
-    base = load_model_card("llama3_8b")
-    card = ModelCard(name="llama3_8b_bench", embed_dim=base.embed_dim,
-                     num_heads=base.num_heads, num_kv_heads=base.num_kv_heads,
-                     ff_dim=base.ff_dim, seq_len=SEQ,
-                     num_decoder_blocks=LAYERS, vocab_size=VOCAB,
-                     gated_mlp=True)
     # r3 accounting fixes: (1) vs_baseline_causal divides the credited
     # S^2 score FLOPs by 2 (the flash kernel executes only the causal
     # half); (2) the LM-head logits matmul is credited (see below) —
@@ -84,24 +72,16 @@ def main() -> int:
     # splits fusions XLA had right), B=2 S=2048 (0.66), B=1 S=8192
     # (0.68, half the tokens), B=1 S=12288 / B=2 S=8192 / B=4 S=4096 /
     # B=2 S=7168 with the VMEM option (OOM).
-    cfg = dataclasses.replace(tfm.TransformerConfig.from_card(card),
-                              scan_layers=False, logits_f32=False)
-
-    params = tfm.init_params(jax.random.key(0), cfg)
-    tokens = jax.random.randint(jax.random.key(1), (BATCH, SEQ + 1), 0, VOCAB)
-
+    # r4 perf attempts on the dominant backward bucket, all paired A/B
+    # on-chip (docs/PERF.md r4): split-dot custom VJP 0.9975 (neutral),
+    # fused Pallas dg/du + dWd kernels 1.012 (slower), bare same-shape
+    # dots 0.992 of peak in isolation — XLA's backward schedule is at
+    # the wall; mlp_backward stays "fused".
+    # The step itself is built by models/bench_step.py, SHARED with
+    # examples/xla_knob_study.py so compiler-knob sweeps tune exactly
+    # this program.
     K = 10  # train steps chained inside ONE program
-
-    def train_k_fn(p, t):
-        # K optimizer steps under a single dispatch: on the tunnel backend
-        # every dispatch costs ~2-7 ms of host->device latency that a real
-        # training loop (async dispatch, local runtime) never serializes
-        # on; chaining measures the DEVICE, not the tunnel
-        def body(p, _):
-            loss, g = jax.value_and_grad(tfm.loss_fn)(p, t, cfg)
-            p = jax.tree.map(lambda a, b: a - 1e-3 * b.astype(a.dtype), p, g)
-            return p, loss
-        return jax.lax.scan(body, p, None, length=K)
+    train_k_fn, params, tokens, card, cfg = bench_step.build(K)
 
     # per-compile compiler option (env XLA_FLAGS can't carry backend
     # flags through the tunnel's compile helper; compiler_options can);
@@ -157,6 +137,19 @@ def main() -> int:
     executed_ratio = (fwd_flops - causal_elided) / fwd_flops
     vs_baseline_causal = vs_baseline * executed_ratio
 
+    # Backward-aware baseline (VERDICT r3 #4): same credited FLOPs, but
+    # the divisor prices the step's explicit traffic — weights x3,
+    # working set x3, PLUS the saved-residual round trip (the [B,S,ff]
+    # g/u pre-activations autodiff stores) — instead of scaling the
+    # forward's AI by 3 (roofline.train_step_bytes).  At this shape the
+    # step is deeply compute-bound either way (AI thousands vs a ~240
+    # FLOP/B ridge), so if this key matches vs_baseline, none of the
+    # residual gap was byte-model flattery.
+    step_bytes_bwd = roofline.train_step_bytes(card, BATCH, "bfloat16")
+    roofline_bwd_s = roofline.roofline_time_s(
+        total_flops, step_bytes_bwd, HARDWARE[hw_key], "bfloat16")
+    vs_baseline_bwd_aware = roofline_bwd_s / step_s
+
     # fp8 line FIRST so the headline train-step line stays LAST on
     # stdout (tail parsers take the final JSON line); its result also
     # rides inside the headline object for first-line parsers
@@ -169,6 +162,7 @@ def main() -> int:
         "unit": "ms",
         "vs_baseline": round(vs_baseline, 4),
         "vs_baseline_causal": round(vs_baseline_causal, 4),
+        "vs_baseline_bwd_aware": round(vs_baseline_bwd_aware, 4),
         # r1/r2's decoder-only accounting (LM-head time spent but its
         # flops uncredited) — kept so rounds stay comparable
         "vs_baseline_decoder_only": round(roofline_dec_s / step_s, 4),

@@ -34,6 +34,9 @@ _KEYMAP = {
     "device": "device",
     "dtype": "dtype",
     "bytes_per_element": "bytes_per_element",
+    # backward-aware step roofline (r4, core/roofline.py
+    # train_step_time_s): absent from reference-era files, parsed as 0
+    "train_step_time (us)": "step_us",
 }
 
 _REQUIRED = {"forward_flops", "backward_flops", "model_size", "fwd_us",
@@ -58,6 +61,9 @@ class ModelStats:
     experts: int = 1
     device: str = "unknown"
     bytes_per_element: float = 2.0
+    # backward-aware step roofline (weights x3 + saved-residual round
+    # trip, core/roofline.py train_step_bytes); 0 in files predating r4
+    step_us: float = 0.0
 
     @property
     def model_bytes(self) -> int:
@@ -84,6 +90,8 @@ class ModelStats:
             f"Dtype:{self.dtype}",
             f"Bytes_per_element:{self.bytes_per_element}",
         ]
+        if self.step_us:
+            lines.append(f"Train_Step_Time (us):{self.step_us:.2f}")
         return "\n".join(lines) + "\n"
 
 
@@ -127,6 +135,7 @@ def parse_stats_text(name: str, text: str) -> ModelStats:
         device=found.get("device", "unknown"),
         dtype=found["dtype"],
         bytes_per_element=_f("bytes_per_element", 2.0),
+        step_us=_f("step_us", 0.0),
     )
 
 

@@ -66,6 +66,41 @@ def roofline_time_s(flops: int, nbytes: int, hw: HardwareSpec, dtype: str) -> fl
     return flops / achievable
 
 
+def train_step_bytes(card: ModelCard, batch: int, dtype: str) -> int:
+    """Backward-aware HBM traffic of one full train step (fwd + bwd).
+
+    The forward-scaled convention (step = 3 x forward roofline via the
+    reference's bwd/fwd=2, python/model_stats.py:140) implicitly prices
+    step traffic at 3 x (weights + working activations).  Counting the
+    backward explicitly reproduces that aggregate for weights and the
+    working set — forward reads W, the dx pass re-reads W, the dW pass
+    writes W; the activation working set flows once per pass — but it
+    MISSES the saved-residual round trip: the tensors autodiff stores
+    in forward and re-reads in backward.  Dominant among those are the
+    gated MLP's two [B, N, ff] pre-activations (g, u) per layer —
+    ff/d x larger than the d-sized working set the 8*B*N*d estimate
+    covers — plus ~4 d-sized attention saves per layer.
+    """
+    bpe = BYTES_PER_ELEMENT[dtype]
+    base = 3 * model_bytes(card, batch, dtype)
+    n_pre = 2 if card.gated_mlp else 1
+    mlp_saved = n_pre * batch * card.seq_len * card.ff_dim * card.top_k
+    attn_saved = 4 * batch * card.seq_len * card.embed_dim
+    saved_round_trip = 2 * card.num_layers * (mlp_saved + attn_saved) * bpe
+    return int(base + saved_round_trip)
+
+
+def train_step_time_s(card: ModelCard, batch: int, dtype: str,
+                      device: str) -> float:
+    """Backward-aware roofline time of one train step: the same
+    min(peak, AI*BW) model with the step's own FLOPs and the explicit
+    step traffic (train_step_bytes) instead of 3 x the forward's AI."""
+    hw = HARDWARE[device]
+    flops = int(model_flops(card, batch) * (1.0 + BWD_FWD_RATIO))
+    return roofline_time_s(flops, train_step_bytes(card, batch, dtype),
+                           hw, dtype)
+
+
 def forward_time_s(card: ModelCard, batch: int, dtype: str, device: str) -> float:
     hw = HARDWARE[device]
     return roofline_time_s(model_flops(card, batch),

@@ -1,0 +1,64 @@
+"""The headline bench train step, in ONE place.
+
+``bench.py`` (the driver-run headline) and
+``examples/xla_knob_study.py`` (the compiler-knob sweep) must measure
+the SAME program — a sweep winner tuned for a drifted copy of the step
+would be adopted into a different program than it was measured on.
+Both build their step through this module.
+
+Recipe rationale (shapes, remat, scan, logits dtype, VMEM option) is
+documented at the call site in bench.py, where the measured history
+lives.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+BATCH, SEQ, LAYERS, VOCAB = 2, 6144, 4, 32768
+
+
+def bench_card():
+    from dlnetbench_tpu.core.model_card import ModelCard, load_model_card
+    base = load_model_card("llama3_8b")
+    return ModelCard(name="llama3_8b_bench", embed_dim=base.embed_dim,
+                     num_heads=base.num_heads,
+                     num_kv_heads=base.num_kv_heads, ff_dim=base.ff_dim,
+                     seq_len=SEQ, num_decoder_blocks=LAYERS,
+                     vocab_size=VOCAB, gated_mlp=True)
+
+
+def bench_cfg(card, **overrides):
+    from dlnetbench_tpu.models import transformer as tfm
+    return dataclasses.replace(tfm.TransformerConfig.from_card(card),
+                               scan_layers=False, logits_f32=False,
+                               **overrides)
+
+
+def make_train_k(cfg, k: int):
+    """K optimizer steps chained in one program: on the tunnel backend
+    every dispatch costs ~2-7 ms of host->device latency a real
+    training loop never serializes on; chaining measures the DEVICE."""
+    from dlnetbench_tpu.models import transformer as tfm
+
+    def train_k(p, t):
+        def body(p, _):
+            loss, g = jax.value_and_grad(tfm.loss_fn)(p, t, cfg)
+            p = jax.tree.map(lambda a, b: a - 1e-3 * b.astype(a.dtype),
+                             p, g)
+            return p, loss
+        return jax.lax.scan(body, p, None, length=k)
+    return train_k
+
+
+def build(k: int = 10, **cfg_overrides):
+    """(train_k_fn, params, tokens, card, cfg) at the bench shape."""
+    import jax.numpy as jnp  # noqa: F401  (jax initialized before use)
+    from dlnetbench_tpu.models import transformer as tfm
+    card = bench_card()
+    cfg = bench_cfg(card, **cfg_overrides)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (BATCH, SEQ + 1), 0,
+                                VOCAB)
+    return make_train_k(cfg, k), params, tokens, card, cfg

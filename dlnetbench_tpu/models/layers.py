@@ -100,18 +100,85 @@ def attention(q, k, v, causal: bool):
     return out.reshape(b, s, hq, dh).astype(v.dtype)
 
 
-def swiglu(x, w_gate, w_up, w_down):
-    # Round each projection to the compute dtype IMMEDIATELY so the
-    # residuals autodiff saves for backward are bf16, not f32 (the MXU
-    # still accumulates in f32; silu stays f32 elementwise and fuses).
-    # Measured perf-neutral on v5e at B=2 S=2048 — the save traffic
-    # overlaps MXU work — but it halves activation memory, which is what
-    # lets larger B/S fit without remat.
+def swiglu_fwd_res(x, w_gate, w_up, w_down):
+    """The SwiGLU forward, returning (y, residuals): the ONE place the
+    three-dot body lives — the autodiff path (swiglu), the split-dot
+    VJP below, and the Pallas VJP (ops/mlp_backward.py) all call it, so
+    the bf16 rounding discipline cannot silently diverge between the
+    variants that are A/B'd against each other.
+
+    Rounds each projection to the compute dtype IMMEDIATELY so the
+    saved residuals are bf16, not f32 (the MXU still accumulates in
+    f32; silu stays f32 elementwise and fuses).  Measured perf-neutral
+    on v5e at B=2 S=2048 — the save traffic overlaps MXU work — but it
+    halves activation memory, which is what lets larger B/S fit without
+    remat.
+    """
     g = jnp.dot(x, w_gate, preferred_element_type=_F32).astype(x.dtype)
     u = jnp.dot(x, w_up, preferred_element_type=_F32).astype(x.dtype)
     h = (jax.nn.silu(g.astype(_F32)) * u.astype(_F32)).astype(g.dtype)
-    return jnp.dot(h, w_down,
-                   preferred_element_type=_F32).astype(x.dtype)
+    y = jnp.dot(h, w_down, preferred_element_type=_F32).astype(x.dtype)
+    return y, (x, g, u, w_gate, w_up, w_down)
+
+
+def swiglu(x, w_gate, w_up, w_down):
+    return swiglu_fwd_res(x, w_gate, w_up, w_down)[0]
+
+
+@jax.custom_vjp
+def swiglu_split_bwd(x, w_gate, w_up, w_down):
+    """SwiGLU whose BACKWARD is hand-structured: six pure dot_generals
+    with the silu-gradient elementwise pass isolated behind
+    optimization barriers.
+
+    Why: on v5e, XLA's autodiff backward for this block compiles to
+    generic matmul fusions measured at ~0.80 of the bf16 MXU peak
+    (docs/PERF.md r3 budget), while the same-shape PURE dots run at
+    0.99 of peak (r4 experiment).  Keeping the elementwise work out of
+    the matmuls' fusions trades a small explicit HBM round trip of the
+    [T, ff] tensors (~2 ms/layer at bench shape) for matmuls that the
+    compiler schedules at full rate (~9 ms/layer at bench shape).
+    Forward is the same three dots as ``swiglu``; residuals saved are
+    bf16 (x, g, u), matching swiglu's memory discipline.
+    """
+    return swiglu(x, w_gate, w_up, w_down)
+
+
+def _swiglu_split_fwd(x, w_gate, w_up, w_down):
+    return swiglu_fwd_res(x, w_gate, w_up, w_down)
+
+
+def _swiglu_split_bwd(res, dy):
+    x, g, u, w_gate, w_up, w_down = res
+    t_nk = (((1,), (1,)), ((), ()))   # a @ b^T  (contract both dim 1)
+    t_km = (((0,), (0,)), ((), ()))   # a^T @ b  (contract both dim 0)
+    # dh = dy @ Wd^T — a pure dot; the barrier keeps the elementwise
+    # silu-grad block below OUT of its fusion
+    dh = jax.lax.dot_general(dy, w_down, t_nk,
+                             preferred_element_type=_F32)
+    (dh,) = jax.lax.optimization_barrier((dh,))
+    gf = g.astype(_F32)
+    sig = jax.nn.sigmoid(gf)
+    silu = gf * sig
+    dg = (dh * u.astype(_F32) * (sig + silu * (1.0 - sig))).astype(g.dtype)
+    du = (dh * silu).astype(u.dtype)
+    h = (silu * u.astype(_F32)).astype(g.dtype)
+    dg, du, h = jax.lax.optimization_barrier((dg, du, h))
+    dx = (jax.lax.dot_general(dg, w_gate, t_nk,
+                              preferred_element_type=_F32)
+          + jax.lax.dot_general(du, w_up, t_nk,
+                                preferred_element_type=_F32)).astype(x.dtype)
+    dwg = jax.lax.dot_general(x, dg, t_km,
+                              preferred_element_type=_F32)
+    dwu = jax.lax.dot_general(x, du, t_km,
+                              preferred_element_type=_F32)
+    dwd = jax.lax.dot_general(h, dy, t_km,
+                              preferred_element_type=_F32)
+    return (dx, dwg.astype(w_gate.dtype), dwu.astype(w_up.dtype),
+            dwd.astype(w_down.dtype))
+
+
+swiglu_split_bwd.defvjp(_swiglu_split_fwd, _swiglu_split_bwd)
 
 
 def gelu_mlp(x, w_in, b_in, w_out, b_out):

@@ -67,6 +67,15 @@ class TransformerConfig:
                              # style: ~k*cf*T*ffn FLOPs, over-capacity
                              # tokens dropped — the production semantics)
     moe_capacity_factor: float = 1.25
+    mlp_backward: str = "fused"    # SwiGLU backward: "fused" = plain
+                             # autodiff (the r4-measured winner);
+                             # "split" = pure dots behind barriers
+                             # (layers.swiglu_split_bwd, 0.9975 paired
+                             # ratio — noise); "pallas" = fused dg/du +
+                             # dWd kernels (ops/mlp_backward.py, 1.012 —
+                             # slower).  All three measured end-to-end
+                             # on v5e; docs/PERF.md r4 records why the
+                             # XLA schedule is already at the wall
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots"):
@@ -83,6 +92,18 @@ class TransformerConfig:
             raise ValueError(
                 "mlp_dtype='float8' currently covers the dense SwiGLU "
                 "path only")
+        if self.mlp_backward not in ("split", "fused", "pallas"):
+            raise ValueError(f"unknown mlp_backward {self.mlp_backward!r}; "
+                             f"expected 'split', 'fused' or 'pallas'")
+        if self.mlp_backward != "fused" and (self.num_experts > 1
+                                             or self.mlp_dtype == "float8"
+                                             or not self.gated):
+            # the MoE / fp8 / gelu branches would win the dispatch and
+            # silently measure the WRONG backward in an A/B
+            raise ValueError(
+                f"mlp_backward={self.mlp_backward!r} covers the dense "
+                f"bf16 SwiGLU path only (MoE, float8 and non-gated MLPs "
+                f"dispatch elsewhere)")
 
     @classmethod
     def from_card(cls, card: ModelCard, *, seq_len: int | None = None,
@@ -203,6 +224,15 @@ def _block(cfg: TransformerConfig, x, lp, positions):
         elif cfg.mlp_dtype == "float8":
             from dlnetbench_tpu.ops.fp8 import swiglu_fp8
             y2 = swiglu_fp8(y, lp["w_gate"], lp["w_up"], lp["w_down"])
+        elif cfg.mlp_backward == "pallas":
+            from dlnetbench_tpu.ops.mlp_backward import swiglu_pallas_bwd
+            y2 = swiglu_pallas_bwd(
+                y.reshape(b * s, d), lp["w_gate"], lp["w_up"],
+                lp["w_down"]).reshape(b, s, d)
+        elif cfg.mlp_backward == "split":
+            y2 = L.swiglu_split_bwd(
+                y.reshape(b * s, d), lp["w_gate"], lp["w_up"],
+                lp["w_down"]).reshape(b, s, d)
         else:
             y2 = L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
     else:

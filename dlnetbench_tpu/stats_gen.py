@@ -33,6 +33,7 @@ def generate_stats(card: ModelCard, batch: int, dtype: str,
     fwd_flops = roofline.model_flops(card, batch)
     fwd_s = roofline.forward_time_s(card, batch, dtype, device)
     ffn_fwd_s = roofline.ffn_forward_time_s(card, batch, dtype, device)
+    step_s = roofline.train_step_time_s(card, batch, dtype, device)
     return ModelStats(
         name=f"{card.name}_{batch}_{dtype}",
         forward_flops=fwd_flops,
@@ -50,6 +51,7 @@ def generate_stats(card: ModelCard, batch: int, dtype: str,
         device=HARDWARE[device].name,
         dtype=dtype,
         bytes_per_element=BYTES_PER_ELEMENT[dtype],
+        step_us=step_s * 1e6,
     )
 
 

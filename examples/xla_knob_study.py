@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""XLA compiler-knob sweep over the headline train step — the TPU
+analogue of the reference's NCCL tuning-space study (reference
+plots/plot_dp.py:23-26 sweeps protocol x algorithm x threads x
+channels and Pareto-plots the result; on one TPU chip the tunable
+surface is the XLA compile, reached through per-compile
+``compiler_options``).
+
+Knobs (>=2 axes x >=3 values, VERDICT r3 #5):
+  * xla_tpu_scoped_vmem_limit_kib: 16 MiB (compiler default) / 24 / 32
+    (the r2 winner) / 48 / 64 MiB — how much VMEM the scheduler may
+    dedicate to one fusion's tiles;
+  * xla_tpu_enable_latency_hiding_scheduler: on/off — the scheduler
+    that overlaps DMA with compute across ops.
+
+Each point recompiles the SAME train step (bench.py shape) and runs
+K-chained measured rounds; output is a table + CSV, and the winner is
+adopted into bench.py or declined with numbers (docs/PERF.md).
+
+    python examples/xla_knob_study.py --out_dir docs/studies/xla_knob_sweep
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+VMEM_KIB = (16384, 24576, 32768, 49152, 65536)
+LHS = ("default", "on", "off")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out_dir", type=Path,
+                    default=Path("/tmp/xla_knob_sweep"))
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--k", type=int, default=10,
+                    help="train steps chained per program")
+    args = ap.parse_args()
+    args.out_dir.mkdir(parents=True, exist_ok=True)
+
+    import jax
+
+    from dlnetbench_tpu.models import bench_step
+    from dlnetbench_tpu.utils.timing import time_callable
+
+    if jax.default_backend() != "tpu":
+        print("needs the real TPU backend (compiler_options are "
+              "TPU-compiler flags)", file=sys.stderr)
+        return 1
+
+    # EXACTLY the headline step (shared builder — a sweep winner tuned
+    # on a drifted copy would be adopted into a different program)
+    K = args.k
+    train_k, params, tokens, _card, _cfg = bench_step.build(K)
+
+    rows = []
+    points = list(itertools.product(VMEM_KIB, LHS))
+    for idx, (vmem, lhs) in enumerate(points):
+        opts = {"xla_tpu_scoped_vmem_limit_kib": str(vmem)}
+        if lhs != "default":
+            opts["xla_tpu_enable_latency_hiding_scheduler"] = (
+                "true" if lhs == "on" else "false")
+        label = f"vmem={vmem//1024}MiB lhs={lhs}"
+        t0 = time.time()
+        try:
+            f = jax.jit(train_k, compiler_options=opts)
+            _, losses = f(params, tokens)
+            losses[-1].item()
+        except Exception as e:  # an unknown/rejected flag combination
+            print(f"[{idx+1}/{len(points)}] {label}: compile FAILED "
+                  f"({type(e).__name__}: {str(e)[:120]})", flush=True)
+            rows.append({"vmem_kib": vmem, "lhs": lhs,
+                         "step_ms": None, "error": str(e)[:200]})
+            continue
+        compile_s = time.time() - t0
+        samples = [t / K for t in
+                   time_callable(f, params, tokens, reps=args.reps)]
+        step_ms = statistics.median(samples) * 1e3
+        print(f"[{idx+1}/{len(points)}] {label}: {step_ms:.1f} ms "
+              f"(compile {compile_s:.0f}s, spread "
+              f"{(max(samples)-min(samples))*1e3:.1f} ms)", flush=True)
+        rows.append({"vmem_kib": vmem, "lhs": lhs,
+                     "step_ms": round(step_ms, 2),
+                     "compile_s": round(compile_s, 1)})
+
+    out = args.out_dir / "xla_knob_sweep.json"
+    out.write_text(json.dumps(rows, indent=1))
+    ok = [r for r in rows if r.get("step_ms")]
+    if ok:
+        best = min(ok, key=lambda r: r["step_ms"])
+        print(f"\nbest: vmem={best['vmem_kib']//1024}MiB "
+              f"lhs={best['lhs']} at {best['step_ms']} ms")
+        print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

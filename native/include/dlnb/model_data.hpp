@@ -36,6 +36,9 @@ struct ModelStats {
   std::int64_t experts = 1;
   std::string device = "unknown";
   double bytes_per_element = 2.0;
+  // backward-aware step roofline (core/roofline.py train_step_time_s);
+  // 0 in files predating r4
+  double step_us = 0.0;
 
   std::int64_t model_bytes() const {
     return static_cast<std::int64_t>(model_size * bytes_per_element);
@@ -112,6 +115,7 @@ inline ModelStats parse_model_stats(const std::string& text,
       else if (key == "device") st.device = val;
       else if (key == "dtype") st.dtype = val;
       else if (key == "bytes_per_element") st.bytes_per_element = std::stod(val);
+      else if (key == "train_step_time (us)") st.step_us = std::stod(val);
       // unknown keys ignored: files may grow fields
     } catch (const std::exception&) {
       throw std::runtime_error("stats '" + name + "': bad value for key '" +
