@@ -7,9 +7,11 @@ roofline — the same ``min(peak, AI*BW)`` model the stat-file generator
 uses (reference python/model_stats.py:47-50, re-derived for TPU in
 core/roofline.py).
 
-Prints TWO JSON lines: first the fp8 MLP-matmul line (its own fp8
-roofline ratio), LAST the headline train-step line (tail parsers read
-the final line; the fp8 result also rides inside it as "fp8_mlp"):
+Prints the auxiliary low-precision JSON lines first — fp8 MLP matmul,
+fp8 swiglu stage-chain, int8 matmul, each against the chip's OWN
+low-precision roofline — and LAST the headline train-step line (tail
+parsers read the final line; the auxiliary results also ride inside it
+as "fp8_mlp" / "fp8_swiglu" / "int8_matmul"):
   {"metric": ..., "value": <step ms>, "unit": "ms",
    "vs_baseline": <achieved/roofline, 1.0 = roofline-perfect>, ...}
 """
@@ -23,6 +25,30 @@ import jax
 import jax.numpy as jnp
 
 from dlnetbench_tpu.models.bench_step import BATCH, SEQ, LAYERS, VOCAB
+
+
+def _measure_chain(fn, arg, k: int) -> float:
+    """jit + compile + TRUE fence (a device->host transfer — on the
+    tunnel backend block_until_ready only acks dispatch), then median
+    of 3 K-chained rounds, per-iteration seconds.  Shared by every
+    auxiliary bench line so fence/timing fixes happen once."""
+    from dlnetbench_tpu.utils.timing import time_callable
+    j = jax.jit(fn)
+    out = j(arg)
+    _ = out[0, 0].item() if hasattr(out[0, 0], "item") else int(out[0, 0])
+    return statistics.median(time_callable(j, arg, reps=3)) / k
+
+
+def _roofline_s(flops: int, nbytes: int, hw, dtype_key: str) -> float:
+    """min(peak, AI*BW) time for a measured kernel — one definition for
+    every auxiliary line."""
+    ai = flops / max(nbytes, 1)
+    achievable = min(hw.peak(dtype_key), ai * hw.hbm_bandwidth)
+    return flops / achievable
+
+
+def _skipped(metric: str, why: str) -> None:
+    print(json.dumps({"metric": metric, "skipped": why}))
 
 
 def main() -> int:
@@ -150,10 +176,12 @@ def main() -> int:
         total_flops, step_bytes_bwd, HARDWARE[hw_key], "bfloat16")
     vs_baseline_bwd_aware = roofline_bwd_s / step_s
 
-    # fp8 line FIRST so the headline train-step line stays LAST on
-    # stdout (tail parsers take the final JSON line); its result also
-    # rides inside the headline object for first-line parsers
+    # auxiliary lines FIRST so the headline train-step line stays LAST
+    # on stdout (tail parsers take the final JSON line); results also
+    # ride inside the headline object for first-line parsers
     fp8 = _bench_fp8_mlp(card, hw_key, dev)
+    fp8_chain = _bench_fp8_swiglu_chain(card, hw_key, dev)
+    int8 = _bench_int8_matmul(card, hw_key, dev)
 
     print(json.dumps({
         "metric": f"llama3_8b-shaped {LAYERS}L train step, B={BATCH} S={SEQ}, "
@@ -171,6 +199,8 @@ def main() -> int:
         "loss": round(float(loss), 4),
         "logits_dtype": "float32" if cfg.logits_f32 else "bfloat16",
         **({"fp8_mlp": fp8} if fp8 else {}),
+        **({"fp8_swiglu": fp8_chain} if fp8_chain else {}),
+        **({"int8_matmul": int8} if int8 else {}),
     }))
     return 0
 
@@ -196,14 +226,13 @@ def _bench_fp8_mlp(card, hw_key: str, dev) -> dict | None:
 
     from dlnetbench_tpu.core.hardware import BYTES_PER_ELEMENT, HARDWARE
     from dlnetbench_tpu.ops.fp8 import fp8_dot
-    from dlnetbench_tpu.utils.timing import time_callable
 
     hw = HARDWARE[hw_key]
     try:
         fp8_peak = hw.peak("float8")
     except ValueError:
-        print(json.dumps({"metric": f"fp8 mlp matmul ({hw_key})",
-                          "skipped": f"{hw_key} has no float8 peak"}))
+        _skipped(f"fp8 mlp matmul ({hw_key})",
+                 f"{hw_key} has no float8 peak")
         return None
 
     tokens, d = BATCH * SEQ, card.embed_dim
@@ -217,19 +246,13 @@ def _bench_fp8_mlp(card, hw_key: str, dev) -> dict | None:
             return fp8_dot(xc, w).astype(xc.dtype), ()
         return jax.lax.scan(body, x0, None, length=K)[0]
 
-    f_jit = jax.jit(chain)
-    f_jit(x)[0, 0].item()  # compile + true fence (block_until_ready only
-                           # acks dispatch on the tunnel backend)
-    samples = [t / K for t in time_callable(f_jit, x, reps=3)]
-    t_s = statistics.median(samples)
+    t_s = _measure_chain(chain, x, K)
 
     flops = 2 * tokens * d * d
     # bytes per matmul: e4m3 operand reads + bf16 output write
     nbytes = int(BYTES_PER_ELEMENT["float8"] * (tokens * d + d * d)
                  + BYTES_PER_ELEMENT["bfloat16"] * tokens * d)
-    ai = flops / nbytes
-    achievable = min(fp8_peak, ai * hw.hbm_bandwidth)
-    roofline_s = flops / achievable
+    roofline_s = _roofline_s(flops, nbytes, hw, "float8")
     line = {
         "metric": f"fp8(e4m3) mlp-projection matmul, {tokens} tok D={d}, "
                   f"{dev.device_kind} ({hw_key}, fp8 peak "
@@ -238,6 +261,128 @@ def _bench_fp8_mlp(card, hw_key: str, dev) -> dict | None:
         "unit": "ms",
         "vs_baseline": round(roofline_s / t_s, 4),
         "tflops_achieved": round(flops / t_s / 1e12, 2),
+    }
+    print(json.dumps(line))
+    return line
+
+
+def _bench_fp8_swiglu_chain(card, hw_key: str, dev) -> dict | None:
+    """The REAL ``swiglu_fp8`` path, stage by stage (VERDICT r3 #7a).
+
+    Multi-matmul fp8 jit bodies hit the toolchain's compile pathology
+    (>9 min for the full chain; r4 showed the same for bf16 pairs), so
+    each of the three projections is measured as its OWN chained
+    program — the same fp8_dot the model executes, exact bench shapes,
+    quantization included — and the stage medians are summed.  The
+    elementwise silu*u between stages is covered by the headline step's
+    profile (VPU work that overlaps) and is not separately billed; the
+    metric text says exactly what is summed.
+    """
+    import jax.numpy as jnp
+
+    from dlnetbench_tpu.core.hardware import BYTES_PER_ELEMENT, HARDWARE
+    from dlnetbench_tpu.ops.fp8 import fp8_dot
+
+    hw = HARDWARE[hw_key]
+    try:
+        fp8_peak = hw.peak("float8")
+    except ValueError:
+        _skipped(f"fp8 swiglu chain ({hw_key})",
+                 f"{hw_key} has no float8 peak")
+        return None
+
+    tokens, d, f = BATCH * SEQ, card.embed_dim, card.ff_dim
+    x = jax.random.normal(jax.random.key(5), (tokens, d), jnp.bfloat16)
+    wg = jax.random.normal(jax.random.key(6), (d, f), jnp.bfloat16) * 0.02
+    wd = jax.random.normal(jax.random.key(7), (f, d), jnp.bfloat16) * 0.02
+    h0 = jax.random.normal(jax.random.key(8), (tokens, f), jnp.bfloat16)
+
+    K = 8
+
+    def up_chain(x0):   # gate and up are the same (T,D)@(D,F) stage
+        def body(xc, _):
+            y = fp8_dot(xc, wg)
+            # feed a slice back so the dot cannot be loop-hoisted
+            return (xc + y[:, :d] * 1e-6).astype(xc.dtype), ()
+        return jax.lax.scan(body, x0, None, length=K)[0]
+
+    def down_chain(h):  # (T,F)@(F,D)
+        def body(hc, _):
+            y = fp8_dot(hc, wd)
+            # the full (T,D) result feeds the carry — a scalar-only
+            # dependency could legally let XLA shrink the dot to a
+            # slice and void the measurement
+            return hc.at[:, :d].add(y.astype(hc.dtype) * 1e-6), ()
+        return jax.lax.scan(body, h, None, length=K)[0]
+
+    # chain total: gate + up (two identical stages) + down
+    t_s = (2 * _measure_chain(up_chain, x, K)
+           + _measure_chain(down_chain, h0, K))
+
+    flops = 6 * tokens * d * f  # three T*D*F matmuls
+    nbytes = int(BYTES_PER_ELEMENT["float8"]
+                 * (2 * tokens * d + 2 * d * f + 2 * tokens * f + f * d)
+                 + BYTES_PER_ELEMENT["bfloat16"] * (2 * tokens * f
+                                                    + tokens * d))
+    line = {
+        "metric": f"fp8(e4m3) swiglu chain (gate+up+down as separate "
+                  f"chained stages; multi-matmul fp8 bodies hit the XLA "
+                  f"compile pathology), {tokens} tok D={d} F={f}, "
+                  f"{dev.device_kind} ({hw_key}, fp8 peak "
+                  f"{fp8_peak/1e12:.0f} TF/s)",
+        "value": round(t_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(_roofline_s(flops, nbytes, hw, "float8")
+                             / t_s, 4),
+        "tflops_achieved": round(flops / t_s / 1e12, 2),
+    }
+    print(json.dumps(line))
+    return line
+
+
+def _bench_int8_matmul(card, hw_key: str, dev) -> dict | None:
+    """int8 matmul line (VERDICT r3 #7b): the v5e's natively-accelerated
+    low precision (394 TOPS = 2x bf16).  Square D x D chain of
+    lax.dot_general(int8, int8) -> int32, rescaled to int8 between
+    steps — measures whether this stack reaches the int8 rate the
+    hardware table claims, or records the wall like the fp8 line."""
+    import jax.numpy as jnp
+
+    from dlnetbench_tpu.core.hardware import BYTES_PER_ELEMENT, HARDWARE
+
+    hw = HARDWARE[hw_key]
+    try:
+        int8_peak = hw.peak("int8")
+    except ValueError:
+        _skipped(f"int8 matmul ({hw_key})", f"{hw_key} has no int8 peak")
+        return None
+
+    tokens, d = BATCH * SEQ, card.embed_dim
+    x = jax.random.randint(jax.random.key(9), (tokens, d), -127, 128,
+                           jnp.int8)
+    w = jax.random.randint(jax.random.key(10), (d, d), -127, 128, jnp.int8)
+
+    K = 10
+
+    def chain(x0):
+        def body(xc, _):
+            y = jax.lax.dot_general(xc, w, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            return (y >> 8).astype(jnp.int8), ()
+        return jax.lax.scan(body, x0, None, length=K)[0]
+
+    t_s = _measure_chain(chain, x, K)
+
+    ops = 2 * tokens * d * d
+    nbytes = int(BYTES_PER_ELEMENT["int8"] * (2 * tokens * d + d * d))
+    line = {
+        "metric": f"int8 matmul, {tokens} tok D={d}, {dev.device_kind} "
+                  f"({hw_key}, int8 peak {int8_peak/1e12:.0f} TOP/s)",
+        "value": round(t_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(_roofline_s(ops, nbytes, hw, "int8") / t_s,
+                             4),
+        "tops_achieved": round(ops / t_s / 1e12, 2),
     }
     print(json.dumps(line))
     return line
