@@ -538,7 +538,7 @@ _HOST_EXEC = {"DLNB_PJRT_EXECUTOR": "host"}
 
 
 def _spawn_hier(native_bin, name, port, rank, *extra, world=4, procs=2,
-                out=None, model="gpt2_l_16_bfloat16"):
+                out=None, model="gpt2_l_16_bfloat16", env=None):
     import os
     cmd = [str(native_bin / name), "--model", model,
            "--world", str(world), "--backend", "pjrt",
@@ -551,7 +551,8 @@ def _spawn_hier(native_bin, name, port, rank, *extra, world=4, procs=2,
         cmd += ["--out", str(out)]
     return subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True,
-                            env={**os.environ, **_HOST_EXEC})
+                            env={**os.environ, **_HOST_EXEC,
+                                 **(env or {})})
 
 
 @pytest.mark.parametrize("world,nprocs", [
@@ -656,29 +657,34 @@ def test_native_hier_dcn_wire_bytes(native_bin):
             (r, rec["tcp_bytes_sent"], expected)
 
 
-@pytest.mark.parametrize("name,extra,world,model", [
-    ("dp", ("--num_buckets", 2), 4, "gpt2_l_16_bfloat16"),
+@pytest.mark.parametrize("name,extra,world,model,nprocs", [
+    ("dp", ("--num_buckets", 2), 4, "gpt2_l_16_bfloat16", 2),
+    # 4 OS processes x 2 local ranks: the DCN mesh at its widest test
+    # configuration.  The test env forces the ring threshold to 1 byte
+    # (scaled test buckets are ~4 KB, far under the 64 KiB default), so
+    # the DCN allreduce leg genuinely rides ring_allreduce at P=4
+    ("dp", ("--num_buckets", 4), 8, "gpt2_l_16_bfloat16", 4),
     ("fsdp", ("--num_units", 3, "--sharding_factor", 2), 4,
-     "gpt2_l_16_bfloat16"),
+     "gpt2_l_16_bfloat16", 2),
     # pipeline: the stage-1 -> stage-2 hop crosses the process boundary,
     # exercising Hier's cross-process p2p (TCP frames with encoded
     # endpoint tags)
     ("hybrid_2d", ("--num_stages", 4, "--num_microbatches", 4), 4,
-     "gpt2_l_16_bfloat16"),
+     "gpt2_l_16_bfloat16", 2),
     # MoE ZB: spanning splits + Alltoall's block-routed DCN leg + the
     # zero-bubble schedule's p2p pattern, 2 procs x 4 local ranks
     ("hybrid_3d_moe",
      ("--num_stages", 2, "--num_microbatches", 2,
       "--num_expert_shards", 2, "--schedule", "zb"), 8,
-     "mixtral_8x7b_16_bfloat16"),
+     "mixtral_8x7b_16_bfloat16", 2),
     # ring attention: RingShift's KV rotation crosses the process
     # boundary via the boundary-block-routed DCN leg
     ("ring_attention", ("--sp", 4, "--max_layers", 2), 4,
-     "llama3_8b_16_bfloat16"),
+     "llama3_8b_16_bfloat16", 2),
 ])
 def test_native_proxy_over_hier_and_merge(native_bin, tmp_path, name, extra,
-                                          world, model):
-    """Proxies across 2 OS processes on the hier fabric: local
+                                          world, model, nprocs):
+    """Proxies across OS processes on the hier fabric: local
     collectives on each process's executor, DCN combine over TCP,
     records merged by metrics.merge with the hierarchy described.
     fsdp's allreduce_comm groups stride the process boundary, so the
@@ -688,11 +694,15 @@ def test_native_proxy_over_hier_and_merge(native_bin, tmp_path, name, extra,
         validate_record
 
     port = _free_port()
-    local = world // 2
-    outs = [tmp_path / f"p{r}.jsonl" for r in range(2)]
+    local = world // nprocs
+    outs = [tmp_path / f"p{r}.jsonl" for r in range(nprocs)]
+    # the threshold must be IDENTICAL on every process (it is part of
+    # the collective's wire protocol); 1 byte forces the ring at the
+    # suite's tiny scaled buckets for the wide-mesh case
+    env = ({"DLNB_TCP_RING_THRESHOLD": "1"} if nprocs > 2 else None)
     procs = [_spawn_hier(native_bin, name, port, r, *extra, world=world,
-                         out=outs[r], model=model)
-             for r in range(2)]
+                         procs=nprocs, out=outs[r], model=model, env=env)
+             for r in range(nprocs)]
     texts = [p.communicate(timeout=180)[0] for p in procs]
     for r, (p, txt) in enumerate(zip(procs, texts)):
         assert p.returncode == 0, f"process {r} failed:\n{txt}"
@@ -702,7 +712,7 @@ def test_native_proxy_over_hier_and_merge(native_bin, tmp_path, name, extra,
         assert rec["process"] == r
         g = rec["global"]
         assert g["backend"] == "pjrt"
-        assert g["num_processes"] == 2
+        assert g["num_processes"] == nprocs
         assert g["local_world"] == local
         assert g["dcn_transport"] == "tcp"
         assert g["p2p_transport"] == "host+tcp"
