@@ -57,10 +57,13 @@ class TransformerConfig:
                              # the [B, S, V] logits traffic for benches
     mlp_dtype: str = "bfloat16"    # "float8" runs the (dense) MLP matmuls
                              # in e4m3 with per-tensor dynamic scales and
-                             # bf16 master weights (ops/fp8.py) — 2x MXU
-                             # rate on fp8-capable chips (v5e 394 TF/s,
-                             # core/hardware.py); backward stays in the
-                             # master dtype (straight-through)
+                             # bf16 master weights (ops/fp8.py; measured
+                             # r3/r4: upcast on the MXU, bf16-class rate);
+                             # "int8" likewise via ops/int8.py — the
+                             # low precision this chip ACTUALLY runs at
+                             # 2x (r4: 0.99 of the 394 TOP/s int8 peak);
+                             # backward stays in the master dtype
+                             # (straight-through) for both
     moe_impl: str = "dense"        # "dense" (every expert computes every
                              # selected token — exact, E/k x the FLOPs) or
                              # "sparse" (capacity-based dispatch, GShard
@@ -84,26 +87,26 @@ class TransformerConfig:
         if self.moe_impl not in ("dense", "sparse"):
             raise ValueError(f"unknown moe_impl {self.moe_impl!r}; "
                              f"expected 'dense' or 'sparse'")
-        if self.mlp_dtype not in ("bfloat16", "float8"):
+        if self.mlp_dtype not in ("bfloat16", "float8", "int8"):
             raise ValueError(f"unknown mlp_dtype {self.mlp_dtype!r}; "
-                             f"expected 'bfloat16' or 'float8'")
-        if self.mlp_dtype == "float8" and (self.num_experts > 1
-                                           or not self.gated):
+                             f"expected 'bfloat16', 'float8' or 'int8'")
+        if self.mlp_dtype != "bfloat16" and (self.num_experts > 1
+                                             or not self.gated):
             raise ValueError(
-                "mlp_dtype='float8' currently covers the dense SwiGLU "
-                "path only")
+                f"mlp_dtype={self.mlp_dtype!r} currently covers the "
+                f"dense SwiGLU path only")
         if self.mlp_backward not in ("split", "fused", "pallas"):
             raise ValueError(f"unknown mlp_backward {self.mlp_backward!r}; "
                              f"expected 'split', 'fused' or 'pallas'")
         if self.mlp_backward != "fused" and (self.num_experts > 1
-                                             or self.mlp_dtype == "float8"
+                                             or self.mlp_dtype != "bfloat16"
                                              or not self.gated):
-            # the MoE / fp8 / gelu branches would win the dispatch and
-            # silently measure the WRONG backward in an A/B
+            # the MoE / fp8 / int8 / gelu branches would win the
+            # dispatch and silently measure the WRONG backward in an A/B
             raise ValueError(
                 f"mlp_backward={self.mlp_backward!r} covers the dense "
-                f"bf16 SwiGLU path only (MoE, float8 and non-gated MLPs "
-                f"dispatch elsewhere)")
+                f"bf16 SwiGLU path only (MoE, float8/int8 and non-gated "
+                f"MLPs dispatch elsewhere)")
 
     @classmethod
     def from_card(cls, card: ModelCard, *, seq_len: int | None = None,
@@ -224,6 +227,9 @@ def _block(cfg: TransformerConfig, x, lp, positions):
         elif cfg.mlp_dtype == "float8":
             from dlnetbench_tpu.ops.fp8 import swiglu_fp8
             y2 = swiglu_fp8(y, lp["w_gate"], lp["w_up"], lp["w_down"])
+        elif cfg.mlp_dtype == "int8":
+            from dlnetbench_tpu.ops.int8 import swiglu_int8
+            y2 = swiglu_int8(y, lp["w_gate"], lp["w_up"], lp["w_down"])
         elif cfg.mlp_backward == "pallas":
             from dlnetbench_tpu.ops.mlp_backward import swiglu_pallas_bwd
             y2 = swiglu_pallas_bwd(

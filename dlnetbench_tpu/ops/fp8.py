@@ -57,7 +57,10 @@ def _fp8_dot_fwd(x, w):
     return out.astype(x.dtype), (x, w)
 
 
-def _fp8_dot_bwd(res, g):
+def straight_through_dot_bwd(res, g):
+    """Master-dtype backward shared by every quantized dot (fp8, int8 —
+    ops/int8.py imports this): quantization treated as identity, so the
+    gradient matmuls are the plain bf16/f32 ones."""
     x, w = res
     gf = g.astype(_F32)
     dx = jnp.dot(gf, w.astype(_F32).T).astype(x.dtype)
@@ -66,6 +69,9 @@ def _fp8_dot_bwd(res, g):
     dw = jax.lax.dot_general(
         x.astype(_F32), gf, ((lead, lead), ((), ()))).astype(w.dtype)
     return dx, dw
+
+
+_fp8_dot_bwd = straight_through_dot_bwd
 
 
 fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)

@@ -1,0 +1,72 @@
+"""int8 matmul with per-tensor dynamic scales — the quantized MLP
+compute path that is ACTUALLY fast on this hardware.
+
+Round-4 measurement (docs/PERF.md): chained int8->int32 matmuls run at
+389.9 TOP/s = 0.99 of the v5e's 394 TOP/s int8 peak, while the fp8
+path upcasts on the MXU and stays at bf16-class rate.  So where the
+fp8 module (`ops/fp8.py`) exists as the stat files' float8
+compatibility path, this module is the low-precision path with real
+2x-over-bf16 silicon behind it.
+
+Same recipe shape as fp8_dot: bf16 master weights/activations,
+per-tensor symmetric scaling to [-127, 127], int32 accumulation on the
+MXU, scales re-applied to the result; the backward is straight-through
+in the master dtype (quantization treated as identity — the standard
+recipe when gradients are not quantized).
+
+The reference's low-precision support is communication-buffer dtype
+selection only (`PROXY_FLOAT8`, data_types.hpp:36-79); it has no
+quantized compute path at all.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_F32 = jnp.float32
+_QMAX = 127.0
+
+
+def _quantize(x):
+    """Per-tensor symmetric scaling to int8: (x_q, scale) with
+    x ~= x_q * scale; the scale is clamped so an all-zero tensor stays
+    representable."""
+    amax = jnp.max(jnp.abs(x.astype(_F32)))
+    scale = jnp.maximum(amax, 1e-12) / _QMAX
+    xq = jnp.clip(jnp.round(x.astype(_F32) / scale), -_QMAX, _QMAX)
+    return xq.astype(jnp.int8), scale
+
+
+@jax.custom_vjp
+def int8_dot(x, w):
+    """[..., K] x [K, N] -> [..., N]: int8 operands, int32 MXU
+    accumulation, result in x.dtype.  Backward is straight-through in
+    the master dtype."""
+    out, _ = _int8_dot_fwd(x, w)
+    return out
+
+
+def _int8_dot_fwd(x, w):
+    xq, sx = _quantize(x)
+    wq, sw = _quantize(w)
+    acc = jax.lax.dot_general(xq, wq,
+                              (((x.ndim - 1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    out = acc.astype(_F32) * (sx * sw)
+    return out.astype(x.dtype), (x, w)
+
+
+# master-dtype straight-through backward, shared with the fp8 path
+from dlnetbench_tpu.ops.fp8 import straight_through_dot_bwd  # noqa: E402
+
+int8_dot.defvjp(_int8_dot_fwd, straight_through_dot_bwd)
+
+
+def swiglu_int8(x, w_gate, w_up, w_down):
+    """SwiGLU with all three matmuls in int8 (the int8 sibling of
+    layers.swiglu / ops.fp8.swiglu_fp8 — same bf16-rounding discipline
+    for saved residuals)."""
+    g = int8_dot(x, w_gate)
+    u = int8_dot(x, w_up)
+    h = (jax.nn.silu(g.astype(_F32)) * u.astype(_F32)).astype(g.dtype)
+    return int8_dot(h, w_down)
