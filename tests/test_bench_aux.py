@@ -1,0 +1,27 @@
+"""bench.py auxiliary-line guard: a failing low-precision line must
+degrade to a machine-readable skipped marker, never cost the headline
+line (the driver's tail parser reads the LAST stdout line)."""
+from __future__ import annotations
+
+import json
+
+
+def test_aux_failure_prints_skipped_marker(capsys):
+    import bench
+
+    def boom(*a):
+        raise RuntimeError("synthetic compile pathology")
+
+    out = bench._aux("fp8 swiglu chain", boom, "card", "hw", "dev")
+    assert out is None
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["metric"] == "fp8 swiglu chain"
+    assert "synthetic compile pathology" in line["skipped"]
+
+
+def test_aux_success_passes_through(capsys):
+    import bench
+
+    got = bench._aux("x", lambda a: {"metric": a}, "ok")
+    assert got == {"metric": "ok"}
+    assert capsys.readouterr().out == ""
