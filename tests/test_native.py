@@ -322,26 +322,28 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_native_tcp_selftest(native_bin):
-    """Every collective + p2p + split verified across 2 OS processes
-    ('correct sums' done-criterion)."""
-    # the freshly-probed port can be stolen before rank 0 binds it
-    # (TOCTOU); retry on a new port ONLY for that distinguishable
-    # signature — rank 0's bind failure, or a hang (the thief may itself
-    # be listening, wedging rank 1 against a foreign coordinator).  Any
-    # other non-zero exit is a real fabric regression and must fail
-    # immediately, not be retried into an occasional flake.
+def _spawn_ranks_with_port_retry(make_cmd, n, *, timeout=90):
+    """Launch one process per rank on a freshly-probed port; the port
+    can be stolen before rank 0 binds it (TOCTOU), so retry on a new
+    port ONLY for that distinguishable signature — rank 0's bind
+    failure, or a hang (the thief may itself be listening, wedging a
+    rank against a foreign coordinator).  Any other non-zero exit is a
+    real fabric regression and is returned for the caller to assert on,
+    never retried into an occasional flake.  ``make_cmd(rank, port)``
+    returns (argv, env-or-None); every process of an attempt is reaped
+    before the next attempt or return.  Returns (procs, outs)."""
     for attempt in range(3):
         port = _free_port()
-        procs = [subprocess.Popen(
-            [str(native_bin / "tcp_selftest"), "--world", "2",
-             "--rank", str(r), "--coordinator", f"127.0.0.1:{port}"],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-            for r in range(2)]
+        procs = []
+        for r in range(n):
+            argv, env = make_cmd(r, port)
+            procs.append(subprocess.Popen(
+                argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env))
         outs, timed_out = [], False
         for p in procs:
             try:
-                outs.append(p.communicate(timeout=90)[0])
+                outs.append(p.communicate(timeout=timeout)[0])
             except subprocess.TimeoutExpired:
                 timed_out = True
                 p.kill()
@@ -352,6 +354,17 @@ def test_native_tcp_selftest(native_bin):
                        or any("tcp: bind failed (port" in o for o in outs))
         if not port_stolen or attempt == 2:
             break
+    return procs, outs
+
+
+def test_native_tcp_selftest(native_bin):
+    """Every collective + p2p + split verified across 2 OS processes
+    ('correct sums' done-criterion)."""
+    procs, outs = _spawn_ranks_with_port_retry(
+        lambda r, port: ([str(native_bin / "tcp_selftest"), "--world", "2",
+                          "--rank", str(r),
+                          "--coordinator", f"127.0.0.1:{port}"], None),
+        2)
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"rank {r} OK" in out
@@ -364,28 +377,12 @@ def test_native_tcp_ring_zero_tail_blocks(native_bin):
     tail-block pointer arithmetic was UB before the r4 fix (ADVICE r3).
     Sums must still come out exact."""
     import os
-    for attempt in range(3):
-        port = _free_port()
-        procs = [subprocess.Popen(
-            [str(native_bin / "tcp_selftest"), "--world", "5",
-             "--rank", str(r), "--coordinator", f"127.0.0.1:{port}"],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env={**os.environ, "DLNB_TCP_RING_THRESHOLD": "1"})
-            for r in range(5)]
-        outs, timed_out = [], False
-        for p in procs:
-            try:
-                outs.append(p.communicate(timeout=120)[0])
-            except subprocess.TimeoutExpired:
-                timed_out = True
-                p.kill()
-                outs.append(p.communicate()[0])
-        if all(p.returncode == 0 for p in procs):
-            break
-        port_stolen = (timed_out
-                       or any("tcp: bind failed (port" in o for o in outs))
-        if not port_stolen or attempt == 2:
-            break
+    procs, outs = _spawn_ranks_with_port_retry(
+        lambda r, port: ([str(native_bin / "tcp_selftest"), "--world", "5",
+                          "--rank", str(r),
+                          "--coordinator", f"127.0.0.1:{port}"],
+                         {**os.environ, "DLNB_TCP_RING_THRESHOLD": "1"}),
+        5, timeout=120)
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"rank {r} OK" in out
@@ -401,33 +398,16 @@ def test_native_tcp_ring_survives_clean_early_exit(native_bin):
     marks the departure clean, rank 0's delayed take matches the
     already-queued frames, and every rank exits 0."""
     import os
-    for attempt in range(3):
-        port = _free_port()
-        procs = []
-        for r in range(3):
-            env = {**os.environ}
-            if r == 0:
-                env["DLNB_TEST_RING_FINAL_RECV_DELAY_MS"] = "1000"
-            procs.append(subprocess.Popen(
-                [str(native_bin / "tcp_selftest"), "--world", "3",
+
+    def make_cmd(r, port):
+        env = {**os.environ}
+        if r == 0:
+            env["DLNB_TEST_RING_FINAL_RECV_DELAY_MS"] = "1000"
+        return ([str(native_bin / "tcp_selftest"), "--world", "3",
                  "--rank", str(r), "--coordinator", f"127.0.0.1:{port}",
-                 "--final_ring"],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, env=env))
-        outs, timed_out = [], False
-        for p in procs:
-            try:
-                outs.append(p.communicate(timeout=60)[0])
-            except subprocess.TimeoutExpired:
-                timed_out = True
-                p.kill()
-                outs.append(p.communicate()[0])
-        if all(p.returncode == 0 for p in procs):
-            break
-        port_stolen = (timed_out
-                       or any("tcp: bind failed (port" in o for o in outs))
-        if not port_stolen or attempt == 2:
-            break
+                 "--final_ring"], env)
+
+    procs, outs = _spawn_ranks_with_port_retry(make_cmd, 3, timeout=60)
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"rank {r} OK" in out
@@ -570,29 +550,13 @@ def test_native_hier_selftest(native_bin, world, nprocs):
     subsets), and cross-process p2p verified by all global ranks
     ('correct sums' done-criterion for the multi-host device path)."""
     import os
-    for attempt in range(3):
-        port = _free_port()
-        procs = [subprocess.Popen(
-            [str(native_bin / "hier_selftest"), "--world", str(world),
-             "--procs", str(nprocs), "--rank", str(r),
-             "--coordinator", f"127.0.0.1:{port}"],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env={**os.environ, **_HOST_EXEC})
-            for r in range(nprocs)]
-        outs, timed_out = [], False
-        for p in procs:
-            try:
-                outs.append(p.communicate(timeout=90)[0])
-            except subprocess.TimeoutExpired:
-                timed_out = True
-                p.kill()
-                outs.append(p.communicate()[0])
-        if all(p.returncode == 0 for p in procs):
-            break
-        port_stolen = (timed_out
-                       or any("tcp: bind failed (port" in o for o in outs))
-        if not port_stolen or attempt == 2:
-            break
+    procs, outs = _spawn_ranks_with_port_retry(
+        lambda r, port: ([str(native_bin / "hier_selftest"),
+                          "--world", str(world), "--procs", str(nprocs),
+                          "--rank", str(r),
+                          "--coordinator", f"127.0.0.1:{port}"],
+                         {**os.environ, **_HOST_EXEC}),
+        nprocs)
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {r} failed:\n{out}"
         assert f"hier_selftest process {r} OK" in out
@@ -625,30 +589,14 @@ def test_native_hier_dcn_wire_bytes(native_bin):
         + (P - 1) * (hdr + count * esz))
     expected = 2 * (P - 1) * hdr + iters * per_iter  # + 2 barriers
 
-    for attempt in range(3):
-        port = _free_port()
-        procs = [subprocess.Popen(
-            [str(native_bin / "hier_wire_probe"), "--world", str(world),
-             "--procs", str(nprocs), "--rank", str(r),
-             "--coordinator", f"127.0.0.1:{port}",
-             "--count", str(count), "--iters", str(iters)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env={**os.environ, **_HOST_EXEC})
-            for r in range(nprocs)]
-        outs, timed_out = [], False
-        for p in procs:
-            try:
-                outs.append(p.communicate(timeout=90)[0])
-            except subprocess.TimeoutExpired:
-                timed_out = True
-                p.kill()
-                outs.append(p.communicate()[0])
-        if all(p.returncode == 0 for p in procs):
-            break
-        port_stolen = (timed_out
-                       or any("tcp: bind failed (port" in o for o in outs))
-        if not port_stolen or attempt == 2:
-            break
+    procs, outs = _spawn_ranks_with_port_retry(
+        lambda r, port: ([str(native_bin / "hier_wire_probe"),
+                          "--world", str(world), "--procs", str(nprocs),
+                          "--rank", str(r),
+                          "--coordinator", f"127.0.0.1:{port}",
+                          "--count", str(count), "--iters", str(iters)],
+                         {**os.environ, **_HOST_EXEC}),
+        nprocs)
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {r} failed:\n{out}"
         rec = json.loads(out.strip().splitlines()[-1])
@@ -1001,35 +949,35 @@ def test_native_tsan_fabrics(tmp_path):
                     "-B", str(build)],
                    check=True, capture_output=True)
     subprocess.run(["ninja", "-C", str(build), "test_comm", "test_pjrt",
-                    "tcp_selftest"], check=True, capture_output=True)
+                    "tcp_selftest", "hier_selftest"],
+                   check=True, capture_output=True)
     for t in ("test_comm", "test_pjrt"):
         out = subprocess.run([str(build / t)], capture_output=True,
                              text=True, timeout=600)
         assert out.returncode == 0, f"{t} under tsan:\n{out.stdout[-2000:]}"
         assert "ThreadSanitizer" not in out.stdout + out.stderr
-    # same port-TOCTOU retry + orphan-reaping discipline as
-    # test_native_tcp_selftest (its comment explains the race)
-    for attempt in range(3):
-        port = _free_port()
-        procs = [subprocess.Popen(
-            [str(build / "bin" / "tcp_selftest"), "--world", "4",
-             "--rank", str(r), "--coordinator", f"127.0.0.1:{port}"],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-            for r in range(4)]
-        outs, timed_out = [], False
-        for p in procs:
-            try:
-                outs.append(p.communicate(timeout=300)[0])
-            except subprocess.TimeoutExpired:
-                timed_out = True
-                p.kill()
-                outs.append(p.communicate()[0])
-        if all(p.returncode == 0 for p in procs):
-            break
-        port_stolen = (timed_out
-                       or any("tcp: bind failed (port" in o for o in outs))
-        if not port_stolen or attempt == 2:
-            break
+    procs, outs = _spawn_ranks_with_port_retry(
+        lambda r, port: ([str(build / "bin" / "tcp_selftest"),
+                          "--world", "4", "--rank", str(r),
+                          "--coordinator", f"127.0.0.1:{port}"], None),
+        4, timeout=300)
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} under tsan:\n{out}"
+        assert "ThreadSanitizer" not in out, out
+
+    # the r4 hier additions are the thread-heaviest new code (per-slot
+    # DCN exchanges from concurrent rendezvous execs, Bye-frame
+    # teardown, concurrent quiesce): run the full hier selftest —
+    # including the uneven subset-spanning splits — under TSan at
+    # procs 3 x 4 local ranks
+    import os
+    procs, outs = _spawn_ranks_with_port_retry(
+        lambda r, port: ([str(build / "bin" / "hier_selftest"),
+                          "--world", "12", "--procs", "3",
+                          "--rank", str(r),
+                          "--coordinator", f"127.0.0.1:{port}"],
+                         {**os.environ, **_HOST_EXEC}),
+        3, timeout=300)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"hier proc {r} under tsan:\n{out}"
         assert "ThreadSanitizer" not in out, out
