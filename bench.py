@@ -18,8 +18,10 @@ as "fp8_mlp" / "fp8_swiglu" / "int8_matmul"):
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -51,10 +53,27 @@ def _skipped(metric: str, why: str) -> None:
     print(json.dumps({"metric": metric, "skipped": why}))
 
 
+try:
+    _AUX_DEADLINE_S = float(os.environ.get("DLNB_BENCH_AUX_DEADLINE_S",
+                                           "900"))
+except ValueError:  # a malformed override must not cost the headline
+    _AUX_DEADLINE_S = 900.0
+_T0 = time.monotonic()
+
+
 def _aux(name: str, fn, *args):
     """Run one auxiliary bench line; an auxiliary failure (compile
     pathology, transient tunnel error) must never cost the HEADLINE
-    line — it degrades to a skipped marker instead."""
+    line — it degrades to a skipped marker instead.  A wall-clock
+    deadline bounds the auxiliary section as a whole: if earlier lines
+    (or the headline compile) ate the budget, the rest skip rather
+    than risk the driver's timeout killing the run before the headline
+    prints."""
+    elapsed = time.monotonic() - _T0
+    if elapsed > _AUX_DEADLINE_S:
+        _skipped(name, f"aux deadline ({_AUX_DEADLINE_S:.0f}s) exceeded "
+                       f"at +{elapsed:.0f}s — headline takes precedence")
+        return None
     try:
         return fn(*args)
     except Exception as e:

@@ -25,3 +25,16 @@ def test_aux_success_passes_through(capsys):
     got = bench._aux("x", lambda a: {"metric": a}, "ok")
     assert got == {"metric": "ok"}
     assert capsys.readouterr().out == ""
+
+
+def test_aux_deadline_skips_instead_of_running(capsys, monkeypatch):
+    """Past the wall-clock deadline the aux fn must not even start —
+    the headline line takes precedence over auxiliary coverage."""
+    import bench
+
+    monkeypatch.setattr(bench, "_AUX_DEADLINE_S", 0.0)
+    ran = []
+    got = bench._aux("int8 matmul", lambda: ran.append(1))
+    assert got is None and not ran
+    line = json.loads(capsys.readouterr().out.strip())
+    assert "deadline" in line["skipped"]
