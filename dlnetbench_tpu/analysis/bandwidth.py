@@ -80,15 +80,27 @@ def effective_bandwidth(records: list[dict]):
                 # at n=2, which is also why the fabric never rings
                 # there).  On hier records the mesh in question is the
                 # DCN leg among the PROCESSES (same element count as the
-                # group op), so num_processes bounds its width —
-                # conservatively refusing groups that span fewer.
+                # group op): components stamped with their split's real
+                # spanning process count ("span", axis_span_procs in
+                # schedule.hpp) use it directly — a group contained in
+                # one process (span 1) never touches the DCN and is
+                # never refused; older records without the stamp fall
+                # back to the record-global num_processes, which can
+                # only over-refuse, never admit a wrong figure.
                 ring_thr = g.get("tcp_ring_threshold_bytes")
                 if ring_thr is not None and bound != "hierarchical":
-                    mesh_n = (int(g.get("num_processes", 0))
-                              if dcn_algo == "blocked" else None)
+                    def _mesh_n(c):
+                        if dcn_algo != "blocked":
+                            return int(c["group"])
+                        # last-resort group fallback: a blocked record
+                        # stripped of num_processes must stay refused
+                        # (over-refuse, never admit)
+                        return int(c.get("span")
+                                   or g.get("num_processes", 0)
+                                   or c["group"])
                     fullmesh = any(
                         c["kind"] == "allreduce"
-                        and (mesh_n or int(c["group"])) > 2
+                        and _mesh_n(c) > 2
                         and c["bytes"] / max(int(c.get("ops", 1)),
                                              1) < ring_thr
                         for c in components)

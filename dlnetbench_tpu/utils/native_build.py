@@ -34,10 +34,29 @@ def _claim(root: Path) -> None:
     ``ninja -C`` would then execute; checking at mkdir time (not at
     path-computation time) closes the window.
     """
-    root.mkdir(mode=0o700, exist_ok=True)
-    if hasattr(os, "getuid") and root.stat().st_uid != os.getuid():
+    try:
+        root.mkdir(mode=0o700)
+        created = True
+    except FileExistsError:
+        created = False
+    st = root.stat()
+    if hasattr(os, "getuid") and st.st_uid != os.getuid():
         raise RuntimeError(
             f"{root} exists but is not owned by uid {os.getuid()}")
+    # ownership alone is not enough: mkdir's mode applies only when the
+    # dir is CREATED (and is umask-subject then), so a same-uid but
+    # group/world-accessible dir from an earlier run or another tool
+    # would pass the uid check and its build.ninja be executed (advisor
+    # r4).  A PRE-EXISTING dir that was group/world-WRITABLE may
+    # already contain planted content — chmod cannot un-plant it, so
+    # wipe and rebuild; otherwise just tighten the bits.
+    if st.st_mode & 0o077:
+        if not created and st.st_mode & 0o022:
+            import shutil
+            shutil.rmtree(root)
+            root.mkdir(mode=0o700)
+        else:
+            root.chmod(0o700)
 
 
 def _run(cmd: list[str], what: str) -> None:

@@ -10,7 +10,10 @@
 //   MoE A2A + two-level sync reference cpp/hybrid_parallel/hybrid_3d_moe.cpp:291-363
 #pragma once
 
+#include <algorithm>
 #include <cstdint>
+#include <map>
+#include <set>
 #include <stdexcept>
 #include <string>
 #include <vector>
@@ -106,6 +109,27 @@ struct Grid3D {
   i64 pp_color(i64 r) const { auto c = coords(r); return c.dp_id * tp + c.tp_id; }
   i64 tp_color(i64 r) const { auto c = coords(r); return c.dp_id * pp + c.pp_id; }
 };
+
+// Max OS processes any single group of an axis split spans, under the
+// hier fabric's contiguous rank->process layout (world/procs local
+// ranks per process, hier_fabric.hpp).  Stamped into comm-model
+// components ("span") so the small-allreduce full-mesh busbw refusal
+// (analysis/bandwidth.py) keys on the group's REAL DCN mesh width: a
+// group contained in one process (span 1) never touches the DCN and
+// must not be refused on the record-global process count (advisor r4).
+// `color_of` maps world rank -> group color (Grid3D::*_color).
+template <typename ColorFn>
+inline i64 axis_span_procs(i64 world, i64 procs, ColorFn color_of) {
+  if (procs <= 1 || world <= 0 || world % procs != 0) return 1;
+  const i64 locals = world / procs;
+  std::map<i64, std::set<i64>> procs_by_color;
+  for (i64 r = 0; r < world; ++r)
+    procs_by_color[color_of(r)].insert(r / locals);
+  i64 mx = 1;
+  for (const auto& kv : procs_by_color)
+    mx = std::max<i64>(mx, static_cast<i64>(kv.second.size()));
+  return mx;
+}
 
 // ----------------------------------------------------------------- PP(+TP)
 struct PipelineSchedule {

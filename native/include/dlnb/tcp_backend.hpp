@@ -36,6 +36,7 @@
 #include <condition_variable>
 #include <cstring>
 #include <deque>
+#include <exception>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -367,16 +368,25 @@ class TcpFabric : public Fabric {
     // left" from "died mid-run" — a slower rank must keep waiting for
     // frames from STILL-ALIVE ranks after a fast rank legitimately
     // exits (the ring's transitive-dependency check would otherwise
-    // false-positive on the Bye'd rank's EOF)
-    for (int r = 0; r < world_; ++r) {
-      if (r == rank_ || fds_[r] < 0) continue;
-      tcp::FrameHeader h{};
-      h.kind = static_cast<std::uint32_t>(tcp::FrameKind::Bye);
-      h.src = static_cast<std::uint32_t>(rank_);
-      try {
-        send_frame(r, h, nullptr);
-      } catch (...) {
-        // peer already gone: nothing to tell it
+    // false-positive on the Bye'd rank's EOF).  But ONLY on clean
+    // completion: if this destructor runs during exception unwinding,
+    // the rank is DYING mid-run, and advertising that as a clean
+    // departure would disarm the transitive (also_dep) fail-fast on
+    // every waiter — failure would then surface only as a serial
+    // cascade of direct-wait desync errors masking the real cause
+    // (advisor r4).  Skipping the Bye lets peers see the EOF for what
+    // it is: a death.
+    if (std::uncaught_exceptions() == 0) {
+      for (int r = 0; r < world_; ++r) {
+        if (r == rank_ || fds_[r] < 0) continue;
+        tcp::FrameHeader h{};
+        h.kind = static_cast<std::uint32_t>(tcp::FrameKind::Bye);
+        h.src = static_cast<std::uint32_t>(rank_);
+        try {
+          send_frame(r, h, nullptr);
+        } catch (...) {
+          // peer already gone: nothing to tell it
+        }
       }
     }
     closing_.store(true, std::memory_order_release);

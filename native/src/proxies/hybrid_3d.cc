@@ -31,7 +31,7 @@ int main(int argc, char** argv) {
 
     Json meta = Json::object();
     meta["proxy"] = "hybrid_3d";
-    hybrid_meta(meta, spec, env.dtype, env.cfg.size_scale);
+    hybrid_meta(meta, spec, env.dtype, env.cfg.size_scale, env.procs);
 
     return run_proxy_main(
         "hybrid_3d", env, meta,

@@ -46,7 +46,7 @@ int main(int argc, char** argv) {
     Json meta = Json::object();
     meta["proxy"] = "hybrid_3d_moe";
     meta["top_k"] = moe.top_k;
-    hybrid_meta(meta, spec, env.dtype, env.cfg.size_scale);
+    hybrid_meta(meta, spec, env.dtype, env.cfg.size_scale, env.procs);
 
     return run_proxy_main(
         "hybrid_3d_moe", env, meta,

@@ -40,10 +40,23 @@ struct HybridSpec {
   i64 expert_sync = 0;        // level-2 expert stage shard elems (DP group)
 };
 
-// Fill the record's shared pipeline metadata.
+// Fill the record's shared pipeline metadata.  `procs` is the hier
+// fabric's OS-process count (1 on single-process fabrics): allreduce
+// comm-model components get their split's real spanning process count
+// stamped so the busbw full-mesh refusal keys on the actual DCN mesh
+// width (advisor r4; analysis/bandwidth.py).
 inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
-                        double size_scale) {
+                        double size_scale, i64 procs = 1) {
   const auto& p = spec.pipe;
+  // the grid the engine actually runs (see run body): MoE replaces the
+  // tp axis with ep, so splits/colors — and spans — follow that grid
+  const Grid3D rg = spec.is_moe ? Grid3D{p.grid.dp, p.grid.pp, spec.ep}
+                                : p.grid;
+  const i64 world = rg.world_size();
+  const i64 dp_span = procs > 1 ? axis_span_procs(
+      world, procs, [&](i64 r) { return rg.dp_color(r); }) : 0;
+  const i64 axis_span = procs > 1 ? axis_span_procs(
+      world, procs, [&](i64 r) { return rg.tp_color(r); }) : 0;
   meta["num_stages"] = p.grid.pp;
   meta["num_microbatches"] = p.num_microbatches;
   meta["schedule"] = spec.schedule;
@@ -111,19 +124,22 @@ inline void hybrid_meta(Json& meta, const HybridSpec& spec, DType dtype,
           /*bound=*/"", /*ops=*/2 * M * spec.a2a_per_direction));
       cm["dp_ep_comm"] = comm_timer(comm_component(
           "allreduce", spec.ep,
-          scale_count(spec.nonexpert_sync, size_scale) * esz));
+          scale_count(spec.nonexpert_sync, size_scale) * esz,
+          /*bound=*/"", /*ops=*/1, /*span=*/axis_span));
       cm["dp_comm"] = comm_timer(comm_component(
           "allreduce", p.grid.dp,
-          scale_count(spec.expert_sync, size_scale) * esz));
+          scale_count(spec.expert_sync, size_scale) * esz,
+          /*bound=*/"", /*ops=*/1, /*span=*/dp_span));
     } else {
       cm["dp_comm"] = comm_timer(comm_component(
           "allreduce", p.grid.dp,
-          scale_count(p.dp_sync_elems, size_scale) * esz));
+          scale_count(p.dp_sync_elems, size_scale) * esz,
+          /*bound=*/"", /*ops=*/1, /*span=*/dp_span));
       if (p.grid.tp > 1)
         cm["tp_comm"] = comm_timer(comm_component(
             "allreduce", p.grid.tp,
             4 * M * scale_count(p.tp_msg_elems, size_scale) * esz,
-            /*bound=*/"", /*ops=*/4 * M));
+            /*bound=*/"", /*ops=*/4 * M, /*span=*/axis_span));
     }
     meta["comm_model"] = cm;
   }

@@ -226,7 +226,8 @@ inline std::unique_ptr<Fabric> make_fabric(const ProxyEnv& env) {
 inline Json comm_component(const std::string& kind,
                            std::int64_t group, std::int64_t bytes,
                            const std::string& bound = "",
-                           std::int64_t ops = 1) {
+                           std::int64_t ops = 1,
+                           std::int64_t span = 0) {
   Json c = Json::object();
   c["kind"] = kind;
   c["group"] = group;
@@ -239,6 +240,10 @@ inline Json comm_component(const std::string& kind,
   // per-MESSAGE size (bytes/ops) is what algorithm-selection thresholds
   // compare against, not the per-iteration total
   c["ops"] = ops;
+  // span > 0: the max OS processes any group of this split spans on the
+  // hier fabric (axis_span_procs) — the DCN mesh width the full-mesh
+  // refusal should key on; 0 = single-process fabric, field omitted
+  if (span > 0) c["span"] = span;
   return c;
 }
 

@@ -50,6 +50,7 @@ int main(int argc, char** argv) {
     meta["attn_time_source"] = sched.attn_time_source;
     {
       i64 shifts = 2 * layers * (sp - 1);  // fwd + bwd ring passes
+      const Grid3D mg{dp, 1, sp};  // same grid the rank body runs
       Json cm = Json::object();
       cm["ring_comm"] = comm_timer(comm_component(
           "p2p", sp, shifts * kv_elems *
@@ -57,7 +58,12 @@ int main(int argc, char** argv) {
       if (dp > 1)
         cm["dp_comm"] = comm_timer(comm_component(
             "allreduce", dp,
-            grad_elems * static_cast<i64>(dtype_bytes(env.dtype))));
+            grad_elems * static_cast<i64>(dtype_bytes(env.dtype)),
+            /*bound=*/"", /*ops=*/1,
+            /*span=*/env.procs > 1
+                ? axis_span_procs(env.world, env.procs,
+                                  [&](i64 r) { return mg.dp_color(r); })
+                : 0));
       meta["comm_model"] = cm;
     }
 

@@ -49,6 +49,7 @@ int main(int argc, char** argv) {
         static_cast<i64>(sched.a2a_elems * sched.bytes_per_element);
     {
       i64 n_a2a = 4 * layers;  // 2 reshards per layer, fwd + bwd
+      const Grid3D mg{dp, 1, sp};  // same grid the rank body runs
       Json cm = Json::object();
       cm["a2a_comm"] = comm_timer(comm_component(
           "alltoall", sp,
@@ -57,7 +58,12 @@ int main(int argc, char** argv) {
       if (dp > 1)
         cm["dp_comm"] = comm_timer(comm_component(
             "allreduce", dp,
-            grad_elems * static_cast<i64>(dtype_bytes(env.dtype))));
+            grad_elems * static_cast<i64>(dtype_bytes(env.dtype)),
+            /*bound=*/"", /*ops=*/1,
+            /*span=*/env.procs > 1
+                ? axis_span_procs(env.world, env.procs,
+                                  [&](i64 r) { return mg.dp_color(r); })
+                : 0));
       meta["comm_model"] = cm;
     }
 

@@ -101,6 +101,41 @@ def test_blocked_hier_records_admissible_with_threshold():
     assert two.iloc[0]["busbw_GBps"] > 0
 
 
+def test_blocked_refusal_keys_on_component_span():
+    """Components stamped with their split's real spanning process
+    count ("span", schedule.hpp axis_span_procs) refuse on THAT mesh
+    width, not the record-global num_processes (advisor r4): a small
+    allreduce whose group lives inside one process (span 1) never
+    touches the DCN and keeps its busbw; one spanning 2 processes rides
+    a 2-mesh (== ring wire cost) and keeps it too; only a true >2-wide
+    DCN mesh is refused.  Records without the stamp keep the
+    conservative num_processes fallback."""
+    import math
+
+    def hier_rec(comp):
+        rec = _record({"comm": [comp]}, {"comm": [5.0]})
+        rec["global"]["dcn_algo"] = "blocked"
+        rec["global"]["num_processes"] = 4
+        rec["global"]["tcp_ring_threshold_bytes"] = 65536
+        return rec
+
+    small = {"kind": "allreduce", "group": 8, "bytes": 4000}
+    # span 1: group contained in one process -> never refused
+    one = effective_bandwidth([hier_rec({**small, "span": 1})])
+    assert one.iloc[0]["bound"] == "exact"
+    assert one.iloc[0]["busbw_GBps"] > 0
+    # span 2: mesh == ring at n=2 -> admissible
+    two = effective_bandwidth([hier_rec({**small, "span": 2})])
+    assert two.iloc[0]["bound"] == "exact"
+    # span 3: true DCN full mesh -> refused
+    three = effective_bandwidth([hier_rec({**small, "span": 3})])
+    assert three.iloc[0]["bound"] == "fullmesh"
+    assert math.isnan(three.iloc[0]["busbw_GBps"])
+    # no span: conservative fallback on num_processes (4) -> refused
+    legacy = effective_bandwidth([hier_rec(small)])
+    assert legacy.iloc[0]["bound"] == "fullmesh"
+
+
 def test_zero_time_and_missing_model_skipped():
     rec = _record({"barrier_time": [
         {"kind": "allreduce", "group": 8, "bytes": 100}]},

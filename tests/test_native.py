@@ -668,6 +668,15 @@ def test_native_proxy_over_hier_and_merge(native_bin, tmp_path, name, extra,
         # each process emits only its own local ranks
         assert [row["rank"] for row in rec["ranks"]] == \
             list(range(r * local, (r + 1) * local))
+        # allreduce components carry their split's real spanning
+        # process count (advisor r4): moe at world 8 / 2 procs has a
+        # dp split {r, r+4} crossing the process boundary (span 2)
+        # while the contiguous ep pairs stay inside one process
+        # (span 1) — bandwidth.py keys the full-mesh refusal on these
+        if name == "hybrid_3d_moe":
+            cm = g["comm_model"]
+            assert cm["dp_comm"][0]["span"] == 2
+            assert cm["dp_ep_comm"][0]["span"] == 1
 
     merged = merge_files(tmp_path / "merged.jsonl", outs)
     validate_record(merged)
@@ -938,6 +947,28 @@ def test_native_hier_noncoordinator_death_at_three_procs(native_bin):
 # preset alongside the ASan/UBSan debug preset, and this (slow) test
 # builds it and runs the unit suites plus the cross-process selftest
 # under it.
+
+def test_build_dir_claim_permission_discipline(tmp_path):
+    """_claim (advisor r4): a pre-existing same-uid build dir with
+    group/world WRITE bits may already contain planted build.ninja —
+    must be wiped, not merely chmodded; read-only-permissive dirs are
+    tightened in place; a foreign-uid dir is rejected (not testable
+    unprivileged)."""
+    from dlnetbench_tpu.utils.native_build import _claim
+
+    d = tmp_path / "bld"
+    d.mkdir(mode=0o755)  # world-readable, NOT writable
+    (d / "build.ninja").write_text("ok")
+    _claim(d)
+    assert (d.stat().st_mode & 0o777) == 0o700
+    assert (d / "build.ninja").exists()  # tightened in place, kept
+
+    d.chmod(0o775)  # group-WRITABLE: contents are untrusted
+    (d / "build.ninja").write_text("planted")
+    _claim(d)
+    assert (d.stat().st_mode & 0o777) == 0o700
+    assert not (d / "build.ninja").exists()  # wiped and recreated
+
 
 @pytest.mark.slow
 def test_native_tsan_fabrics(tmp_path):
