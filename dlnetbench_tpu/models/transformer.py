@@ -58,10 +58,13 @@ class TransformerConfig:
     mlp_dtype: str = "bfloat16"    # "float8" runs the (dense) MLP matmuls
                              # in e4m3 with per-tensor dynamic scales and
                              # bf16 master weights (ops/fp8.py; measured
-                             # r3/r4: upcast on the MXU, bf16-class rate);
-                             # "int8" likewise via ops/int8.py — the
-                             # low precision this chip ACTUALLY runs at
-                             # 2x (r4: 0.99 of the 394 TOP/s int8 peak);
+                             # r5: native on the MXU at 0.70 of fp8 peak
+                             # in isolation — the r3/r4 "upcast" verdict
+                             # was an HBM-residency artifact);
+                             # "int8" likewise via ops/int8.py — 0.98 of
+                             # the 2x int8 peak in isolation and a
+                             # measured 1.089x END-TO-END step win vs
+                             # bf16 at matched remat (r5, docs/PERF.md);
                              # backward stays in the master dtype
                              # (straight-through) for both
     moe_impl: str = "dense"        # "dense" (every expert computes every

@@ -1,12 +1,12 @@
 """int8 matmul with per-tensor dynamic scales — the quantized MLP
 compute path that is ACTUALLY fast on this hardware.
 
-Round-4 measurement (docs/PERF.md): chained int8->int32 matmuls run at
-389.9 TOP/s = 0.99 of the v5e's 394 TOP/s int8 peak, while the fp8
-path upcasts on the MXU and stays at bf16-class rate.  So where the
-fp8 module (`ops/fp8.py`) exists as the stat files' float8
-compatibility path, this module is the low-precision path with real
-2x-over-bf16 silicon behind it.
+Measured on v5e (r4/r5, docs/PERF.md): chained int8->int32 matmuls run
+at 387-390 TOP/s = 0.98-0.99 of the 394 TOP/s int8 peak, and the
+END-TO-END int8-MLP train step beats the paired bf16 step by 1.089x
+(r5, bench.py int8_step) — the only low-precision path with a measured
+end-to-end win on this chip (fp8 reaches 0.70 of its peak in isolation
+but has no step-level win recorded).
 
 Same recipe shape as fp8_dot: bf16 master weights/activations,
 per-tensor symmetric scaling to [-127, 127], int32 accumulation on the
