@@ -33,7 +33,11 @@ from dlnetbench_tpu.metrics.parser import load_records, validate_record
 # (energy_scope rides with energy_source: a host without a counter emits
 # neither key, and that heterogeneity must not abort the merge)
 _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
-                     "cache_hits", "cache_misses", "tcp_bytes_sent"}
+                     "cache_hits", "cache_misses", "tcp_bytes_sent",
+                     # per-PROCESS share of an uneven-locals hier run
+                     # (world % procs != 0): differs by construction;
+                     # the process-invariant layout rides "local_worlds"
+                     "local_world"}
 
 # scheduler-stamped variables that identify the PROCESS, not the run
 # (metrics.emit.scheduler_variables): they legitimately differ between

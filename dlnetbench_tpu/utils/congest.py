@@ -1,0 +1,78 @@
+"""Launching `_loop` congestor pairs (the reference's interference
+primitives, Makefile.common:96-109 / dp.cpp:251-256) over the native
+TCP fabric — shared by examples/congestion_study.py and
+examples/pod_study.py --congest so the orphan-reaping discipline and
+the spawn recipe exist once.
+
+The pair runs forever (`_loop` binaries never return): callers MUST
+reap with ``kill_group`` (SIGKILL to the process group — each child
+gets its own session so a killed parent still leaves them reapable by
+group id, never saturating the host as orphans).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from pathlib import Path
+
+from dlnetbench_tpu.utils.net import free_port
+
+
+def launch_pair(bin_dir: Path, binary: str, model: str, repo: str | Path,
+                time_scale: float, size_scale: float,
+                extra: list[str] | None = None,
+                outs: list[Path] | None = None) -> list[subprocess.Popen]:
+    """A 2-rank pair of ``binary`` over the TCP fabric; own process
+    group per child.  No bind-retry here — use ``launch_pair_retry``
+    for long-lived congestors where a TOCTOU port steal must not abort
+    the caller."""
+    port = free_port()
+    procs = []
+    for r in range(2):
+        argv = [str(bin_dir / binary), "--model", model,
+                "--world", "2", "--backend", "tcp", "--rank", str(r),
+                "--coordinator", f"127.0.0.1:{port}",
+                "--time_scale", str(time_scale),
+                "--size_scale", str(size_scale),
+                "--no_topology", "--base_path", str(repo)] + (extra or [])
+        if outs is not None:
+            argv += ["--out", str(outs[r])]
+        procs.append(subprocess.Popen(
+            argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True))
+    return procs
+
+
+def launch_pair_retry(bin_dir: Path, binary: str, model: str,
+                      repo: str | Path, time_scale: float,
+                      size_scale: float, extra: list[str] | None = None,
+                      attempts: int = 3,
+                      settle_s: float = 1.0) -> list[subprocess.Popen]:
+    """``launch_pair`` with the same fresh-port retry discipline as the
+    repo's other spawners (the probed port can be stolen before rank 0
+    binds it — TOCTOU): give the pair ``settle_s`` to come up; if
+    either process died, reap both and retry on a new port."""
+    last: list[subprocess.Popen] = []
+    for _ in range(attempts):
+        procs = launch_pair(bin_dir, binary, model, repo, time_scale,
+                            size_scale, extra)
+        time.sleep(settle_s)
+        if all(p.poll() is None for p in procs):
+            return procs
+        kill_group(procs)
+        last = procs
+    raise RuntimeError(
+        f"{binary} pair died during startup {attempts} times "
+        f"(rcs {[p.returncode for p in last]})")
+
+
+def kill_group(procs: list[subprocess.Popen]) -> None:
+    for p in procs:
+        if p.poll() is None:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        p.wait()

@@ -23,52 +23,17 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
-import signal
 import subprocess
 import sys
-import time
 from pathlib import Path
 
 # runnable from a clone without installation
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-from dlnetbench_tpu.utils.net import free_port  # noqa: E402
+from dlnetbench_tpu.utils import congest  # noqa: E402
 
 REPO = Path(__file__).resolve().parent.parent
 from dlnetbench_tpu.utils.native_build import native_bin as _locate  # noqa: E402
 BIN = _locate(REPO, build=False)  # resolved for real (with build) in main()
-
-
-def launch_pair(binary: str, extra: list[str], outs: list[Path] | None,
-                args) -> list[subprocess.Popen]:
-    port = free_port()
-    procs = []
-    for r in range(2):
-        argv = [str(BIN / binary), "--model", args.model,
-                "--world", "2", "--backend", "tcp", "--rank", str(r),
-                "--coordinator", f"127.0.0.1:{port}",
-                "--time_scale", str(args.time_scale),
-                "--size_scale", str(args.size_scale),
-                "--no_topology", "--base_path", str(REPO)] + extra
-        if outs is not None:
-            argv += ["--out", str(outs[r])]
-        # own process group: if THIS script is killed mid-study (test
-        # timeout, ^C), killpg still reaps the children — an orphaned
-        # `_loop` binary would otherwise saturate the host forever
-        procs.append(subprocess.Popen(
-            argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            start_new_session=True))
-    return procs
-
-
-def kill_group(procs: list[subprocess.Popen]) -> None:
-    for p in procs:
-        if p.poll() is None:
-            try:
-                os.killpg(p.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-        p.wait()
 
 
 def measure(tag: str, out_dir: Path, args) -> dict:
@@ -77,15 +42,16 @@ def measure(tag: str, out_dir: Path, args) -> dict:
     outs = [out_dir / f"{tag}_p{r}.jsonl" for r in range(2)]
     for o in outs:
         o.unlink(missing_ok=True)
-    procs = launch_pair("dp", ["--num_buckets", str(args.num_buckets),
-                               "--runs", str(args.runs), "--warmup", "1"],
-                        outs, args)
+    procs = congest.launch_pair(
+        BIN, "dp", args.model, REPO, args.time_scale, args.size_scale,
+        extra=["--num_buckets", str(args.num_buckets),
+               "--runs", str(args.runs), "--warmup", "1"], outs=outs)
     try:
         for p in procs:
             if p.wait(timeout=600) != 0:
                 raise SystemExit(f"{tag}: dp rank exited {p.returncode}")
     finally:
-        kill_group(procs)  # reap a surviving sibling on any failure
+        congest.kill_group(procs)  # reap survivors on any failure
     from dlnetbench_tpu.metrics.merge import merge_records
     from dlnetbench_tpu.metrics.parser import load_records
     merged = merge_records([r for o in outs for r in load_records(o)])
@@ -130,17 +96,14 @@ def main() -> int:
     solo = measure("solo", args.out_dir, args)
 
     # sustained background traffic: the _loop binary never returns —
-    # start it, let its warmup pass, measure under load, kill it
-    congestors = launch_pair(
-        "dp_loop", ["--num_buckets", str(args.num_buckets)], None, args)
+    # start it (fresh-port retry inside), measure under load, kill it
+    congestors = congest.launch_pair_retry(
+        BIN, "dp_loop", args.model, REPO, args.time_scale,
+        args.size_scale, extra=["--num_buckets", str(args.num_buckets)])
     try:
-        time.sleep(1.0)
-        dead = [p for p in congestors if p.poll() is not None]
-        if dead:
-            raise SystemExit("congestor died during startup")
         congested = measure("congested", args.out_dir, args)
     finally:
-        kill_group(congestors)
+        congest.kill_group(congestors)
 
     report = {
         "solo": solo, "congested": congested,

@@ -159,9 +159,12 @@ def _run_hier_point(argv: list[str], world, records: Path, env,
     Returns a nonzero code for ANY per-point failure (signal death,
     timeout, bad records) so run_plan's per-point FAILED accounting
     sees it."""
-    if int(world) % nprocs != 0:
-        print(f"  skipped (world {world} not divisible by {nprocs} "
-              f"processes)", file=sys.stderr)
+    if int(world) < nprocs:
+        # uneven worlds are fine (the fabric's balanced layout gives the
+        # first world%procs processes one extra rank); only a process
+        # with NO rank to host is impossible
+        print(f"  skipped (world {world} < {nprocs} processes)",
+              file=sys.stderr)
         return 0
     # strip the single-record --out; each process writes its own file
     base = [a for j, a in enumerate(argv)
@@ -316,7 +319,17 @@ def main() -> int:
                          "device path; records merged per point)")
     ap.add_argument("--procs", type=int, default=2,
                     help="pjrt-hier: number of OS processes composing the "
-                         "DCN mesh (world must divide evenly)")
+                         "DCN mesh; worlds that do not divide evenly get "
+                         "the balanced uneven layout (first world%%procs "
+                         "processes host one extra rank)")
+    ap.add_argument("--congest", action="store_true",
+                    help="run a dp_loop congestor pair (native TCP fabric) "
+                         "for the duration of the sweep — sustained "
+                         "background frames sharing the DCN transport "
+                         "path, the reference's _loop interference shape "
+                         "(Makefile.common:96-109) composed with the "
+                         "hier study; the study README/json records it")
+    ap.add_argument("--congest_model", default="gpt2_l_16_bfloat16")
     ap.add_argument("--models", default=f"{DENSE},{MOE}",
                     help="comma-separated stats-file names")
     ap.add_argument("--runs", type=int, default=3)
@@ -344,13 +357,42 @@ def main() -> int:
     failed = 0
     if not args.report_only:
         records.unlink(missing_ok=True)
+        # a stale marker from an earlier --congest sweep into the same
+        # dir would mislabel THIS solo run's tables
+        (args.out_dir / "CONGESTED").unlink(missing_ok=True)
         plan = build_plan([m for m in args.models.split(",") if m],
                           args.devices)
-        failed = run_plan(plan, args, records)
+        congestors = _start_congestors(args) if args.congest else []
+        try:
+            failed = run_plan(plan, args, records)
+        finally:
+            from dlnetbench_tpu.utils.congest import kill_group
+            kill_group(congestors)
     report(args, records)
     if failed:
         print(f"\n{failed} study point(s) failed", file=sys.stderr)
     return 1 if failed else 0
+
+
+def _start_congestors(args) -> list:
+    """A dp_loop pair over the native TCP fabric, running for the whole
+    sweep: its frames share the DCN transport path (loopback here, real
+    links on a cluster) with every hier point's combine legs — the
+    reference's `_loop` interference composition.  Study output marks
+    the run so congested tables are never mistaken for solo ones."""
+    from dlnetbench_tpu.utils import congest
+    from dlnetbench_tpu.utils.native_build import native_bin as _locate
+
+    repo = Path(__file__).resolve().parent.parent
+    procs = congest.launch_pair_retry(
+        _locate(str(repo)), "dp_loop", args.congest_model, repo,
+        args.time_scale, max(args.size_scale * 10, 1e-3),
+        extra=["--num_buckets", "4"])
+    (args.out_dir / "CONGESTED").write_text(
+        f"sweep ran with a dp_loop x2 congestor pair "
+        f"(model {args.congest_model}) sharing the DCN transport\n")
+    print("congestor pair running (dp_loop x2 over tcp)", flush=True)
+    return procs
 
 
 if __name__ == "__main__":

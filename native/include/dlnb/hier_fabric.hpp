@@ -82,6 +82,7 @@
 #include "dlnb/communicator.hpp"
 #include "dlnb/fabric.hpp"
 #include "dlnb/pjrt_fabric.hpp"
+#include "dlnb/schedule.hpp"  // balanced_local/start: the rank layout
 #include "dlnb/tcp_backend.hpp"
 #include "dlnb/tensor.hpp"
 
@@ -185,9 +186,17 @@ struct GroupSet {
     std::vector<int> local_members;  // global ranks here, ascending
     std::unique_ptr<TcpCommunicator> tcp;  // null for single-proc groups
     std::vector<std::unique_ptr<Rendezvous>> rdv;  // [0 .. num_slots]
+    // host mailbox for local p2p when the split has no device sub
+    // communicator (local_uniform == false)
+    std::unique_ptr<shm::Mailboxes> mbox;
   };
 
-  int world = 0, local = 0, nprocs = 1, my_proc = 0;
+  int world = 0, nprocs = 1, my_proc = 0;
+  // rank layout: process p hosts global ranks [starts[p], starts[p+1]) —
+  // contiguous but NOT necessarily equal-sized (balanced_locals gives
+  // the first world%procs processes one extra rank when world does not
+  // divide evenly)
+  std::vector<int> starts;
   // All groups the same size?  The local DEVICE phase of G-dependent
   // ops (Alltoall / ReduceScatter move G x count locally) rides ONE
   // compiled XLA module per process, whose shapes cannot differ across
@@ -195,12 +204,27 @@ struct GroupSet {
   // a host-side local phase (same DCN wire layout).  Set-wide so every
   // rank of every process takes the same path.
   bool uniform = true;
+  // This PROCESS's restriction of the split has equal-size color
+  // groups, so one compiled XLA module (uniform replica_groups) can run
+  // the local device phase.  False — possible with uneven locals even
+  // when the GLOBAL groups are all equal (a group crossing the ragged
+  // process boundary leaves different-size remainders in each process)
+  // — routes the local phase through host staging instead: members
+  // stage raw sources and the rendezvous combines on host.  The DCN
+  // wire format is IDENTICAL either way, so processes may take
+  // different paths within one collective.
+  bool local_uniform = true;
   std::vector<std::vector<int>> groups;  // global ranks, by color asc
   std::vector<int> group_of, grank_of;   // by global rank
   std::vector<Info> info;                // by group index
   std::vector<std::unique_ptr<LocalGroup>> local_groups;  // null if none
 
-  int proc_of(int global_rank) const { return global_rank / local; }
+  int proc_of(int global_rank) const {
+    // starts is ascending and small (nprocs entries): linear scan
+    for (int p = nprocs - 1; p >= 0; --p)
+      if (global_rank >= starts[p]) return p;
+    return 0;
+  }
 };
 
 }  // namespace hier
@@ -267,7 +291,12 @@ class HierCommunicator : public ProxyCommunicator {
             int tag = 0) override {
     int dst_global = set_->groups[gidx_].at(dst_rank);
     if (set_->proc_of(dst_global) == set_->my_proc) {
-      sub_->Send(src, count, local_index(dst_global), tag);
+      if (sub_)
+        sub_->Send(src, count, local_index(dst_global), tag);
+      else  // host-local split: mailbox p2p, local member indices
+        lg_->mbox->send(local_index(grk_), local_index(dst_global), tag,
+                        src, static_cast<std::size_t>(count) *
+                                 dtype_bytes(dtype_));
     } else {
       require_tcp("Send");
       lg_->tcp->Send(src, count, proc_index(set_->proc_of(dst_global)),
@@ -278,7 +307,12 @@ class HierCommunicator : public ProxyCommunicator {
             int tag = 0) override {
     int src_global = set_->groups[gidx_].at(src_rank);
     if (set_->proc_of(src_global) == set_->my_proc) {
-      sub_->Recv(dst, count, local_index(src_global), tag);
+      if (sub_)
+        sub_->Recv(dst, count, local_index(src_global), tag);
+      else
+        lg_->mbox->recv(local_index(src_global), local_index(grk_), tag,
+                        dst, static_cast<std::size_t>(count) *
+                                 dtype_bytes(dtype_));
     } else {
       require_tcp("Recv");
       lg_->tcp->Recv(dst, count, proc_index(set_->proc_of(src_global)),
@@ -474,29 +508,43 @@ class HierCommunicator : public ProxyCommunicator {
     const std::size_t m = lg_->local_members.size();
     const bool spanning = set_->info[gidx_].procs.size() > 1;
 
-    // ---- phase 1: local device collective (every member thread) ----
+    // ---- phase 1: local collective (every member thread) ----
+    // Device sub-communicator when the process's color restriction is
+    // uniform (ldev); otherwise members stage RAW sources and the
+    // rendezvous combines on host — same DCN wire format either way.
+    const bool ldev = set_->local_uniform;
     std::vector<char> scratch;
     switch (op) {
       case pjrtfab::Op::Allreduce:
-        sub_allreduce(slot, src, dst, count);
+        if (ldev) {
+          sub_allreduce(slot, src, dst, count);
+        } else {
+          scratch.resize(count * esz);
+          std::memcpy(scratch.data(), src, scratch.size());
+        }
         break;
       case pjrtfab::Op::Allgather:
-        scratch.resize(m * count * esz);
-        sub_allgather(slot, src, scratch.data(), count);
+        if (ldev) {
+          scratch.resize(m * count * esz);
+          sub_allgather(slot, src, scratch.data(), count);
+        } else {
+          scratch.resize(count * esz);
+          std::memcpy(scratch.data(), src, scratch.size());
+        }
         break;
       case pjrtfab::Op::ReduceScatterBlock:
         scratch.resize(static_cast<std::size_t>(G) * count * esz);
-        if (set_->uniform) {
+        if (set_->uniform && ldev) {
           sub_allreduce(slot, src, scratch.data(), G * count);
         } else {
-          // uneven group sizes: the G x count local module shape would
-          // differ across co-resident groups — stage the raw source;
+          // uneven group sizes (or no device sub): the G x count local
+          // module shape is unavailable — stage the raw source;
           // dcn_phase sums the members on host
           std::memcpy(scratch.data(), src, scratch.size());
         }
         break;
       case pjrtfab::Op::Alltoall:
-        if (set_->uniform) {
+        if (set_->uniform && ldev) {
           scratch.resize(m * G * count * esz);
           sub_allgather(slot, src, scratch.data(), G * count);
         } else {
@@ -505,11 +553,17 @@ class HierCommunicator : public ProxyCommunicator {
         }
         break;
       case pjrtfab::Op::RingShift:
-        scratch.resize(m * count * esz);
-        sub_allgather(slot, src, scratch.data(), count);
+        if (ldev) {
+          scratch.resize(m * count * esz);
+          sub_allgather(slot, src, scratch.data(), count);
+        } else {
+          scratch.resize(count * esz);
+          std::memcpy(scratch.data(), src, scratch.size());
+        }
         break;
       case pjrtfab::Op::Barrier:
-        sub_->Barrier();
+        if (ldev) sub_->Barrier();
+        // !ldev: the rendezvous below IS the local barrier
         break;
     }
 
@@ -533,26 +587,57 @@ class HierCommunicator : public ProxyCommunicator {
     const auto& gi = set_->info[gidx_];
     const std::size_t m = members.size();
     const std::size_t blk = static_cast<std::size_t>(count) * esz;
-    // every local member's scratch holds the same local-phase result;
-    // scratches[0] is the canonical copy
+    const bool ldev = set_->local_uniform;
+    // with a device local phase every member's scratch holds the same
+    // local-phase result (scratches[0] canonical); in host-local mode
+    // each scratch is that member's RAW source and the combines below
+    // assemble/sum them here
     const char* local_res = static_cast<const char*>(scratches[0]);
+    // m packed member blocks in group-rank order, host-assembled from
+    // the raw per-member sources (Allgather/RingShift host-local mode)
+    std::vector<char> packed;
+    auto pack_members = [&]() {
+      packed.resize(m * blk);
+      for (std::size_t k = 0; k < m; ++k)
+        std::memcpy(packed.data() + k * blk, scratches[k], blk);
+      local_res = packed.data();
+    };
     switch (op) {
       case pjrtfab::Op::Barrier:
         if (spanning) lg_->tcp->Barrier();
         break;
       case pjrtfab::Op::Allreduce: {
-        if (!spanning) break;  // local sum IS the group sum
+        const void* lsum = dsts[0];  // device local phase: partial in dst
+        std::vector<char> hostsum;
+        if (!ldev) {  // host local phase: sum the raw member sources
+          hostsum.assign(static_cast<const char*>(scratches[0]),
+                         static_cast<const char*>(scratches[0]) + blk);
+          for (std::size_t k = 1; k < m; ++k)
+            for (std::size_t i = 0; i < static_cast<std::size_t>(count);
+                 ++i)
+              store_element(
+                  hostsum.data(), i, dtype_,
+                  load_element(hostsum.data(), i, dtype_) +
+                      load_element(scratches[k], i, dtype_));
+          lsum = hostsum.data();
+          if (!spanning) {  // device mode wrote dsts already; host must
+            for (void* d : dsts) std::memcpy(d, lsum, blk);
+            break;
+          }
+        } else if (!spanning) {
+          break;  // local sum IS the group sum, already in every dst
+        }
         std::vector<char> tmp(count * esz);
-        tcp_allreduce(slot, dsts[0], tmp.data(), count);
+        tcp_allreduce(slot, lsum, tmp.data(), count);
         for (void* d : dsts) std::memcpy(d, tmp.data(), tmp.size());
         break;
       }
       case pjrtfab::Op::ReduceScatterBlock: {
         // local_res: this process's full G-block partial sum — from the
-        // device AR, or summed here when the split is uneven (the
-        // staged raw sources, see run_collective)
+        // device AR, or summed here when the split is uneven or has no
+        // device sub (the staged raw sources, see run_collective)
         std::vector<char> staged;
-        if (!set_->uniform) {
+        if (!set_->uniform || !ldev) {
           staged.assign(local_res,
                         local_res + static_cast<std::size_t>(G) * blk);
           for (std::size_t k = 1; k < m; ++k) {
@@ -617,6 +702,7 @@ class HierCommunicator : public ProxyCommunicator {
       case pjrtfab::Op::Allgather: {
         // local_res: this process's m packed member blocks (ascending
         // global rank = group-rank order within the process)
+        if (!ldev) pack_members();
         if (!spanning) {
           for (void* d : dsts) std::memcpy(d, local_res, m * blk);
           break;
@@ -649,9 +735,10 @@ class HierCommunicator : public ProxyCommunicator {
       case pjrtfab::Op::Alltoall: {
         // local_res: m members x their FULL G-block sources
         // (member-major, ascending global rank) — from the device AG,
-        // or packed here from the staged raw sources when uneven
+        // or packed here from the staged raw sources when uneven or
+        // host-local
         std::vector<char> staged;
-        if (!set_->uniform) {
+        if (!set_->uniform || !ldev) {
           staged.resize(m * static_cast<std::size_t>(G) * blk);
           for (std::size_t k = 0; k < m; ++k)
             std::memcpy(staged.data() +
@@ -713,6 +800,7 @@ class HierCommunicator : public ProxyCommunicator {
       case pjrtfab::Op::RingShift: {
         // local_res: m packed member blocks; member gk rotates in the
         // block of grank (gk - extra) mod G
+        if (!ldev) pack_members();
         auto from_of = [&](std::int64_t gk) {
           return ((gk - extra) % G + G) % G;
         };
@@ -794,10 +882,10 @@ class HierFabric : public Fabric {
         dtype_(dtype),
         num_slots_(num_slots),
         tcp_(coordinator, nprocs, proc_rank, dtype),
-        local_(checked_local(global_world, nprocs), dtype, std::move(exec),
-               num_slots) {
-    L_ = global_world / nprocs;
-    base_ = proc_rank * L_;
+        local_(checked_local(global_world, nprocs, proc_rank), dtype,
+               std::move(exec), num_slots) {
+    L_ = static_cast<int>(balanced_local(world_, nprocs_, proc_rank_));
+    base_ = static_cast<int>(balanced_start(world_, nprocs_, proc_rank_));
     // control comm (f32 — exact for small split colors) created first so
     // every process's comm-id sequence aligns
     ctrl_ = make_tcp_comm(all_procs(), DType::F32, "hier_ctrl");
@@ -820,7 +908,6 @@ class HierFabric : public Fabric {
   // derived everywhere.
   std::unique_ptr<ProxyCommunicator> split(
       int world_rank, int color, const std::string& name) override {
-    auto sub = local_.split(world_rank - base_, color, name + "_ici");
     std::shared_ptr<hier::GroupSet> set;
     std::uint64_t seq;
     {
@@ -832,16 +919,31 @@ class HierFabric : public Fabric {
         try {
           std::vector<int> world_colors(world_, 0);
           if (nprocs_ > 1) {
-            std::vector<float> mine(L_), all(world_);
+            // uneven locals: the TCP allgather moves EQUAL counts per
+            // process, so every process contributes Lmax slots (its
+            // own colors, zero-padded) and the reassembly skips each
+            // process's padding via the balanced layout — process 0
+            // always holds the max local count
+            const int Lmax = static_cast<int>(balanced_local(world_, nprocs_, 0));
+            std::vector<float> mine(Lmax, 0.0f);
+            std::vector<float> all(static_cast<std::size_t>(nprocs_) *
+                                   Lmax);
             for (int i = 0; i < L_; ++i)
               mine[i] = static_cast<float>(split_colors_[i]);
-            ctrl_->Allgather(mine.data(), all.data(), L_);
-            for (int r = 0; r < world_; ++r)
-              world_colors[r] = static_cast<int>(all[r]);
+            ctrl_->Allgather(mine.data(), all.data(), Lmax);
+            for (int p = 0; p < nprocs_; ++p) {
+              const int s = static_cast<int>(balanced_start(world_, nprocs_, p));
+              const int lp = static_cast<int>(balanced_local(world_, nprocs_, p));
+              for (int i = 0; i < lp; ++i)
+                world_colors[s + i] =
+                    static_cast<int>(all[static_cast<std::size_t>(p) *
+                                         Lmax + i]);
+            }
           } else {
             world_colors = split_colors_;
           }
-          split_sets_[seq] = build_set(world_colors, name);
+          split_sets_[seq] =
+              build_set(world_colors, name, colors_uniform(split_colors_));
         } catch (...) {
           split_sets_[seq] = nullptr;
           // the builder throws before the retrieval below, so account
@@ -874,6 +976,15 @@ class HierFabric : public Fabric {
     if (!set)
       throw std::runtime_error(
           "hier split: group construction failed on another thread");
+    // local device sub-communicator only when this process's color
+    // restriction is uniform (XLA replica_groups constraint); all local
+    // threads agree on the flag, so either all of them enter the local
+    // split rendezvous or none does, keeping the local fabric's split
+    // sequence aligned.  Non-uniform: the local phase runs on host
+    // (set->local_uniform routing in run_collective/dcn_phase).
+    std::unique_ptr<ProxyCommunicator> sub;
+    if (set->local_uniform)
+      sub = local_.split(world_rank - base_, color, name + "_ici");
     return std::make_unique<HierCommunicator>(std::move(set), std::move(sub),
                                               world_rank, dtype_, num_slots_,
                                               name);
@@ -900,6 +1011,13 @@ class HierFabric : public Fabric {
     meta["backend"] = "pjrt";
     meta["num_processes"] = nprocs_;
     meta["local_world"] = L_;
+    // full layout so analyses of uneven-locals runs (world % procs != 0)
+    // can reconstruct every process's share, not just this one's
+    Json lw = Json::array();
+    for (int p = 0; p < nprocs_; ++p)
+      lw.push_back(
+          static_cast<std::int64_t>(balanced_local(world_, nprocs_, p)));
+    meta["local_worlds"] = lw;
     meta["dcn_transport"] = "tcp";
     meta["p2p_transport"] = "host+tcp";
     // every DCN leg is a block-routed direct exchange moving the
@@ -917,11 +1035,16 @@ class HierFabric : public Fabric {
   }
 
  private:
-  static int checked_local(int world, int nprocs) {
-    if (nprocs <= 0 || world <= 0 || world % nprocs != 0)
+  static int checked_local(int world, int nprocs, int proc_rank) {
+    // world need NOT divide procs: the balanced layout gives the first
+    // world%procs processes one extra local rank (uneven locals — the
+    // real-pod case of a ragged last host).  Every process must still
+    // host at least one rank.
+    if (nprocs <= 0 || world < nprocs)
       throw std::invalid_argument(
-          "hier fabric: world must be a positive multiple of --procs");
-    return world / nprocs;
+          "hier fabric: need world >= procs >= 1 (every process hosts "
+          "at least one rank)");
+    return static_cast<int>(balanced_local(world, nprocs, proc_rank));
   }
 
   std::vector<int> all_procs() const {
@@ -942,13 +1065,27 @@ class HierFabric : public Fabric {
                                              name);
   }
 
+  // Equal-size color classes?  (The local device phase needs ONE
+  // XLA module shape across this process's co-resident groups.)
+  static bool colors_uniform(const std::vector<int>& colors) {
+    std::map<int, int> cnt;
+    for (int c : colors) ++cnt[c];
+    for (const auto& kv : cnt)
+      if (kv.second != cnt.begin()->second) return false;
+    return true;
+  }
+
   std::shared_ptr<hier::GroupSet> build_set(
-      const std::vector<int>& world_colors, const std::string& name) {
+      const std::vector<int>& world_colors, const std::string& name,
+      bool local_uniform = true) {
     auto set = std::make_shared<hier::GroupSet>();
     set->world = world_;
-    set->local = L_;
     set->nprocs = nprocs_;
     set->my_proc = proc_rank_;
+    set->local_uniform = local_uniform;
+    set->starts.resize(nprocs_);
+    for (int p = 0; p < nprocs_; ++p)
+      set->starts[p] = static_cast<int>(balanced_start(world_, nprocs_, p));
     set->group_of.resize(world_);
     set->grank_of.resize(world_);
     std::map<int, std::vector<int>> by_color;
@@ -959,7 +1096,7 @@ class HierFabric : public Fabric {
       for (std::size_t k = 0; k < members.size(); ++k) {
         set->group_of[members[k]] = gi;
         set->grank_of[members[k]] = static_cast<int>(k);
-        int p = members[k] / L_;
+        int p = set->proc_of(members[k]);
         if (info.procs.empty() || info.procs.back() != p) {
           info.procs.push_back(p);
           info.members_by_proc.emplace_back();
@@ -987,6 +1124,8 @@ class HierFabric : public Fabric {
       for (int r : set->groups[gi])
         if (set->proc_of(r) == proc_rank_) lg->local_members.push_back(r);
       lg->tcp = std::move(tcp);
+      if (!local_uniform)  // host mailbox replaces the device sub's p2p
+        lg->mbox = std::make_unique<shm::Mailboxes>();
       for (int s = 0; s <= num_slots_; ++s)
         lg->rdv.push_back(std::make_unique<hier::Rendezvous>(
             static_cast<int>(lg->local_members.size())));

@@ -110,21 +110,40 @@ struct Grid3D {
   i64 tp_color(i64 r) const { auto c = coords(r); return c.dp_id * pp + c.pp_id; }
 };
 
+// The hier fabric's balanced contiguous rank->process layout: process p
+// hosts world/procs ranks, the first world%procs processes one extra
+// (uneven locals).  DERIVED identically everywhere it is needed — the
+// fabric (hier_fabric.hpp), the span stamping below, and tests — never
+// exchanged on the wire.
+inline i64 balanced_local(i64 world, i64 procs, i64 p) {
+  return world / procs + (p < world % procs ? 1 : 0);
+}
+
+inline i64 balanced_start(i64 world, i64 procs, i64 p) {
+  const i64 base = world / procs, rem = world % procs;
+  return p * base + (p < rem ? p : rem);
+}
+
+inline i64 balanced_proc_of(i64 world, i64 procs, i64 rank) {
+  for (i64 p = procs - 1; p >= 0; --p)
+    if (rank >= balanced_start(world, procs, p)) return p;
+  return 0;
+}
+
 // Max OS processes any single group of an axis split spans, under the
-// hier fabric's contiguous rank->process layout (world/procs local
-// ranks per process, hier_fabric.hpp).  Stamped into comm-model
-// components ("span") so the small-allreduce full-mesh busbw refusal
+// hier fabric's balanced contiguous rank->process layout (above;
+// handles uneven locals).  Stamped into comm-model components ("span")
+// so the small-allreduce full-mesh busbw refusal
 // (analysis/bandwidth.py) keys on the group's REAL DCN mesh width: a
 // group contained in one process (span 1) never touches the DCN and
 // must not be refused on the record-global process count (advisor r4).
 // `color_of` maps world rank -> group color (Grid3D::*_color).
 template <typename ColorFn>
 inline i64 axis_span_procs(i64 world, i64 procs, ColorFn color_of) {
-  if (procs <= 1 || world <= 0 || world % procs != 0) return 1;
-  const i64 locals = world / procs;
+  if (procs <= 1 || world <= 0) return 1;
   std::map<i64, std::set<i64>> procs_by_color;
   for (i64 r = 0; r < world; ++r)
-    procs_by_color[color_of(r)].insert(r / locals);
+    procs_by_color[color_of(r)].insert(balanced_proc_of(world, procs, r));
   i64 mx = 1;
   for (const auto& kv : procs_by_color)
     mx = std::max<i64>(mx, static_cast<i64>(kv.second.size()));

@@ -30,7 +30,16 @@ namespace {
     }                                                                    \
   } while (0)
 
-void rank_body(int g, int world, int local, HierFabric& fab) {
+void rank_body(int g, int world, int procs, HierFabric& fab) {
+  // balanced contiguous layout, uneven-aware (schedule.hpp): colors
+  // below are derived from (proc, local index) so the selftest covers
+  // worlds that do NOT divide procs (ragged last hosts)
+  auto proc_of = [&](int r) {
+    return static_cast<int>(balanced_proc_of(world, procs, r));
+  };
+  auto lidx_of = [&](int r) {
+    return r - static_cast<int>(balanced_start(world, procs, proc_of(r)));
+  };
   auto comm = fab.world_comm(g);
   REQUIRE(comm->rank() == g);
   REQUIRE(comm->size() == world);
@@ -91,17 +100,19 @@ void rank_body(int g, int world, int local, HierFabric& fab) {
     comm->RingShift(out.data(), in.data(), 4);
     REQUIRE(in.get(0) == static_cast<float>((g + world - 1) % world));
   }
-  // split with groups SPANNING processes (color = g % local: members
-  // stride across the process boundary — the DCN-active orientation)
+  // split with groups SPANNING processes (color = local index: members
+  // stride across the process boundary — the DCN-active orientation;
+  // with uneven locals the higher indices exist only on the larger
+  // processes, so the spanning groups are themselves uneven)
   {
-    auto span = fab.split(g, g % local, "span");
+    auto span = fab.split(g, lidx_of(g), "span");
     int G = span->size();
     Tensor src(2, DType::F32), dst(2, DType::F32);
     src.fill(static_cast<float>(g));
     span->Allreduce(src.data(), dst.data(), 2);
-    float expect = 0;  // sum over {r : r % local == g % local}
+    float expect = 0;  // sum over {r : lidx_of(r) == lidx_of(g)}
     for (int r = 0; r < world; ++r)
-      if (r % local == g % local) expect += static_cast<float>(r);
+      if (lidx_of(r) == lidx_of(g)) expect += static_cast<float>(r);
     REQUIRE(dst.get(0) == expect);
     // reduce-scatter on the spanning group
     Tensor rs_src(G, DType::F32), rs_dst(1, DType::F32);
@@ -119,8 +130,8 @@ void rank_body(int g, int world, int local, HierFabric& fab) {
   // uneven/subset shape.
   {
     auto uneven_color = [&](int r) {
-      int p = r / local, i = r % local;
-      if (world == 12 && local == 4)
+      int p = proc_of(r), i = lidx_of(r);
+      if (world == 12 && procs == 3)
         return p == 0 ? (i < 2 ? 0 : 1) : (p == 1 ? 0 : (i < 2 ? 1 : 2));
       return 0;  // elsewhere: one group spanning every process
     };
@@ -161,10 +172,72 @@ void rank_body(int g, int world, int local, HierFabric& fab) {
       REQUIRE(ri.get(0) == static_cast<float>(mem[(gr + G - 1) % G]));
     }
   }
-  // split with groups CONTAINED in one process (color = g / local: the
-  // DCN leg must stay silent; group sums still correct)
+  // RAGGED split (color = g % 2): with uneven locals (e.g. world 5 /
+  // procs 2 -> locals 3,2) a process's restriction has UNEQUAL color
+  // groups — no uniform replica_groups exists for a local device
+  // module, so the fabric must take the host-local path
+  // (GroupSet::local_uniform == false) while processes with uniform
+  // restrictions may stay on the device path; the DCN wire format is
+  // shared.  At even worlds this split degenerates to the device path
+  // — same checks, both routes.
+  if (world > 2) {
+    auto rag = fab.split(g, g % 2, "ragged");
+    std::vector<int> mem;
+    for (int r = 0; r < world; ++r)
+      if (r % 2 == g % 2) mem.push_back(r);
+    int G = static_cast<int>(mem.size());
+    int gr = -1;
+    for (int k = 0; k < G; ++k)
+      if (mem[k] == g) gr = k;
+    REQUIRE(rag->size() == G && rag->rank() == gr);
+    // allreduce: sum over the group's members
+    Tensor src(3, DType::F32), dst(3, DType::F32);
+    src.fill(static_cast<float>(g));
+    rag->Allreduce(src.data(), dst.data(), 3);
+    float expect = 0;
+    for (int r : mem) expect += static_cast<float>(r);
+    REQUIRE(dst.get(0) == expect && dst.get(2) == expect);
+    // allgather in group-rank order
+    Tensor one(1, DType::F32), ag(G, DType::F32);
+    one.set(0, static_cast<float>(g));
+    rag->Allgather(one.data(), ag.data(), 1);
+    for (int k = 0; k < G; ++k)
+      REQUIRE(ag.get(k) == static_cast<float>(mem[k]));
+    // reduce-scatter-block: every block gets the member sum
+    Tensor rs(G, DType::F32), rd(1, DType::F32);
+    rs.fill(static_cast<float>(g));
+    rag->ReduceScatterBlock(rs.data(), rd.data(), 1);
+    REQUIRE(rd.get(0) == expect);
+    // alltoall block routing
+    Tensor as(G, DType::F32), ad(G, DType::F32);
+    for (int q = 0; q < G; ++q)
+      as.set(q, static_cast<float>(100 * g + q));
+    rag->Alltoall(as.data(), ad.data(), 1);
+    for (int q = 0; q < G; ++q)
+      REQUIRE(ad.get(q) == static_cast<float>(100 * mem[q] + gr));
+    // ring rotation
+    if (G > 1) {
+      Tensor ro(2, DType::F32), ri(2, DType::F32);
+      ro.fill(static_cast<float>(g));
+      rag->RingShift(ro.data(), ri.data(), 2);
+      REQUIRE(ri.get(0) == static_cast<float>(mem[(gr + G - 1) % G]));
+    }
+    // p2p ring WITHIN the group: local pairs ride the host mailbox
+    // when the split has no device sub, cross-process pairs ride TCP
+    if (G > 1) {
+      Tensor po(2, DType::F32), pi(2, DType::F32);
+      po.fill(static_cast<float>(2000 + g));
+      rag->Isend(po.data(), 2, (gr + 1) % G, 0, /*tag=*/9);
+      rag->Irecv(pi.data(), 2, (gr + G - 1) % G, 1, /*tag=*/9);
+      rag->WaitAll(2);
+      REQUIRE(pi.get(0) ==
+              static_cast<float>(2000 + mem[(gr + G - 1) % G]));
+    }
+  }
+  // split with groups CONTAINED in one process (color = owning proc:
+  // the DCN leg must stay silent; group sums still correct)
   {
-    auto ici = fab.split(g, g / local, "ici_only");
+    auto ici = fab.split(g, proc_of(g), "ici_only");
     Tensor src(2, DType::F32), dst(2, DType::F32);
     src.fill(1.0f);
     ici->Allreduce(src.data(), dst.data(), 2);
@@ -172,7 +245,7 @@ void rank_body(int g, int world, int local, HierFabric& fab) {
     Tensor ag(ici->size(), DType::F32), one(1, DType::F32);
     one.set(0, static_cast<float>(g));
     ici->Allgather(one.data(), ag.data(), 1);
-    int base = (g / local) * local;
+    int base = static_cast<int>(balanced_start(world, procs, proc_of(g)));
     for (int k = 0; k < ici->size(); ++k)
       REQUIRE(ag.get(k) == static_cast<float>(base + k));
   }
@@ -205,10 +278,11 @@ int main(int argc, char** argv) {
   int prank = static_cast<int>(args.integer("rank"));
 
   try {
-    int local = world / procs;
+    // this process's share of the balanced (possibly uneven) layout
+    int local = static_cast<int>(balanced_local(world, procs, prank));
     HierFabric fab(args.str("coordinator"), procs, prank, world, DType::F32,
                    make_pjrt_executor(local, "", {}, std::cerr));
-    fab.launch([&](int g) { rank_body(g, world, local, fab); });
+    fab.launch([&](int g) { rank_body(g, world, procs, fab); });
     std::printf("hier_selftest process %d OK\n", prank);
     return 0;
   } catch (const std::exception& e) {

@@ -172,16 +172,20 @@ inline ProxyEnv make_env(const Args& args) {
       throw std::runtime_error(
           "--procs > 1 requires --backend pjrt (the hierarchical ICI+DCN "
           "fabric; the tcp backend is one-rank-per-process already)");
-    if (env.world % env.procs != 0)
-      throw std::runtime_error("--world must be a multiple of --procs");
+    if (env.world < env.procs)
+      throw std::runtime_error(
+          "--world must be >= --procs (every process hosts at least one "
+          "rank; uneven worlds take the balanced layout)");
     if (env.coordinator.empty())
       throw std::runtime_error(
           "--procs > 1 needs --coordinator host:port and --rank");
     if (env.proc_rank < 0 || env.proc_rank >= env.procs)
       throw std::runtime_error("--rank must be in [0, --procs)");
   }
-  // with multiple processes, each process drives world/procs local devices
-  int local_world = env.world / env.procs;
+  // with multiple processes, each process drives its balanced share of
+  // the world (uneven when world does not divide procs)
+  int local_world = static_cast<int>(
+      balanced_local(env.world, env.procs, env.proc_rank));
   if (!env.devices.empty()) {
     if (env.backend != "pjrt")
       throw std::runtime_error(
@@ -205,8 +209,12 @@ inline std::unique_ptr<Fabric> make_fabric(const ProxyEnv& env) {
   if (env.backend == "pjrt" && env.procs > 1)
     return std::make_unique<HierFabric>(
         env.coordinator, env.procs, env.proc_rank, env.world, env.dtype,
-        make_pjrt_executor(env.world / env.procs, env.pjrt_plugin,
-                           env.devices, std::cerr));
+        // this process's share of the balanced layout — uneven when
+        // world does not divide procs (hier_fabric.hpp)
+        make_pjrt_executor(
+            static_cast<int>(balanced_local(env.world, env.procs,
+                                            env.proc_rank)),
+            env.pjrt_plugin, env.devices, std::cerr));
   if (env.backend == "pjrt")
     return std::make_unique<PjrtFabric>(
         env.world, env.dtype,
