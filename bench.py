@@ -258,10 +258,10 @@ def main() -> int:
     fp8_chain = _aux("fp8 swiglu chain", _bench_fp8_swiglu_chain,
                      card, hw_key, dev)
     int8 = _aux("int8 matmul", _bench_int8_matmul, card, hw_key, dev)
-    # LAST among the aux lines: it is the most expensive (two full
-    # compile+measure passes) and the only one with a known
-    # backend-poisoning failure mode (the r5 no-remat OOM) — running it
-    # after the cheap lines means a blowup costs only itself
+    # LAST among the aux lines: it is the most expensive (a full
+    # train-step compile+measure) and the only one with a known
+    # backend-poisoning failure mode (the r5 composed-VJP OOM) —
+    # running it after the cheap lines means a blowup costs only itself
     int8_step = _aux("int8 train step", _bench_int8_step, card, hw_key,
                      dev, step_s, opts)
 
@@ -298,21 +298,21 @@ def _bench_int8_step(card, hw_key: str, dev, bf16_step_s: float,
     full step, where quantization costs extra HBM passes (amax
     reduction + rescale per operand).
 
-    MEMORY: at the headline's no-remat shape the int8 path OOMs the
-    chip (measured r5) — the int32 dot accumulators and the f32 rescale
-    intermediates are 2x the bf16 buffers in flight — so this line runs
-    a CONTROLLED PAIR at full remat: the bf16 step and the int8 step
-    are both measured fresh with ``remat=True``, identical in every
-    other knob, and ``speedup_vs_bf16`` is their paired ratio.  The
-    headline (no-remat bf16) time rides along as
-    ``headline_bf16_ms`` so the remat tax (~12% per the r2 sweep)
-    stays visible.  ``vs_baseline`` divides by an int8-AWARE
-    split-peak roofline: only the forward MLP dots are priced at the
-    int8 peak (the backward is straight-through bf16 by design), the
-    rest of the step at the bf16 peak — the step's AI is thousands of
-    FLOP/B vs a ~240 ridge, so the compute-bound form of min(peak,
-    AI*BW) is exact here.  (Remat recompute FLOPs are NOT credited,
-    matching MFU convention — both sides of the pair pay them.)
+    Runs at the headline's EXACT config (no remat) — ``mlp_dtype`` is
+    the only difference — so ``speedup_vs_bf16`` divides the headline
+    measurement of this same session by this line.  That needed the r5
+    fused whole-SwiGLU VJP (ops/int8.py swiglu_int8): the composed
+    int8_dot form saved the [B, S, ff] down-projection input ``h`` as
+    a residual the bf16 path never materializes and OOM'd no-remat
+    (first r5 capture, docs/studies/int8_step_r5); recomputing ``h``
+    elementwise from g/u brings the residual footprint back to the
+    bf16 path's, and the step fits — measured 494.3 ms vs 537.5
+    (0.92).  ``vs_baseline`` divides by an int8-AWARE split-peak
+    roofline: only the forward MLP dots are priced at the int8 peak
+    (the backward is straight-through bf16 by design), the rest of the
+    step at the bf16 peak — the step's AI is thousands of FLOP/B vs a
+    ~240 ridge, so the compute-bound form of min(peak, AI*BW) is exact
+    here.
 
     Reference frame: the reference's low-precision support stops at
     comm-buffer dtype selection (data_types.hpp:36-79); an int8
@@ -330,19 +330,13 @@ def _bench_int8_step(card, hw_key: str, dev, bf16_step_s: float,
         return None
 
     K = 10
-
-    def measure(mlp_dtype: str) -> tuple[float, float]:
-        train_k_fn, params, tokens, _, _ = bench_step.build(
-            K, mlp_dtype=mlp_dtype, remat=True)
-        train_k = jax.jit(train_k_fn, compiler_options=opts)
-        _, losses = train_k(params, tokens)  # compile
-        losses[-1].item()                    # true fence (see headline)
-        samples = [t / K
-                   for t in time_callable(train_k, params, tokens, reps=3)]
-        return statistics.median(samples), float(losses[-1])
-
-    bf16_remat_s, _ = measure("bfloat16")
-    step_s, loss = measure("int8")
+    train_k_fn, params, tokens, _, _ = bench_step.build(K, mlp_dtype="int8")
+    train_k = jax.jit(train_k_fn, compiler_options=opts)
+    _, losses = train_k(params, tokens)  # compile
+    losses[-1].item()                    # true fence (see headline)
+    samples = [t / K
+               for t in time_callable(train_k, params, tokens, reps=3)]
+    step_s, loss = statistics.median(samples), float(losses[-1])
 
     lm_head_flops = 2 * BATCH * SEQ * card.embed_dim * VOCAB
     fwd_flops = roofline.model_flops(card, BATCH) + lm_head_flops
@@ -351,14 +345,13 @@ def _bench_int8_step(card, hw_key: str, dev, bf16_step_s: float,
     roofline_split_s = (int8_flops / int8_peak
                         + (total_flops - int8_flops) / hw.peak("bfloat16"))
     line = {
-        "metric": f"int8-MLP train step (fwd MLP dots int8, bwd "
-                  f"straight-through bf16; paired vs bf16 at identical "
-                  f"full-remat config), same shape as headline, "
+        "metric": f"int8-MLP train step (fwd MLP dots int8 via fused "
+                  f"swiglu VJP, bwd straight-through bf16; headline "
+                  f"config, mlp_dtype the only delta), "
                   f"{dev.device_kind} ({hw_key})",
         "value": round(step_s * 1e3, 3),
         "unit": "ms",
-        "speedup_vs_bf16": round(bf16_remat_s / step_s, 4),
-        "bf16_remat_ms": round(bf16_remat_s * 1e3, 3),
+        "speedup_vs_bf16": round(bf16_step_s / step_s, 4),
         "headline_bf16_ms": round(bf16_step_s * 1e3, 3),
         "vs_baseline": round(roofline_split_s / step_s, 4),
         "tflops_achieved": round(total_flops / step_s / 1e12, 2),

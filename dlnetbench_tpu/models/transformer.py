@@ -46,6 +46,15 @@ class TransformerConfig:
                              # (keep matmul outputs, recompute elementwise —
                              # measured ~6% faster than full at S=2048 on v5e
                              # for a fraction of full-remat's memory saving)
+    remat_scope: str = "block"     # "block" checkpoints the whole decoder
+                             # block; "mlp" checkpoints ONLY the gated MLP —
+                             # the [B, S, ff] g/u pre-activation saves are
+                             # the dominant residuals (roofline
+                             # train_step_bytes), and for mlp_dtype="int8"
+                             # the int32/f32 quantization intermediates
+                             # stay transient in BOTH passes (the r5
+                             # no-remat OOM source), at the price of
+                             # recomputing 3 MLP matmuls per layer
     attention_impl: str = "auto"   # ops.attention dispatch: auto | flash | xla
     scan_layers: bool = True       # lax.scan over the layer stack (O(1)
                              # compile time in depth); False unrolls the
@@ -63,8 +72,10 @@ class TransformerConfig:
                              # was an HBM-residency artifact);
                              # "int8" likewise via ops/int8.py — 0.98 of
                              # the 2x int8 peak in isolation and a
-                             # measured 1.089x END-TO-END step win vs
-                             # bf16 at matched remat (r5, docs/PERF.md);
+                             # measured 1.087x END-TO-END step win at
+                             # the headline's no-remat config (494.3 vs
+                             # 537.5 ms, r5 docs/PERF.md — needs the
+                             # fused swiglu_int8 VJP);
                              # backward stays in the master dtype
                              # (straight-through) for both
     moe_impl: str = "dense"        # "dense" (every expert computes every
@@ -87,6 +98,14 @@ class TransformerConfig:
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}; "
                              f"expected 'full' or 'dots'")
+        if self.remat_scope not in ("block", "mlp"):
+            raise ValueError(f"unknown remat_scope {self.remat_scope!r}; "
+                             f"expected 'block' or 'mlp'")
+        if self.remat_scope == "mlp" and (self.num_experts > 1
+                                          or not self.gated):
+            raise ValueError(
+                "remat_scope='mlp' covers the dense gated (SwiGLU) MLP "
+                "path only")
         if self.moe_impl not in ("dense", "sparse"):
             raise ValueError(f"unknown moe_impl {self.moe_impl!r}; "
                              f"expected 'dense' or 'sparse'")
@@ -227,23 +246,32 @@ def _block(cfg: TransformerConfig, x, lp, positions):
             y2 = moe(y.reshape(b * s, d), lp["w_router"],
                      lp["w_gate"], lp["w_up"], lp["w_down"],
                      cfg.top_k).reshape(b, s, d)
-        elif cfg.mlp_dtype == "float8":
-            from dlnetbench_tpu.ops.fp8 import swiglu_fp8
-            y2 = swiglu_fp8(y, lp["w_gate"], lp["w_up"], lp["w_down"])
-        elif cfg.mlp_dtype == "int8":
-            from dlnetbench_tpu.ops.int8 import swiglu_int8
-            y2 = swiglu_int8(y, lp["w_gate"], lp["w_up"], lp["w_down"])
-        elif cfg.mlp_backward == "pallas":
-            from dlnetbench_tpu.ops.mlp_backward import swiglu_pallas_bwd
-            y2 = swiglu_pallas_bwd(
-                y.reshape(b * s, d), lp["w_gate"], lp["w_up"],
-                lp["w_down"]).reshape(b, s, d)
-        elif cfg.mlp_backward == "split":
-            y2 = L.swiglu_split_bwd(
-                y.reshape(b * s, d), lp["w_gate"], lp["w_up"],
-                lp["w_down"]).reshape(b, s, d)
         else:
-            y2 = L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
+            if cfg.mlp_dtype == "float8":
+                from dlnetbench_tpu.ops.fp8 import swiglu_fp8
+                mlp_fn = swiglu_fp8
+            elif cfg.mlp_dtype == "int8":
+                from dlnetbench_tpu.ops.int8 import swiglu_int8
+                mlp_fn = swiglu_int8
+            elif cfg.mlp_backward == "pallas":
+                from dlnetbench_tpu.ops.mlp_backward import \
+                    swiglu_pallas_bwd
+
+                def mlp_fn(y, wg, wu, wd):
+                    return swiglu_pallas_bwd(
+                        y.reshape(b * s, d), wg, wu, wd).reshape(b, s, d)
+            elif cfg.mlp_backward == "split":
+                def mlp_fn(y, wg, wu, wd):
+                    return L.swiglu_split_bwd(
+                        y.reshape(b * s, d), wg, wu, wd).reshape(b, s, d)
+            else:
+                mlp_fn = L.swiglu
+            if cfg.remat and cfg.remat_scope == "mlp":
+                # checkpoint ONLY the MLP: recompute the g/u
+                # pre-activations (and, for int8/fp8, the quantization
+                # intermediates) in backward instead of saving them
+                mlp_fn = jax.checkpoint(mlp_fn)
+            y2 = mlp_fn(y, lp["w_gate"], lp["w_up"], lp["w_down"])
     else:
         y = L.layernorm(x, lp["norm2"], lp["norm2_b"])
         y2 = L.gelu_mlp(y, lp["w_in"], lp["b_in"], lp["w_out"], lp["b_out"])
@@ -259,7 +287,7 @@ def forward(params: dict, tokens, cfg: TransformerConfig):
         x = x + params["pos_embed"][positions][None]
 
     block = _block
-    if cfg.remat:
+    if cfg.remat and cfg.remat_scope == "block":
         policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                   if cfg.remat_policy == "dots" else None)
         block = jax.checkpoint(_block, static_argnums=(0,), policy=policy)

@@ -30,6 +30,7 @@ checks fwd+grad against the einsum reference in models/layers.py).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -302,8 +303,39 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_blocks_override(bq: int, bk: int, s: int):
+    """Per-kernel backward block shapes, env-overridable for on-chip
+    sweeps (docs/studies/flash_bwd_blocks_r5):
+    ``DLNB_FLASH_BWD_BLOCKS=bq_dq,bk_dq,bq_dkv,bk_dkv``.  The dq kernel
+    (minor axis = kv blocks, accumulator [bq, dh]) and the dk/dv kernel
+    (minor axis = q blocks, accumulators 2x[bk, dh]) have different live
+    sets, so their optima need not coincide; default: both (bq, bk).
+
+    An experiment knob must fail LOUD: a malformed string or a block
+    that does not divide the sequence raises — truncated grids would
+    silently leave dq rows unwritten and drop query contributions from
+    dk/dv while the sweep records a plausible-looking time."""
+    env = os.environ.get("DLNB_FLASH_BWD_BLOCKS", "")
+    if not env:
+        return (bq, bk), (bq, bk)
+    try:
+        a, b, c, d = (int(x) for x in env.split(","))
+    except ValueError as e:
+        raise ValueError(
+            f"DLNB_FLASH_BWD_BLOCKS={env!r}: expected 4 comma-separated "
+            f"ints (bq_dq,bk_dq,bq_dkv,bk_dkv)") from e
+    for blk in (a, b, c, d):
+        if blk <= 0 or s % blk:
+            raise ValueError(
+                f"DLNB_FLASH_BWD_BLOCKS={env!r}: block {blk} does not "
+                f"divide seq_len {s}")
+    return (a, b), (c, d)
+
+
 def _bwd_impl(q, k, v, out, lse, do, *, causal: bool,
               block_q: int, block_k: int):
+    (bq_dq, bk_dq), (bq_dkv, bk_dkv) = _bwd_blocks_override(
+        block_q, block_k, q.shape[1])
     b, s, hq, dh = q.shape
     hkv = k.shape[2]
     group = hq // hkv
@@ -320,62 +352,64 @@ def _bwd_impl(q, k, v, out, lse, do, *, causal: bool,
     dcap = jnp.broadcast_to(jnp.swapaxes(dcap, 1, 2)[:, :, None, :],
                             (b, hq, _SUBLANES, s))        # sublane-replicated
 
-    nq, nk = s // block_q, s // block_k
+    nq, nk = s // bq_dq, s // bk_dq
 
     def kv_index(bi, h, i, j):
         if causal:  # no DMA for fully-masked KV blocks (see _fwd)
-            j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+            j = jnp.minimum(j, (i * bq_dq + bq_dq - 1) // bk_dq)
         return (bi, j, h // group)
 
-    q_spec = pl.BlockSpec((1, block_q, dh_p),
+    q_spec = pl.BlockSpec((1, bq_dq, dh_p),
                           lambda bi, h, i, j: (bi, i, h),
                           memory_space=pltpu.VMEM)
-    kv_spec = pl.BlockSpec((1, block_k, dh_p), kv_index,
+    kv_spec = pl.BlockSpec((1, bk_dq, dh_p), kv_index,
                            memory_space=pltpu.VMEM)
-    row_spec = pl.BlockSpec((1, 1, _SUBLANES, block_q),
+    row_spec = pl.BlockSpec((1, 1, _SUBLANES, bq_dq),
                             lambda bi, h, i, j: (bi, h, 0, i),
                             memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=bq_dq, block_k=bk_dq),
         grid=(b, hq, nq, nk),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, s, hq * dh_p), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, dh_p), _F32)],
+        scratch_shapes=[pltpu.VMEM((bq_dq, dh_p), _F32)],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qt, kt, vt, dot, lse, dcap)
 
     # dk/dv per q-head; inner (minor) axis walks q blocks
+    nq_t, nk_t = s // bq_dkv, s // bk_dkv
+
     def qi_index(bi, h, j, i):
         if causal:  # skip DMA of q blocks strictly above this kv diagonal
-            i = jnp.maximum(i, (j * block_k) // block_q)
+            i = jnp.maximum(i, (j * bk_dkv) // bq_dkv)
         return i
 
-    q_spec_t = pl.BlockSpec((1, block_q, dh_p),
+    q_spec_t = pl.BlockSpec((1, bq_dkv, dh_p),
                             lambda bi, h, j, i: (bi, qi_index(bi, h, j, i), h),
                             memory_space=pltpu.VMEM)
-    kv_spec_t = pl.BlockSpec((1, block_k, dh_p),
+    kv_spec_t = pl.BlockSpec((1, bk_dkv, dh_p),
                              lambda bi, h, j, i: (bi, j, h // group),
                              memory_space=pltpu.VMEM)
-    kv_out_t = pl.BlockSpec((1, block_k, dh_p),
+    kv_out_t = pl.BlockSpec((1, bk_dkv, dh_p),
                             lambda bi, h, j, i: (bi, j, h),
                             memory_space=pltpu.VMEM)
-    row_spec_t = pl.BlockSpec((1, 1, _SUBLANES, block_q),
+    row_spec_t = pl.BlockSpec((1, 1, _SUBLANES, bq_dkv),
                               lambda bi, h, j, i: (bi, h, 0, qi_index(bi, h, j, i)),
                               memory_space=pltpu.VMEM)
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(b, hq, nk, nq),
+                          block_q=bq_dkv, block_k=bk_dkv),
+        grid=(b, hq, nk_t, nq_t),
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
                   row_spec_t, row_spec_t],
         out_specs=[kv_out_t, kv_out_t],
         out_shape=[jax.ShapeDtypeStruct((b, s, hq * dh_p), k.dtype),
                    jax.ShapeDtypeStruct((b, s, hq * dh_p), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((block_k, dh_p), _F32),
-                        pltpu.VMEM((block_k, dh_p), _F32)],
+        scratch_shapes=[pltpu.VMEM((bk_dkv, dh_p), _F32),
+                        pltpu.VMEM((bk_dkv, dh_p), _F32)],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qt, kt, vt, dot, lse, dcap)

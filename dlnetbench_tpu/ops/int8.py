@@ -3,10 +3,12 @@ compute path that is ACTUALLY fast on this hardware.
 
 Measured on v5e (r4/r5, docs/PERF.md): chained int8->int32 matmuls run
 at 387-390 TOP/s = 0.98-0.99 of the 394 TOP/s int8 peak, and the
-END-TO-END int8-MLP train step beats the paired bf16 step by 1.089x
-(r5, bench.py int8_step) — the only low-precision path with a measured
-end-to-end win on this chip (fp8 reaches 0.70 of its peak in isolation
-but has no step-level win recorded).
+END-TO-END int8-MLP train step runs the HEADLINE config (no remat) at
+494.3 ms vs 537.5 bf16 — a 1.087x step-level win at loss parity (r5,
+bench.py int8_step; needs the fused swiglu_int8 VJP below) — the only
+low-precision path with a measured end-to-end win on this chip (fp8
+reaches 0.70 of its peak in isolation but has no step-level win
+recorded).
 
 Same recipe shape as fp8_dot: bf16 master weights/activations,
 per-tensor symmetric scaling to [-127, 127], int32 accumulation on the
@@ -62,11 +64,57 @@ from dlnetbench_tpu.ops.fp8 import straight_through_dot_bwd  # noqa: E402
 int8_dot.defvjp(_int8_dot_fwd, straight_through_dot_bwd)
 
 
+@jax.custom_vjp
 def swiglu_int8(x, w_gate, w_up, w_down):
     """SwiGLU with all three matmuls in int8 (the int8 sibling of
     layers.swiglu / ops.fp8.swiglu_fp8 — same bf16-rounding discipline
-    for saved residuals)."""
+    for saved residuals).
+
+    Whole-op custom VJP rather than three composed ``int8_dot``s: the
+    composition's down-projection dot saves its input ``h`` ([B, S, ff]
+    — ~345 MB/layer at bench shape) as a residual, which the bf16
+    path's XLA-fused backward never materializes.  Here the backward
+    recomputes ``h`` elementwise from the (anyway-saved) g/u
+    pre-activations, so the residual footprint matches the bf16 path
+    and the int8 step fits where the composition OOM'd (r5,
+    docs/PERF.md).  Backward stays straight-through in the master
+    dtype, identical in semantics to the composed form."""
+    out, _ = _swiglu_int8_fwd(x, w_gate, w_up, w_down)
+    return out
+
+
+def _swiglu_int8_fwd(x, w_gate, w_up, w_down):
     g = int8_dot(x, w_gate)
     u = int8_dot(x, w_up)
     h = (jax.nn.silu(g.astype(_F32)) * u.astype(_F32)).astype(g.dtype)
-    return int8_dot(h, w_down)
+    out = int8_dot(h, w_down)
+    return out, (x, g, u, w_gate, w_up, w_down)
+
+
+def _swiglu_int8_bwd(res, dy):
+    x, g, u, w_gate, w_up, w_down = res
+    gf, uf = g.astype(_F32), u.astype(_F32)
+    silu_g = jax.nn.silu(gf)
+    h = (silu_g * uf).astype(g.dtype)          # recomputed, not saved
+
+    # down projection (straight-through master-dtype grads)
+    dh = jnp.matmul(dy, w_down.T).astype(_F32)
+    d_wd = jnp.matmul(h.reshape(-1, h.shape[-1]).T,
+                      dy.reshape(-1, dy.shape[-1])).astype(w_down.dtype)
+
+    # silu(g) * u elementwise backward
+    sg = jax.nn.sigmoid(gf)
+    d_g = (dh * uf * (sg * (1.0 + gf * (1.0 - sg)))).astype(g.dtype)
+    d_u = (dh * silu_g).astype(u.dtype)
+
+    # gate/up projections
+    d_wg = jnp.matmul(x.reshape(-1, x.shape[-1]).T,
+                      d_g.reshape(-1, d_g.shape[-1])).astype(w_gate.dtype)
+    d_wu = jnp.matmul(x.reshape(-1, x.shape[-1]).T,
+                      d_u.reshape(-1, d_u.shape[-1])).astype(w_up.dtype)
+    d_x = (jnp.matmul(d_g, w_gate.T) + jnp.matmul(d_u, w_up.T)) \
+        .astype(x.dtype)
+    return d_x, d_wg, d_wu, d_wd
+
+
+swiglu_int8.defvjp(_swiglu_int8_fwd, _swiglu_int8_bwd)

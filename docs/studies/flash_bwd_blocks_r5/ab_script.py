@@ -1,0 +1,50 @@
+import sys; sys.path.insert(0, "/root/repo")
+import importlib, os, statistics
+import jax, jax.numpy as jnp
+fa = importlib.import_module('dlnetbench_tpu.ops.flash_attention')
+from dlnetbench_tpu.utils.timing import time_callable
+
+B, S, HQ, HKV, DH = 2, 6144, 32, 8, 128
+K = 8
+q = jax.random.normal(jax.random.key(1), (B, S, HQ, DH), jnp.bfloat16)
+k = jax.random.normal(jax.random.key(2), (B, S, HKV, DH), jnp.bfloat16)
+v = jax.random.normal(jax.random.key(3), (B, S, HKV, DH), jnp.bfloat16)
+
+def make_chain():
+    def loss(q, k, v):
+        o = fa.flash_attention(q, k, v, True, None, None)
+        return (o.astype(jnp.float32) ** 2).sum()
+    g = jax.grad(loss, argnums=(0, 1, 2))
+    def chain(q0, k0, v0):
+        def body(c, _):
+            qc, kc, vc = c
+            dq, dk, dv = g(qc, kc, vc)
+            return (qc + 1e-6 * dq.astype(qc.dtype),
+                    kc + 1e-6 * dk.astype(kc.dtype),
+                    vc + 1e-6 * dv.astype(vc.dtype)), ()
+        return jax.lax.scan(body, (q0, k0, v0), None, length=K)[0]
+    return chain
+
+CANDS = [("base", ""), ("dkv512x2048", "1024,1024,512,2048"),
+         ("dkv512x1024", "1024,1024,512,1024")]
+jits = {}
+for name, env in CANDS:
+    if env: os.environ["DLNB_FLASH_BWD_BLOCKS"] = env
+    j = jax.jit(make_chain())
+    out = j(q, k, v); out[0][0, 0, 0, 0].item()
+    jits[name] = j
+    os.environ.pop("DLNB_FLASH_BWD_BLOCKS", None)
+    print("compiled", name, flush=True)
+
+ratios = {n: [] for n, _ in CANDS[1:]}
+for r in range(15):
+    tb = time_callable(jits["base"], q, k, v, reps=1)[0]
+    for n in ratios:
+        t = time_callable(jits[n], q, k, v, reps=1)[0]
+        ratios[n].append(t / tb)
+    print(f"round {r}: " + " ".join(f"{n}={ratios[n][-1]:.4f}" for n in ratios),
+          flush=True)
+print("\n=== paired per-round ratio medians (vs base, <1 = faster) ===")
+for n in ratios:
+    print(f"{n:14s} median {statistics.median(ratios[n]):.4f}  "
+          f"min {min(ratios[n]):.4f} max {max(ratios[n]):.4f}")

@@ -1,0 +1,77 @@
+import sys; sys.path.insert(0, "/root/repo")
+import os, statistics, time
+import jax, jax.numpy as jnp
+
+import importlib
+fa = importlib.import_module('dlnetbench_tpu.ops.flash_attention')
+from dlnetbench_tpu.utils.timing import time_callable
+
+B, S, HQ, HKV, DH = 2, 6144, 32, 8, 128
+K = 8  # chained grad calls per program
+
+CONFIGS = [
+    ("base_1024x1024", "1024,1024,1024,1024"),
+    ("dq2048x512", "2048,512,1024,1024"),
+    ("dq2048x1024", "2048,1024,1024,1024"),
+    ("dkv512x2048", "1024,1024,512,2048"),
+    ("dkv1024x2048", "1024,1024,1024,2048"),
+    ("both_asym", "2048,512,512,2048"),
+    ("both_512", "512,512,512,512"),
+    ("both_2048", "2048,2048,2048,2048"),
+]
+
+key = jax.random.key(0)
+q = jax.random.normal(jax.random.key(1), (B, S, HQ, DH), jnp.bfloat16)
+k = jax.random.normal(jax.random.key(2), (B, S, HKV, DH), jnp.bfloat16)
+v = jax.random.normal(jax.random.key(3), (B, S, HKV, DH), jnp.bfloat16)
+
+
+def make_chain():
+    def loss(q, k, v):
+        o = fa.flash_attention(q, k, v, True, None, None)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))
+
+    def chain(q0, k0, v0):
+        def body(c, _):
+            qc, kc, vc = c
+            dq, dk, dv = g(qc, kc, vc)
+            # feed grads back so no iteration can be hoisted
+            return (qc + 1e-6 * dq.astype(qc.dtype),
+                    kc + 1e-6 * dk.astype(kc.dtype),
+                    vc + 1e-6 * dv.astype(vc.dtype)), ()
+        return jax.lax.scan(body, (q0, k0, v0), None, length=K)[0]
+    return chain
+
+
+jits = {}
+for name, env in CONFIGS:
+    os.environ["DLNB_FLASH_BWD_BLOCKS"] = env
+    try:
+        j = jax.jit(make_chain())
+        out = j(q, k, v)
+        out[0][0, 0, 0, 0].item()  # compile + fence
+        jits[name] = (j, None)
+        print(f"compiled {name}", flush=True)
+    except Exception as e:
+        print(f"{name}: FAILED compile: {type(e).__name__} {str(e)[:120]}",
+              flush=True)
+    finally:
+        os.environ.pop("DLNB_FLASH_BWD_BLOCKS", None)
+
+# NOTE: the env var is read at TRACE time; each jit captured its config.
+rounds = 5
+samples = {n: [] for n in jits}
+for r in range(rounds):
+    for n, (j, _) in jits.items():
+        t = time_callable(j, q, k, v, reps=1)[0] / K
+        samples[n].append(t)
+    print(f"round {r}: " + " ".join(
+        f"{n}={samples[n][-1]*1e3:.2f}ms" for n in jits), flush=True)
+
+base = statistics.median(samples["base_1024x1024"])
+print("\n=== medians (per grad call: fwd+bwd attention, all 32 heads) ===")
+for n in samples:
+    med = statistics.median(samples[n])
+    print(f"{n:16s} {med*1e3:8.3f} ms  ratio_vs_base {med/base:.4f}")

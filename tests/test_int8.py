@@ -67,6 +67,58 @@ def test_swiglu_int8_close_to_bf16():
     assert rel < 0.1, f"int8 swiglu relative error {rel}"
 
 
+def test_swiglu_int8_fused_vjp_matches_composed():
+    """The hand-written whole-SwiGLU backward (which recomputes h
+    instead of saving it — the r5 no-remat memory fix) must produce
+    EXACTLY the gradients of the composed int8_dot form it replaced;
+    a sign error in the silu-derivative term or a d_wg/d_wu swap
+    (same shapes) would otherwise pass the suite silently."""
+    from dlnetbench_tpu.ops.int8 import int8_dot
+
+    x = jax.random.normal(jax.random.key(7), (48, 32), jnp.bfloat16)
+    wg = jax.random.normal(jax.random.key(8), (32, 40), jnp.bfloat16) * 0.1
+    wu = jax.random.normal(jax.random.key(9), (32, 40), jnp.bfloat16) * 0.1
+    wd = jax.random.normal(jax.random.key(10), (40, 32), jnp.bfloat16) * 0.1
+    cot = jax.random.normal(jax.random.key(11), (48, 32), jnp.bfloat16)
+
+    def composed(x, wg, wu, wd):
+        g = int8_dot(x, wg)
+        u = int8_dot(x, wu)
+        h = (jax.nn.silu(g.astype(jnp.float32))
+             * u.astype(jnp.float32)).astype(g.dtype)
+        return int8_dot(h, wd)
+
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a).astype(jnp.float32)
+                                  * cot.astype(jnp.float32))
+
+    want = jax.grad(loss(composed), argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+    got = jax.grad(loss(swiglu_int8), argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+    for a, b, name in zip(got, want, ("dx", "dwg", "dwu", "dwd")):
+        assert jnp.allclose(a.astype(jnp.float32), b.astype(jnp.float32),
+                            atol=1e-3, rtol=1e-3), name
+
+
+def test_flash_bwd_blocks_override_fails_loud(monkeypatch):
+    """The sweep env knob must raise on malformed strings and
+    non-divisor blocks — a truncated grid would silently compute wrong
+    gradients while recording a plausible time."""
+    from dlnetbench_tpu.ops.flash_attention import _bwd_blocks_override
+
+    monkeypatch.setenv("DLNB_FLASH_BWD_BLOCKS", "1024;1024,1024,1024")
+    with pytest.raises(ValueError, match="comma-separated"):
+        _bwd_blocks_override(1024, 1024, 6144)
+    monkeypatch.setenv("DLNB_FLASH_BWD_BLOCKS", "1280,1024,1024,1024")
+    with pytest.raises(ValueError, match="does not divide"):
+        _bwd_blocks_override(1024, 1024, 6144)
+    monkeypatch.setenv("DLNB_FLASH_BWD_BLOCKS", "2048,512,512,2048")
+    assert _bwd_blocks_override(1024, 1024, 6144) == ((2048, 512),
+                                                     (512, 2048))
+    monkeypatch.delenv("DLNB_FLASH_BWD_BLOCKS")
+    assert _bwd_blocks_override(1024, 1024, 6144) == ((1024, 1024),
+                                                     (1024, 1024))
+
+
 def test_transformer_int8_mlp_trains():
     """mlp_dtype='int8' plumbs through the dense SwiGLU stack: a tiny
     train step runs, loss is finite, grads flow into the MLP weights."""

@@ -178,3 +178,14 @@ def test_remat_policies_agree():
 
     with pytest.raises(ValueError, match="remat_policy"):
         tfm.TransformerConfig(**{**cfg0.__dict__, "remat_policy": "dot"})
+
+    # remat_scope="mlp" (checkpoint only the SwiGLU — the r5 int8
+    # memory knob) must also be gradient-identical; bad scope rejected
+    cfg = tfm.TransformerConfig(
+        **{**cfg0.__dict__, "remat": True, "remat_scope": "mlp"})
+    l1, g1 = lg(cfg)
+    assert jnp.allclose(l0, l1, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        assert jnp.allclose(a, b, rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="remat_scope"):
+        tfm.TransformerConfig(**{**cfg0.__dict__, "remat_scope": "layer"})
