@@ -84,6 +84,13 @@ class TransformerConfig:
                              # style: ~k*cf*T*ffn FLOPs, over-capacity
                              # tokens dropped — the production semantics)
     moe_capacity_factor: float = 1.25
+    int8_backward: str = "master"  # mlp_dtype="int8" backward mode:
+                             # "master" = straight-through bf16 (the
+                             # conservative default); "switchback" =
+                             # the dx-side matmuls (dh, dx) also
+                             # quantized to int8, dW stays master —
+                             # a RECIPE change, opt-in; loss-drift
+                             # measured in docs/studies/int8_step_r5
     mlp_backward: str = "fused"    # SwiGLU backward: "fused" = plain
                              # autodiff (the r4-measured winner);
                              # "split" = pure dots behind barriers
@@ -112,6 +119,13 @@ class TransformerConfig:
         if self.mlp_dtype not in ("bfloat16", "float8", "int8"):
             raise ValueError(f"unknown mlp_dtype {self.mlp_dtype!r}; "
                              f"expected 'bfloat16', 'float8' or 'int8'")
+        if self.int8_backward not in ("master", "switchback"):
+            raise ValueError(
+                f"unknown int8_backward {self.int8_backward!r}; "
+                f"expected 'master' or 'switchback'")
+        if self.int8_backward != "master" and self.mlp_dtype != "int8":
+            raise ValueError(
+                "int8_backward='switchback' requires mlp_dtype='int8'")
         if self.mlp_dtype != "bfloat16" and (self.num_experts > 1
                                              or not self.gated):
             raise ValueError(
@@ -251,8 +265,11 @@ def _block(cfg: TransformerConfig, x, lp, positions):
                 from dlnetbench_tpu.ops.fp8 import swiglu_fp8
                 mlp_fn = swiglu_fp8
             elif cfg.mlp_dtype == "int8":
-                from dlnetbench_tpu.ops.int8 import swiglu_int8
-                mlp_fn = swiglu_int8
+                from dlnetbench_tpu.ops.int8 import (swiglu_int8,
+                                                     swiglu_int8_sb)
+                mlp_fn = (swiglu_int8_sb
+                          if cfg.int8_backward == "switchback"
+                          else swiglu_int8)
             elif cfg.mlp_backward == "pallas":
                 from dlnetbench_tpu.ops.mlp_backward import \
                     swiglu_pallas_bwd

@@ -48,14 +48,20 @@ def int8_dot(x, w):
     return out
 
 
-def _int8_dot_fwd(x, w):
-    xq, sx = _quantize(x)
-    wq, sw = _quantize(w)
-    acc = jax.lax.dot_general(xq, wq,
-                              (((x.ndim - 1,), (0,)), ((), ())),
+def _int8_matmul(a, b_mat, out_dtype):
+    """Quantized a @ b_mat with per-tensor scales and int32 MXU
+    accumulation — the ONE definition of the int8 dot recipe (forward
+    and SwitchBack activation-grad dots share it)."""
+    aq, sa = _quantize(a)
+    bq, sb = _quantize(b_mat)
+    acc = jax.lax.dot_general(aq, bq,
+                              (((a.ndim - 1,), (0,)), ((), ())),
                               preferred_element_type=jnp.int32)
-    out = acc.astype(_F32) * (sx * sw)
-    return out.astype(x.dtype), (x, w)
+    return (acc.astype(_F32) * (sa * sb)).astype(out_dtype)
+
+
+def _int8_dot_fwd(x, w):
+    return _int8_matmul(x, w, x.dtype), (x, w)
 
 
 # master-dtype straight-through backward, shared with the fp8 path
@@ -91,14 +97,20 @@ def _swiglu_int8_fwd(x, w_gate, w_up, w_down):
     return out, (x, g, u, w_gate, w_up, w_down)
 
 
-def _swiglu_int8_bwd(res, dy):
+def _swiglu_bwd_impl(res, dy, act_dot):
+    """Shared SwiGLU backward: ``act_dot(a, b)`` (master-dtype result)
+    runs the three ACTIVATION-GRADIENT matmuls (dh, and the two dx
+    legs) — a plain matmul for the straight-through recipe, the
+    quantized int8 dot for SwitchBack.  Everything else (h recompute
+    instead of save, silu derivative, the three master-dtype dW
+    matmuls) exists ONCE here."""
     x, g, u, w_gate, w_up, w_down = res
     gf, uf = g.astype(_F32), u.astype(_F32)
     silu_g = jax.nn.silu(gf)
     h = (silu_g * uf).astype(g.dtype)          # recomputed, not saved
 
-    # down projection (straight-through master-dtype grads)
-    dh = jnp.matmul(dy, w_down.T).astype(_F32)
+    # down projection: activation grad via act_dot, dW in master dtype
+    dh = act_dot(dy, w_down.T).astype(_F32)
     d_wd = jnp.matmul(h.reshape(-1, h.shape[-1]).T,
                       dy.reshape(-1, dy.shape[-1])).astype(w_down.dtype)
 
@@ -112,9 +124,41 @@ def _swiglu_int8_bwd(res, dy):
                       d_g.reshape(-1, d_g.shape[-1])).astype(w_gate.dtype)
     d_wu = jnp.matmul(x.reshape(-1, x.shape[-1]).T,
                       d_u.reshape(-1, d_u.shape[-1])).astype(w_up.dtype)
-    d_x = (jnp.matmul(d_g, w_gate.T) + jnp.matmul(d_u, w_up.T)) \
-        .astype(x.dtype)
+    d_x = (act_dot(d_g, w_gate.T) + act_dot(d_u, w_up.T)).astype(x.dtype)
     return d_x, d_wg, d_wu, d_wd
 
 
+def _swiglu_int8_bwd(res, dy):
+    return _swiglu_bwd_impl(res, dy, jnp.matmul)
+
+
 swiglu_int8.defvjp(_swiglu_int8_fwd, _swiglu_int8_bwd)
+
+
+@jax.custom_vjp
+def swiglu_int8_sb(x, w_gate, w_up, w_down):
+    """SwiGLU, int8 forward AND int8 activation-gradient (dx-side)
+    backward — the SwitchBack recipe (arXiv:2304.13013 pattern: the
+    three dL/dactivation matmuls are quantized per-tensor; the three
+    dL/dW matmuls stay in the master dtype, where gradient accuracy
+    lives).  Relative to ``swiglu_int8`` this moves the backward's
+    dh = dy@Wd^T and dx = dg@Wg^T + du@Wu^T onto the 2x int8 MXU rate.
+
+    Numerics are a RECIPE CHANGE (quantization error enters upstream
+    gradients), so this is opt-in via
+    ``TransformerConfig.int8_backward="switchback"``; the r5 loss-
+    trajectory study (docs/studies/int8_step_r5) measures the drift
+    against the master-dtype backward before trusting the speed."""
+    out, _ = _swiglu_int8_fwd(x, w_gate, w_up, w_down)
+    return out
+
+
+def _sb_dot(a, b_mat):
+    return _int8_matmul(a, b_mat, a.dtype)
+
+
+def _swiglu_int8_sb_bwd(res, dy):
+    return _swiglu_bwd_impl(res, dy, _sb_dot)
+
+
+swiglu_int8_sb.defvjp(_swiglu_int8_fwd, _swiglu_int8_sb_bwd)

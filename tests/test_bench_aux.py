@@ -27,6 +27,18 @@ def test_aux_success_passes_through(capsys):
     assert capsys.readouterr().out == ""
 
 
+def test_above_peak_readings_are_flagged():
+    """A short-chain line whose ratio exceeds 1.0 (physically
+    impossible — fence-RTT over-subtraction) must carry the upper-bound
+    note; in-range lines must not."""
+    import bench
+
+    hot = bench._flag_above_peak({"metric": "x", "vs_baseline": 1.05})
+    assert "note" in hot and "above-peak" in hot["note"]
+    ok = bench._flag_above_peak({"metric": "x", "vs_baseline": 0.98})
+    assert "note" not in ok
+
+
 def test_aux_deadline_skips_instead_of_running(capsys, monkeypatch):
     """Past the wall-clock deadline the aux fn must not even start —
     the headline line takes precedence over auxiliary coverage."""
