@@ -71,6 +71,38 @@ int8_dot.defvjp(_int8_dot_fwd, straight_through_dot_bwd)
 
 
 @jax.custom_vjp
+def int8_dot_batched(x, w):
+    """[E, C, K] x [E, K, N] -> [E, C, N]: per-tensor-scaled int8
+    operands, int32 MXU accumulation batched over the leading (expert)
+    axis — the MoE/EP sibling of ``int8_dot`` (models/spmd.py expert
+    einsums).  Backward is straight-through in the master dtype."""
+    out, _ = _int8_dot_batched_fwd(x, w)
+    return out
+
+
+def _int8_dot_batched_fwd(x, w):
+    xq, sx = _quantize(x)
+    wq, sw = _quantize(w)
+    acc = jax.lax.dot_general(xq, wq,
+                              (((2,), (1,)), ((0,), (0,))),
+                              preferred_element_type=jnp.int32)
+    out = acc.astype(_F32) * (sx * sw)
+    return out.astype(x.dtype), (x, w)
+
+
+def _int8_dot_batched_bwd(res, dy):
+    x, w = res
+    d_x = jax.lax.dot_general(
+        dy, w, (((2,), (2,)), ((0,), (0,)))).astype(x.dtype)
+    d_w = jax.lax.dot_general(
+        x, dy, (((1,), (1,)), ((0,), (0,)))).astype(w.dtype)
+    return d_x, d_w
+
+
+int8_dot_batched.defvjp(_int8_dot_batched_fwd, _int8_dot_batched_bwd)
+
+
+@jax.custom_vjp
 def swiglu_int8(x, w_gate, w_up, w_down):
     """SwiGLU with all three matmuls in int8 (the int8 sibling of
     layers.swiglu / ops.fp8.swiglu_fp8 — same bf16-rounding discipline
