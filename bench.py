@@ -30,15 +30,19 @@ from dlnetbench_tpu.models.bench_step import BATCH, SEQ, LAYERS, VOCAB
 
 
 def _measure_chain(fn, arg, k: int) -> float:
-    """jit + compile + TRUE fence (a device->host transfer — on the
+    """AOT compile (core/executor.py: compile time can't leak into the
+    first timed round) + TRUE fence (a device->host transfer — on the
     tunnel backend block_until_ready only acks dispatch), then median
     of 3 K-chained rounds, per-iteration seconds.  Shared by every
-    auxiliary bench line so fence/timing fixes happen once."""
+    auxiliary bench line so fence/timing fixes happen once.  The carry
+    is donated; the executor rebinds it from the chain output."""
+    from dlnetbench_tpu.core import executor
     from dlnetbench_tpu.utils.timing import time_callable
-    j = jax.jit(fn)
-    out = j(arg)
+    prog = executor.CompiledProgram(executor.Program(
+        fn=fn, args=(arg,), donate_argnums=(0,)))
+    out = prog()  # warm run (already compiled)
     _ = out[0, 0].item() if hasattr(out[0, 0], "item") else int(out[0, 0])
-    return statistics.median(time_callable(j, arg, reps=3)) / k
+    return statistics.median(time_callable(prog, reps=3)) / k
 
 
 def _roofline_s(flops: int, nbytes: int, hw, dtype_key: str) -> float:
@@ -192,15 +196,25 @@ def main() -> int:
     # TPU-only flag, so gate on the backend for CPU-mesh runs
     opts = ({"xla_tpu_scoped_vmem_limit_kib": "32768"}
             if jax.default_backend() == "tpu" else None)
-    train_k = jax.jit(train_k_fn, compiler_options=opts)
+    # AOT through the execution engine: compile happens HERE (recorded as
+    # compile_ms, never inside a timed round), params are donated so the
+    # optimizer update reuses their buffers in place (aliasing recorded
+    # in memory_analysis), and each call rebinds the donated carry
+    from dlnetbench_tpu.core import executor
+    train_k = executor.CompiledProgram(executor.Program(
+        fn=train_k_fn, args=(params, tokens),
+        donate_argnums=bench_step.DONATE_ARGNUMS,
+        compiler_options=opts))
+    aot_stats = train_k.stats
+    del params  # the executor owns a private donated copy
 
-    params2, losses = train_k(params, tokens)  # compile
+    params2, losses = train_k()  # warm run (already compiled)
     losses[-1].item()   # true fence (block_until_ready only acks dispatch
                         # on the tunnel backend) so rep 1 starts clean
 
     # three rounds of K in-program steps (each fences once); median guards
     # against a slow round from tunnel or host jitter
-    samples = [t / K for t in time_callable(train_k, params, tokens, reps=3)]
+    samples = [t / K for t in time_callable(train_k, reps=3)]
     step_s = statistics.median(samples)
     # materialize EVERY device value the headline will print BEFORE any
     # auxiliary line runs: an aux failure that poisons the backend (the
@@ -258,12 +272,12 @@ def main() -> int:
         total_flops, step_bytes_bwd, HARDWARE[hw_key], "bfloat16")
     vs_baseline_bwd_aware = roofline_bwd_s / step_s
 
-    # free the headline's device buffers before any auxiliary line: two
-    # params pytrees + the token batch are ~7 GB of HBM this chip no
-    # longer needs, and the r5 capture showed the int8-step pair OOMing
-    # against exactly that residency (then poisoning the rest of the
-    # aux section)
-    del params, params2, losses, tokens
+    # free the headline's device buffers before any auxiliary line: the
+    # params pytrees (executor-owned donated carry + the last outputs) +
+    # the token batch are ~7 GB of HBM this chip no longer needs, and
+    # the r5 capture showed the int8-step pair OOMing against exactly
+    # that residency (then poisoning the rest of the aux section)
+    del params2, losses, tokens, train_k
 
     # auxiliary lines FIRST so the headline train-step line stays LAST
     # on stdout (tail parsers take the final JSON line); results also
@@ -298,6 +312,12 @@ def main() -> int:
         "tflops_executed": round(achieved * executed_ratio / 1e12, 2),
         "loss": round(loss, 4),
         "logits_dtype": "float32" if cfg.logits_f32 else "bfloat16",
+        # AOT engine bookkeeping: compile wall time (never inside a
+        # timed round) and XLA's memory analysis — alias bytes > 0 is
+        # the donation proof (params aliased argument->output)
+        "compile_ms": aot_stats.get("compile_ms"),
+        **({"memory_analysis": aot_stats["memory_analysis"]}
+           if "memory_analysis" in aot_stats else {}),
         **({"fp8_mlp": fp8} if fp8 else {}),
         **({"fp8_swiglu": fp8_chain} if fp8_chain else {}),
         **({"int8_matmul": int8} if int8 else {}),
@@ -357,11 +377,15 @@ def _bench_int8_step(card, hw_key: str, dev, bf16_step_s: float,
     K = 10
     train_k_fn, params, tokens, _, _ = bench_step.build(
         K, mlp_dtype="int8", int8_backward=int8_backward)
-    train_k = jax.jit(train_k_fn, compiler_options=opts)
-    _, losses = train_k(params, tokens)  # compile
-    losses[-1].item()                    # true fence (see headline)
-    samples = [t / K
-               for t in time_callable(train_k, params, tokens, reps=3)]
+    from dlnetbench_tpu.core import executor
+    train_k = executor.CompiledProgram(executor.Program(
+        fn=train_k_fn, args=(params, tokens),
+        donate_argnums=bench_step.DONATE_ARGNUMS,
+        compiler_options=opts))
+    del params                    # executor owns a private donated copy
+    _, losses = train_k()         # warm run (already compiled)
+    losses[-1].item()             # true fence (see headline)
+    samples = [t / K for t in time_callable(train_k, reps=3)]
     step_s, loss = statistics.median(samples), float(losses[-1])
 
     lm_head_flops = 2 * BATCH * SEQ * card.embed_dim * VOCAB

@@ -34,6 +34,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("-r", "--runs", type=int, default=5)
     p.add_argument("-m", "--min_exectime", type=float, default=0.0,
                    help="seconds; when set, runs are estimated from warmup")
+    p.add_argument("-k", "--reps_per_fence", type=int, default=1,
+                   help="K-chained fencing: K step dispatches per host "
+                        "fence, so dispatch + fence RTT amortize over K "
+                        "iterations instead of biasing every sample "
+                        "(utils/timing.py time_chain); 1 = fence per rep "
+                        "(reference parity)")
     p.add_argument("--loop", action="store_true",
                    help="run the schedule forever (congestor mode)")
     p.add_argument("-d", "--devices", default="0",
@@ -72,9 +78,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
 
 
 def _cfg(args) -> ProxyConfig:
+    if args.reps_per_fence < 1:
+        raise SystemExit("--reps_per_fence must be >= 1")
     return ProxyConfig(warmup=args.warmup, runs=args.runs,
                        min_exectime_s=args.min_exectime, loop=args.loop,
-                       size_scale=args.size_scale, time_scale=args.time_scale)
+                       size_scale=args.size_scale, time_scale=args.time_scale,
+                       reps_per_fence=args.reps_per_fence)
 
 
 def _add_pipeline(p: argparse.ArgumentParser) -> None:

@@ -1,0 +1,294 @@
+"""AOT execution engine: compile once, donate everything, measure clean.
+
+Every step-building path (the L4 proxies, ``models/bench_step.py`` via
+``bench.py``, the sweep driver) routes its jitted programs through this
+module instead of calling ``jax.jit`` and letting the first timed call
+pay for tracing + compilation.  Three properties fall out:
+
+1. **Compilation can never leak into measurement.**  Each program is
+   lowered and compiled ahead of time (``jit(fn).lower(...).compile()``)
+   at *build* time, with the wall cost recorded as ``compile_ms`` in the
+   bundle's ``global_meta`` — so ``warmup_times_us`` (and therefore
+   ``estimate_runs``, the reference's ``-m`` min-exectime logic) see
+   only execution.  The compiled executable also yields XLA's
+   ``cost_analysis`` (FLOPs / bytes accessed — cross-checkable against
+   the schedule algebra's ``comm_model`` byte declarations) and
+   ``memory_analysis`` (argument/output/temp/alias bytes), both stamped
+   into the metadata channel the emitter already carries.
+
+2. **Donation without footguns.**  Proxy steps carry a burn state and
+   gradient/shard buffers through every iteration; donating them
+   (``donate_argnums``) lets XLA update in place instead of emitting a
+   fresh output allocation + copy per step.  A donated jax buffer is
+   *deleted* after the call, so the engine rebinds each donated
+   argument to the structurally-matching output before the next call —
+   callers keep the zero-arg ``bundle.full()`` interface and never see
+   a dead buffer.  The output<->argument pairing is computed from
+   ``jax.eval_shape`` *before* compilation; a requested donation whose
+   leaves have no shape/dtype-matching output is dropped (and recorded
+   in the meta as ``undonated``) rather than left to XLA to warn about.
+
+3. **Warm-start re-runs.**  ``DLNB_COMPILE_CACHE_DIR`` opts into jax's
+   persistent compilation cache (size/compile-time thresholds zeroed so
+   every program is eligible), so a re-run of a sweep — each grid point
+   a fresh process — deserializes executables instead of recompiling.
+   The config is set through one code path so the cache key's
+   compile-environment component is identical across runs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from collections.abc import Callable
+
+import jax
+
+ENV_CACHE_DIR = "DLNB_COMPILE_CACHE_DIR"
+
+# Donation kill-switch.  Each donated program owns a PRIVATE clone of
+# its donated buffers (sibling programs must survive the donation), so
+# a bundle with full/compute/comm step programs holds up to 3 carry
+# sets where the pre-AOT path shared 1.  At dev scales that is noise;
+# at --size_scale 1 on a real chip it can be the OOM margin (bench.py's
+# r5 history) — DLNB_NO_DONATION=1 restores the shared-buffer,
+# copy-per-step behavior without touching any call site.
+ENV_NO_DONATION = "DLNB_NO_DONATION"
+
+_CACHE_CONFIGURED = False
+
+
+def enable_persistent_cache() -> str | None:
+    """Point jax's persistent compilation cache at ``$DLNB_COMPILE_CACHE_DIR``
+    (no-op when unset).  Idempotent; returns the directory in use.
+
+    Thresholds are zeroed so even fast-compiling CPU-mesh programs are
+    cached — the sweep acceptance case is a 3-config CPU sweep whose
+    per-point compiles are hundreds of ms, under jax's 1 s default
+    minimum."""
+    global _CACHE_CONFIGURED
+    cache_dir = os.environ.get(ENV_CACHE_DIR)
+    if not cache_dir:
+        return None
+    if not _CACHE_CONFIGURED:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # jax latches its cache-enabled decision at the FIRST compile of
+        # the process; buffer allocation (sharded_zeros) usually compiles
+        # before we get here, so force a re-evaluation under the new
+        # config or the whole run silently skips the cache
+        try:
+            from jax._src import compilation_cache
+            compilation_cache.reset_cache()
+        except Exception:  # private API drifted: next compile may still
+            pass           # pick the config up; never fail the build
+        _CACHE_CONFIGURED = True
+    return cache_dir
+
+
+@dataclasses.dataclass
+class Program:
+    """One jittable callable plus the concrete buffers it runs on.
+
+    ``donate_argnums`` names top-level positional args whose buffers the
+    engine may donate; the engine only donates an argnum when every one
+    of its leaves has a shape/dtype-matching output leaf to rebind from
+    (otherwise the donation is dropped and listed in the compile record
+    as ``undonated``).
+    """
+    fn: Callable
+    args: tuple
+    donate_argnums: tuple = ()
+    compiler_options: dict | None = None
+
+
+class CompiledProgram:
+    """A zero-arg callable around an AOT-compiled executable.
+
+    Owns the argument buffers: after each call, donated arguments are
+    rebound to their paired outputs so the next call never touches a
+    deleted buffer.  ``stats`` carries compile_ms / cost_analysis /
+    memory_analysis / donation bookkeeping for the metadata channel.
+    """
+
+    def __init__(self, program: Program):
+        enable_persistent_cache()
+        # the traceable python callable, kept for structural analyses
+        # (metrics/profiling.py re-traces it to a jaxpr — the compiled
+        # executable is opaque to make_jaxpr)
+        self.traceable = program.fn
+        args = list(program.args)
+        requested = (() if os.environ.get(ENV_NO_DONATION)
+                     else tuple(program.donate_argnums))
+
+        t0 = time.perf_counter()
+        # one trace covers both lowering and donation planning: the
+        # rebind map needs only output shapes/dtypes, which
+        # ``lowered.out_info`` already carries — a separate eval_shape
+        # pass would re-trace every program (tracing these unrolled
+        # pipeline bodies costs as much as compiling them warm)
+        lowered = jax.jit(program.fn,
+                          donate_argnums=requested).lower(*args)
+        donate, self._rebind, undonated = _plan_donation(
+            jax.tree.leaves(lowered.out_info), args, requested)
+        if donate != requested:
+            # some requested donations have no output to rebind from
+            # (mode/schedule-dependent dummies): re-lower with only the
+            # kept set — the dropped buffers must NOT be invalidated
+            lowered = jax.jit(program.fn,
+                              donate_argnums=donate).lower(*args)
+        self._compiled = lowered.compile(program.compiler_options)
+        compile_ms = (time.perf_counter() - t0) * 1e3
+
+        # donation consumes the buffer, and sibling programs (full /
+        # compute / comm share the proxy's buffers) must stay callable:
+        # every donated argument gets a private device-side copy
+        # (structurally identical to the original, so the executable
+        # lowered above accepts it)
+        for argnum in donate:
+            args[argnum] = _clone(args[argnum])
+        self._args = args
+        self._treedef = jax.tree.structure(tuple(args))
+
+        self.stats = {"compile_ms": round(compile_ms, 3),
+                      "donated_argnums": list(donate)}
+        if undonated:
+            self.stats["undonated"] = undonated
+        self.stats.update(_analyses(self._compiled))
+
+    @property
+    def example_args(self) -> tuple:
+        """The program's current argument buffers (for re-tracing)."""
+        return tuple(self._args)
+
+    def __call__(self):
+        outs = self._compiled(*self._args)
+        if self._rebind:
+            flat_out = jax.tree.leaves(outs)
+            flat_args = jax.tree.leaves(tuple(self._args))
+            for arg_i, out_i in self._rebind:
+                flat_args[arg_i] = flat_out[out_i]
+            self._args = list(jax.tree.unflatten(self._treedef,
+                                                 flat_args))
+        return outs
+
+
+def _clone(tree):
+    """Device-side copy of a pytree of jax.Arrays, shardings preserved.
+    ``device_put`` with the same sharding short-circuits to the original
+    buffer, so the copy goes through a compiled identity-with-copy."""
+    shardings = jax.tree.map(lambda a: a.sharding, tree)
+    copy = jax.jit(lambda t: jax.tree.map(jax.numpy.copy, t),
+                   out_shardings=shardings)
+    return copy(tree)
+
+
+def _plan_donation(out_leaves, args, donate_argnums):
+    """(kept argnums, flat arg-index -> flat out-index rebind pairs,
+    dropped argnums) — computed from the lowering's abstract output
+    leaves (anything with ``.shape``/``.dtype``), before compile."""
+    if not donate_argnums:
+        return (), [], []
+    out_taken = [False] * len(out_leaves)
+
+    # flat index range of each top-level argument
+    arg_leaf_ranges = []
+    pos = 0
+    for a in args:
+        n = len(jax.tree.leaves(a))
+        arg_leaf_ranges.append((pos, pos + n))
+        pos += n
+    flat_args = jax.tree.leaves(tuple(args))
+
+    keep, rebind, dropped = [], [], []
+    for argnum in donate_argnums:
+        lo, hi = arg_leaf_ranges[argnum]
+        pairs = []
+        taken_here: set[int] = set()
+
+        def free(j):
+            return not out_taken[j] and j not in taken_here
+
+        for i in range(lo, hi):
+            a = flat_args[i]
+            # positional preference first: when the step returns its
+            # carries in argument order (every proxy step and the bench
+            # scan do), flat position i pairs with output i — this keeps
+            # equal-shaped sibling leaves (param tensors, double-buffered
+            # activations) wired to THEIR updated value instead of a
+            # same-shaped neighbor's
+            if (i < len(out_leaves) and free(i)
+                    and out_leaves[i].shape == a.shape
+                    and out_leaves[i].dtype == a.dtype):
+                match = i
+            else:
+                match = next(
+                    (j for j, o in enumerate(out_leaves)
+                     if free(j) and o.shape == a.shape
+                     and o.dtype == a.dtype), None)
+            if match is None:
+                break
+            pairs.append((i, match))
+            taken_here.add(match)
+        # all-or-nothing per argnum: donate_argnums is top-level, so a
+        # partially-rebindable argument cannot be donated at all
+        if len(pairs) == hi - lo:
+            for _, j in pairs:
+                out_taken[j] = True
+            rebind.extend(pairs)
+            keep.append(argnum)
+        else:
+            dropped.append(argnum)
+    return tuple(keep), rebind, dropped
+
+
+def _analyses(compiled) -> dict:
+    """Flatten XLA's per-executable analyses into JSON-ready dicts; an
+    analysis a backend doesn't implement is simply absent, never fatal."""
+    out = {}
+    try:
+        ca = compiled.cost_analysis()
+        props = ca[0] if isinstance(ca, (list, tuple)) and ca else ca
+        if isinstance(props, dict):
+            cost = {}
+            if "flops" in props:
+                cost["flops"] = float(props["flops"])
+            ba = [float(v) for k, v in props.items()
+                  if k.startswith("bytes accessed")]
+            if ba:
+                cost["bytes_accessed"] = max(ba)
+            if cost:
+                out["cost_analysis"] = cost
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        out["memory_analysis"] = {
+            k: int(getattr(ma, f"{k}_size_in_bytes"))
+            for k in ("argument", "output", "temp", "alias")
+            if hasattr(ma, f"{k}_size_in_bytes")}
+    except Exception:
+        pass
+    return out
+
+
+def compile_programs(programs: dict[str, Program],
+                     global_meta: dict | None = None
+                     ) -> dict[str, CompiledProgram]:
+    """AOT-compile a named set of programs, recording per-program
+    ``compile_ms`` (plus analyses under ``aot``) into ``global_meta`` —
+    the record every proxy's emitter already serializes, which is how
+    compile time ships *separate from* ``runtimes``."""
+    compiled = {name: CompiledProgram(prog)
+                for name, prog in programs.items()}
+    if global_meta is not None:
+        global_meta["compile_ms"] = {
+            name: c.stats["compile_ms"] for name, c in compiled.items()}
+        global_meta["aot"] = {
+            name: {k: v for k, v in c.stats.items() if k != "compile_ms"}
+            for name, c in compiled.items()}
+        cache_dir = enable_persistent_cache()
+        if cache_dir:
+            global_meta["compile_cache_dir"] = cache_dir
+    return compiled

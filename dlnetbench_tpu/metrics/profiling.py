@@ -121,7 +121,14 @@ def permute_dependencies(fn, *args) -> tuple[int, set[tuple[int, int]]]:
     i-th's output, so the two can never be in flight together.  Pairs
     absent from ``deps`` are schedulable concurrently by XLA — the 1F1B
     overlap property is ``(i, i+1) not in deps`` for its steady pairs.
+
+    An AOT-compiled program (core/executor.py CompiledProgram) is opaque
+    to ``make_jaxpr``; its kept python callable + argument buffers are
+    traced instead.
     """
+    if not args and hasattr(fn, "traceable"):
+        args = fn.example_args
+        fn = fn.traceable
     closed = jax.make_jaxpr(fn)(*args)
     # find the (deepest) jaxpr level that actually contains the permutes
     level = None

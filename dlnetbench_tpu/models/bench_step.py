@@ -4,7 +4,11 @@
 ``examples/xla_knob_study.py`` (the compiler-knob sweep) must measure
 the SAME program — a sweep winner tuned for a drifted copy of the step
 would be adopted into a different program than it was measured on.
-Both build their step through this module.
+Both build their step through this module, and both execute it through
+the AOT engine (``core/executor.py``) with the params/optimizer-state
+carry donated (``DONATE_ARGNUMS``): compile time is recorded out of
+band, and the optimizer update reuses the param buffers in place
+(aliasing visible in the recorded ``memory_analysis``).
 
 Recipe rationale (shapes, remat, scan, logits dtype, VMEM option) is
 documented at the call site in bench.py, where the measured history
@@ -17,6 +21,10 @@ import dataclasses
 import jax
 
 BATCH, SEQ, LAYERS, VOCAB = 2, 6144, 4, 32768
+
+# which train_k argument the AOT call sites donate: the params /
+# optimizer-state carry (argument 0); tokens are read-only
+DONATE_ARGNUMS = (0,)
 
 
 def bench_card():

@@ -303,19 +303,24 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_blocks_override(bq: int, bk: int, s: int):
-    """Per-kernel backward block shapes, env-overridable for on-chip
-    sweeps (docs/studies/flash_bwd_blocks_r5):
-    ``DLNB_FLASH_BWD_BLOCKS=bq_dq,bk_dq,bq_dkv,bk_dkv``.  The dq kernel
-    (minor axis = kv blocks, accumulator [bq, dh]) and the dk/dv kernel
-    (minor axis = q blocks, accumulators 2x[bk, dh]) have different live
-    sets, so their optima need not coincide; default: both (bq, bk).
+# Read ONCE at import time: the override reaches compiled code at trace
+# time, but jax's jit cache is NOT keyed on the environment — a value
+# changed between calls of an already-traced function would silently
+# keep the stale compiled block config (ADVICE r5).  Freezing the knob
+# at import makes the per-process semantics explicit; sweeps vary it by
+# launching a fresh process per value (docs/studies/flash_bwd_blocks_r5
+# already does), and a post-import change raises instead of lying.
+_BWD_BLOCKS_ENV = os.environ.get("DLNB_FLASH_BWD_BLOCKS", "")
+
+
+def _parse_bwd_blocks(env: str, bq: int, bk: int, s: int):
+    """Validate and split one knob string into ((bq_dq, bk_dq),
+    (bq_dkv, bk_dkv)); empty string = default (bq, bk) for both.
 
     An experiment knob must fail LOUD: a malformed string or a block
     that does not divide the sequence raises — truncated grids would
     silently leave dq rows unwritten and drop query contributions from
     dk/dv while the sweep records a plausible-looking time."""
-    env = os.environ.get("DLNB_FLASH_BWD_BLOCKS", "")
     if not env:
         return (bq, bk), (bq, bk)
     try:
@@ -330,6 +335,31 @@ def _bwd_blocks_override(bq: int, bk: int, s: int):
                 f"DLNB_FLASH_BWD_BLOCKS={env!r}: block {blk} does not "
                 f"divide seq_len {s}")
     return (a, b), (c, d)
+
+
+def _bwd_blocks_override(bq: int, bk: int, s: int):
+    """Per-kernel backward block shapes, env-overridable for on-chip
+    sweeps (docs/studies/flash_bwd_blocks_r5):
+    ``DLNB_FLASH_BWD_BLOCKS=bq_dq,bk_dq,bq_dkv,bk_dkv`` — captured at
+    IMPORT time (module constant ``_BWD_BLOCKS_ENV``), one value per
+    process.  The dq kernel (minor axis = kv blocks, accumulator
+    [bq, dh]) and the dk/dv kernel (minor axis = q blocks, accumulators
+    2x[bk, dh]) have different live sets, so their optima need not
+    coincide; default: both (bq, bk).
+
+    A value changed AFTER import raises (where a re-trace happens to
+    observe it) rather than silently keeping the stale compiled config
+    through the jit cache — the pre-freeze behavior read the LIVE env
+    at trace time, so an in-process sweep could believe it measured 4
+    configs while timing one."""
+    live = os.environ.get("DLNB_FLASH_BWD_BLOCKS", "")
+    if live != _BWD_BLOCKS_ENV:
+        raise ValueError(
+            f"DLNB_FLASH_BWD_BLOCKS changed after import "
+            f"({_BWD_BLOCKS_ENV!r} -> {live!r}): the knob is captured at "
+            f"import time because jit caching is not keyed on it — set "
+            f"it before importing, or use a fresh process per value")
+    return _parse_bwd_blocks(_BWD_BLOCKS_ENV, bq, bk, s)
 
 
 def _bwd_impl(q, k, v, out, lse, do, *, causal: bool,

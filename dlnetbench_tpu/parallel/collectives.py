@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dlnetbench_tpu.utils.jax_compat import axis_size as _axis_size
+
 
 def tie(value, dep):
     """Return ``value`` with a scheduling dependency on ``dep`` (both must
@@ -68,7 +70,7 @@ def ring_shift(x, axis: str, shift: int = 1):
     (the p2p idiom on TPU: there is no send/recv primitive, so pipeline
     hops (reference hybrid_2d.cpp:109-132) and ring-attention KV rotation
     are ``ppermute`` steps over the axis)."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -81,7 +83,7 @@ def shift_up(x, axis: str, senders=None):
     stage ids) restricts the edges further — fill/drain pipeline ticks use
     it so an edge carries exactly one message per microbatch while the
     permute still synchronizes the whole axis every tick."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     allowed = set(range(n - 1)) if senders is None else set(senders)
     perm = [(i, i + 1) for i in range(n - 1) if i in allowed]
     return lax.ppermute(x, axis, perm)
@@ -89,7 +91,7 @@ def shift_up(x, axis: str, senders=None):
 
 def shift_down(x, axis: str, senders=None):
     """Stage s -> stage s-1 edge transfer (backward gradients)."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     allowed = set(range(1, n)) if senders is None else set(senders)
     perm = [(i, i - 1) for i in range(1, n) if i in allowed]
     return lax.ppermute(x, axis, perm)

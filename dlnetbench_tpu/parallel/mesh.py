@@ -28,6 +28,29 @@ AXIS_SP = "sp"   # sequence/context parallelism
 AXIS_FLAT = "x"  # single-axis meshes (dp / fsdp proxies)
 
 
+# mesh reuse across sweep grid points (sweep.py in-process mode): a
+# Mesh over the same devices/shape/axes is immutable, and rebuilding it
+# per point would defeat jax-internal sharding caches keyed on mesh
+# identity.  Keyed on device ids so distinct --devices subsets coexist,
+# AND on the device objects' python identity: after a backend re-init
+# (clear_backends in __graft_entry__ / test_wedge_guard) jax hands out
+# NEW device objects with the SAME ids, and a Mesh over the dead
+# backend's devices must never be served from here.
+_MESH_CACHE: dict = {}
+
+
+def _cached_mesh(shape: tuple[int, ...], axes: tuple[str, ...],
+                 devices) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    key = (tuple(shape), tuple(axes),
+           tuple((d.id, id(d)) for d in devices))
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = Mesh(_device_grid(tuple(shape), devices), tuple(axes))
+        _MESH_CACHE[key] = mesh
+    return mesh
+
+
 def _device_grid(shape: tuple[int, ...], devices=None) -> np.ndarray:
     devices = list(devices) if devices is not None else jax.devices()
     need = math.prod(shape)
@@ -50,7 +73,7 @@ def make_flat_mesh(world_size: int | None = None, devices=None,
     of MPI_COMM_WORLD for the dp proxy (reference dp.cpp:224)."""
     devices = list(devices) if devices is not None else jax.devices()
     n = world_size if world_size is not None else len(devices)
-    return Mesh(_device_grid((n,), devices), (axis,))
+    return _cached_mesh((n,), (axis,), devices)
 
 
 def make_grid_mesh(dp: int = 1, pp: int = 1, tp: int = 1,
@@ -60,7 +83,7 @@ def make_grid_mesh(dp: int = 1, pp: int = 1, tp: int = 1,
     reference grid layout (hybrid_3d.cpp:283-285) so the innermost (tp/ep)
     axis, which carries the most latency-sensitive traffic, sits on
     neighboring ICI links."""
-    return Mesh(_device_grid((dp, pp, tp), devices), (AXIS_DP, AXIS_PP, AXIS_TP))
+    return _cached_mesh((dp, pp, tp), (AXIS_DP, AXIS_PP, AXIS_TP), devices)
 
 
 def make_fsdp_mesh(num_replicas: int, sharding_factor: int,
@@ -68,14 +91,14 @@ def make_fsdp_mesh(num_replicas: int, sharding_factor: int,
     """2D mesh (replica, shard) for the FSDP proxy — the analogue of the
     reference's two comm splits, intra-shard ``unit_comm`` and inter-replica
     ``allreduce_comm`` (reference fsdp.cpp:257-265)."""
-    return Mesh(_device_grid((num_replicas, sharding_factor), devices),
-                (AXIS_DP, AXIS_TP))
+    return _cached_mesh((num_replicas, sharding_factor),
+                        (AXIS_DP, AXIS_TP), devices)
 
 
 def make_sp_mesh(sp: int, dp: int = 1, devices=None) -> Mesh:
     """2D mesh (dp, sp) for the sequence-parallel proxies; sp innermost so
     the ring rides neighboring ICI links."""
-    return Mesh(_device_grid((dp, sp), devices), (AXIS_DP, AXIS_SP))
+    return _cached_mesh((dp, sp), (AXIS_DP, AXIS_SP), devices)
 
 
 def mesh_from_grid(grid: Grid3D, devices=None) -> Mesh:

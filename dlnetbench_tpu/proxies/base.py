@@ -37,7 +37,7 @@ from collections.abc import Callable
 
 import jax
 
-from dlnetbench_tpu.utils.timing import time_callable
+from dlnetbench_tpu.utils.timing import time_callable, time_chain
 
 DEFAULT_WARMUP = 3   # reference dp.cpp:65
 DEFAULT_RUNS = 5     # reference dp.cpp:66
@@ -54,6 +54,10 @@ class ProxyConfig:
     measure_comm_only: bool = True
     measure_compute_only: bool = True
     measure_energy: bool = True    # reference PROXY_ENERGY_PROFILING
+    # K-chained fencing: K dispatches per host fence, so dispatch + fence
+    # RTT amortize over K iterations instead of biasing every sample
+    # (utils/timing.py time_chain); 1 = the reference's fence-per-rep
+    reps_per_fence: int = 1
 
 
 @dataclasses.dataclass
@@ -95,9 +99,22 @@ class ProxyResult:
         return sum(vals) / len(vals) if vals else 0.0
 
 
+def _chain_sizes(runs: int, k: int) -> list[int]:
+    """Partition ``runs`` iterations into fence chains of (at most) ``k``."""
+    if k <= 1:
+        return [1] * runs
+    sizes = [k] * (runs // k)
+    if runs % k:
+        sizes.append(runs % k)
+    return sizes
+
+
 def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
               energy_sampler=None) -> ProxyResult:
-    # warmup (also compiles); reference dp.cpp:234-244
+    # warmup; reference dp.cpp:234-244.  Bundles are AOT-compiled at
+    # build time (core/executor.py), so these samples measure EXECUTION
+    # only — compile time can no longer pollute estimate_runs through
+    # the warmup mean the way a first-call jit compile did.
     warmup_s = time_callable(bundle.full, reps=max(cfg.warmup, 1))
 
     runs = cfg.runs
@@ -127,26 +144,34 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
     # (dp.cpp:191); the decomposition channel has to earn it.
     measure_compute = cfg.measure_compute_only and bundle.compute is not None
     if measure_compute:
-        time_callable(bundle.compute, reps=1)  # compile outside the A/B loop
+        time_callable(bundle.compute, reps=1)  # warm outside the A/B loop
+
+    # fence chains: with reps_per_fence = K each chain is K back-to-back
+    # dispatches fenced ONCE, and contributes one per-iteration sample
+    # (time_chain's (elapsed - rtt)/K) — the A/B pairing below is then
+    # chain-vs-chain, still matched in time
+    chains = _chain_sizes(runs, max(cfg.reps_per_fence, 1))
+    bundle.global_meta["reps_per_fence"] = max(cfg.reps_per_fence, 1)
 
     timers: dict[str, list] = {}
     full_s: list[float] = []
     comp_s: list[float] = []
     energy_j: list[float] = []
-    for _ in range(runs):
-        # Energy brackets ONLY the fenced full run (reference per-rank
-        # energy_consumed arrays, plots/parser.py:172) — genuinely per-run.
-        # The RTT-aware transfer fence inside time_callable guarantees the
-        # device work finished before the closing read; its host spin adds
-        # a constant per-run offset that cancels across configs.
+    for k in chains:
+        # Energy brackets ONLY the fenced full chain (reference per-rank
+        # energy_consumed arrays, plots/parser.py:172), reported per
+        # iteration.  The RTT-aware transfer fence inside time_chain
+        # guarantees the device work finished before the closing read;
+        # its host spin adds a constant per-chain offset that cancels
+        # across configs.
         if energy_sampler is not None:
             e0 = energy_sampler.read_joules()
-        t_full = time_callable(bundle.full, reps=1)[0]
+        t_full = time_chain(bundle.full, k=k)
         if energy_sampler is not None:
-            energy_j.append(max(0.0, energy_sampler.read_joules() - e0))
+            energy_j.append(max(0.0, energy_sampler.read_joules() - e0) / k)
         full_s.append(t_full)
         if measure_compute:
-            comp_s.append(time_callable(bundle.compute, reps=1)[0])
+            comp_s.append(time_chain(bundle.compute, k=k))
     timers["runtimes"] = [t * 1e6 for t in full_s]
     if energy_sampler is not None:
         timers["energy_consumed"] = energy_j
@@ -160,14 +185,14 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
                                   for f, c in zip(full_s, comp_s)]
 
     if cfg.measure_comm_only and bundle.comm is not None:
-        time_callable(bundle.comm, reps=1)  # compile
-        comm_s = time_callable(bundle.comm, reps=runs)
+        time_callable(bundle.comm, reps=1)  # warm
+        comm_s = [time_chain(bundle.comm, k=k) for k in chains]
         timers["comm_time"] = [t * 1e6 for t in comm_s]
 
     if cfg.measure_comm_only and bundle.variants:
         for vname, vfn in bundle.variants.items():
-            time_callable(vfn, reps=1)  # compile
-            v_s = time_callable(vfn, reps=runs)
+            time_callable(vfn, reps=1)  # warm
+            v_s = [time_chain(vfn, k=k) for k in chains]
             timers[f"{vname}_time"] = [t * 1e6 for t in v_s]
 
     return ProxyResult(

@@ -60,20 +60,21 @@ def burn(state, iters: int):
 def burn_if(state, iters: int, active):
     """Advance the chain ``iters`` times when ``active`` (a traced bool —
     typically derived from a mesh axis index), else do ~0 work: the
-    rank-predicated trip count that lets one SPMD program express
-    stage-gated pipeline compute (GPipe fill/drain ticks where idle stages
-    participate in the hop but not the burn).  The dynamic bound lowers to
-    ``lax.while_loop``, so the idle branch costs one predicate check."""
+    rank-predicated burn that lets one SPMD program express stage-gated
+    pipeline compute (GPipe fill/drain ticks where idle stages
+    participate in the hop but not the burn).  Expressed as ``lax.cond``
+    around a STATIC-count loop rather than a dynamic trip count: a
+    while-loop bound derived from ``axis_index`` leaves a PartitionId
+    in the loop condition that XLA's SPMD partitioner rejects
+    (UNIMPLEMENTED on this toolchain), while a conditional's idle branch
+    still costs only the predicate check."""
     if iters <= 0:
         return state
-    scale = 1.0 / state.shape[-1]
 
-    def body(_, s):
-        p = jnp.dot(s, s, preferred_element_type=jnp.float32)
-        return jnp.tanh(p * scale).astype(s.dtype)
-
-    n = jnp.where(active, jnp.int32(iters), jnp.int32(0))
-    return lax.fori_loop(0, n, body, state, unroll=False)
+    return lax.cond(active,
+                    functools.partial(burn, iters=iters),
+                    lambda s: s,
+                    state)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,16 +117,73 @@ def _calibrate_on_device(shape, dtype_name, device, n_lo, n_hi):
 _CAL_CACHE: dict = {}
 
 
+def _persist_path():
+    """Calibration rides in the same opt-in cache dir as compiled
+    executables (core/executor.py DLNB_COMPILE_CACHE_DIR): a warm sweep
+    re-run should skip the ~2.4 s calibration the same way it skips
+    recompiles.  Returns None when the cache is not opted into."""
+    import os
+    d = os.environ.get("DLNB_COMPILE_CACHE_DIR")
+    if not d:
+        return None
+    from pathlib import Path
+    return Path(d) / "burn_calibration.json"
+
+
+def _load_persisted(path, key) -> BurnCalibration | None:
+    import json
+    # TypeError included: a cache file holding valid JSON that is not a
+    # dict (hand edit, torn write) must fall back to measuring, not
+    # crash every run until someone deletes the file
+    try:
+        entry = json.loads(path.read_text())[":".join(map(str, key))]
+        return BurnCalibration(ns_per_iter=float(entry), shape=key[0],
+                               dtype=key[1], device_kind=key[2])
+    except (OSError, KeyError, TypeError, ValueError):
+        return None
+
+
+def _store_persisted(path, key, cal: BurnCalibration) -> None:
+    import json
+    import os
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, ValueError):
+            data = {}
+        if not isinstance(data, dict):
+            data = {}
+        data[":".join(map(str, key))] = cal.ns_per_iter
+        # per-process + random tmp name: id() repeats across processes
+        # (same heap layout), and two concurrent sweep points sharing a
+        # tmp path could rename a torn file into place
+        tmp = path.with_suffix(
+            f".{os.getpid()}-{os.urandom(4).hex()}.tmp")
+        tmp.write_text(json.dumps(data))
+        tmp.replace(path)  # atomic: readers never see a torn file
+    except OSError:
+        pass  # persistence is an optimization, never a failure
+
+
 def calibrate(shape=DEFAULT_SHAPE, dtype=DEFAULT_DTYPE,
               device=None) -> BurnCalibration:
     """Measure ns/iteration of the burn chain on the current default device.
     Differenced between two trip counts so dispatch/compile overheads cancel
     (the same discipline as the reference's warm-up skipping, reference
-    cpp/utils.hpp:121-123)."""
+    cpp/utils.hpp:121-123).  Cached in-process per (shape, dtype, device
+    kind) — one ``build()`` per grid point must not re-pay it — and,
+    when ``DLNB_COMPILE_CACHE_DIR`` is set, persisted there so re-runs
+    start warm."""
     device = device or jax.devices()[0]
     key = (tuple(shape), jnp.dtype(dtype).name, device.device_kind)
     if key not in _CAL_CACHE:
-        _CAL_CACHE[key] = _calibrate_on_device(tuple(shape),
-                                               jnp.dtype(dtype).name,
-                                               device, 64, 256)
+        persist = _persist_path()
+        cal = _load_persisted(persist, key) if persist else None
+        if cal is None:
+            cal = _calibrate_on_device(tuple(shape), jnp.dtype(dtype).name,
+                                       device, 64, 256)
+            if persist:
+                _store_persisted(persist, key, cal)
+        _CAL_CACHE[key] = cal
     return _CAL_CACHE[key]

@@ -20,11 +20,11 @@ from __future__ import annotations
 
 import functools
 
-import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dlnetbench_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from dlnetbench_tpu.core import executor
 from dlnetbench_tpu.core.model_stats import ModelStats
 from dlnetbench_tpu.core.schedule import dp_schedule
 from dlnetbench_tpu.parallel import collectives as col
@@ -70,8 +70,12 @@ def build(stats: ModelStats, num_buckets: int, cfg: ProxyConfig,
                               with_comm=with_comm),
             mesh=mesh, in_specs=(P(), tuple(P() for _ in grads)),
             out_specs=P(), check_vma=False)
-        jitted = jax.jit(fn)
-        return lambda: jitted(state0, tuple(grads))
+        # donate the carried burn state and every gradient bucket: the
+        # outputs are exactly (state', allreduced buckets), so XLA
+        # updates in place instead of allocating + copying per step;
+        # the executor rebinds the donated args from the outputs
+        return executor.Program(fn=fn, args=(state0, tuple(grads)),
+                                donate_argnums=(0, 1))
 
     bucket_bytes = [int(e * jnp.dtype(dtype).itemsize)
                     for e in bucket_elems]
@@ -97,9 +101,13 @@ def build(stats: ModelStats, num_buckets: int, cfg: ProxyConfig,
         "size_scale": cfg.size_scale,
         "time_scale": cfg.time_scale,
     }
+    compiled = executor.compile_programs(
+        {"full": make(True, True),
+         "compute": make(True, False),
+         "comm": make(False, True)}, meta)
     return StepBundle(
-        full=make(True, True),
-        compute=make(True, False),
-        comm=make(False, True),
+        full=compiled["full"],
+        compute=compiled["compute"],
+        comm=compiled["comm"],
         global_meta=meta,
     )

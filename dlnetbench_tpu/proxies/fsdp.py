@@ -27,9 +27,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dlnetbench_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from dlnetbench_tpu.core import executor
 from dlnetbench_tpu.core.model_stats import ModelStats
 from dlnetbench_tpu.core.schedule import fsdp_schedule
 from dlnetbench_tpu.parallel import collectives as col
@@ -108,8 +109,11 @@ def build(stats: ModelStats, num_units: int, cfg: ProxyConfig,
                               with_comm=with_comm),
             mesh=mesh, in_specs=(P(), tuple(P() for _ in shards)),
             out_specs=P(), check_vma=False)
-        jitted = jax.jit(fn)
-        return lambda: jitted(state0, tuple(shards))
+        # donate the burn state and every parameter/gradient shard — the
+        # outputs are (state', per-unit grad shards), shape-matched, so
+        # XLA reuses the buffers instead of copying per step
+        return executor.Program(fn=fn, args=(state0, tuple(shards)),
+                                donate_argnums=(0, 1))
 
     # comm-only sub-schedules for per-collective timers (reference
     # fsdp.cpp:61-66 allgather / reduce_scatter timers)
@@ -121,8 +125,7 @@ def build(stats: ModelStats, num_units: int, cfg: ProxyConfig,
         fn = shard_map(body, mesh=mesh,
                        in_specs=(tuple(P() for _ in bufs),),
                        out_specs=P(), check_vma=False)
-        jitted = jax.jit(fn)
-        return lambda: jitted(tuple(bufs))
+        return executor.Program(fn=fn, args=(tuple(bufs),))
 
     def ag_body(bufs):
         # match the full schedule's gather count: N forward + N-1 backward.
@@ -180,11 +183,17 @@ def build(stats: ModelStats, num_units: int, cfg: ProxyConfig,
         "size_scale": cfg.size_scale,
         "time_scale": cfg.time_scale,
     }
+    compiled = executor.compile_programs(
+        {"full": make(True, True),
+         "compute": make(True, False),
+         "comm": make(False, True),
+         "allgather": make_var(ag_body, shards),
+         "reduce_scatter": make_var(rs_body, full_units)}, meta)
     return StepBundle(
-        full=make(True, True),
-        compute=make(True, False),
-        comm=make(False, True),
-        variants={"allgather": make_var(ag_body, shards),
-                  "reduce_scatter": make_var(rs_body, full_units)},
+        full=compiled["full"],
+        compute=compiled["compute"],
+        comm=compiled["comm"],
+        variants={"allgather": compiled["allgather"],
+                  "reduce_scatter": compiled["reduce_scatter"]},
         global_meta=meta,
     )

@@ -24,9 +24,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dlnetbench_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from dlnetbench_tpu.core import executor
 from dlnetbench_tpu.core.model_card import ModelCard
 from dlnetbench_tpu.core.model_stats import ModelStats
 from dlnetbench_tpu.core.schedule import (
@@ -344,16 +345,23 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                               with_comm=with_comm),
             mesh=mesh, in_specs=tuple(P() for _ in range(8)),
             out_specs=P(), check_vma=False)
-        jitted = jax.jit(fn)
-        return lambda: jitted(state0, act, act2_in, grad_shard, tp_buf,
-                              a2a_buf, ne_in, ex_in)
+        # request donation of every carried buffer; the executor keeps
+        # only the ones whose leaves have a shape-matched output to
+        # rebind from (schedule/mode dependent: gpipe never outputs the
+        # act2 dummy, the A2A buffer comes back reshaped, the TP/grad
+        # buffers only exist as outputs in their modes) and records the
+        # dropped ones in the compile meta as ``undonated``
+        return executor.Program(
+            fn=fn,
+            args=(state0, act, act2_in, grad_shard, tp_buf, a2a_buf,
+                  ne_in, ex_in),
+            donate_argnums=tuple(range(8)))
 
     # per-collective comm-only variants
     def make_var(body, *bufs):
         fn = shard_map(body, mesh=mesh, in_specs=tuple(P() for _ in bufs),
                        out_specs=P(), check_vma=False)
-        jitted = jax.jit(fn)
-        return lambda: jitted(*bufs)
+        return executor.Program(fn=fn, args=bufs)
 
     def pp_body(a, a2=None):
         """Hop-only replay of the schedule's permute ticks (same sender
@@ -489,10 +497,15 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         "size_scale": cfg.size_scale,
         "time_scale": cfg.time_scale,
     }
+    compiled = executor.compile_programs(
+        {"full": make(True, True),
+         "compute": make(True, False),
+         "comm": make(False, True),
+         **variants}, meta)
     return StepBundle(
-        full=make(True, True),
-        compute=make(True, False),
-        comm=make(False, True),
-        variants=variants,
+        full=compiled["full"],
+        compute=compiled["compute"],
+        comm=compiled["comm"],
+        variants={k: compiled[k] for k in variants},
         global_meta=meta,
     )

@@ -19,9 +19,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dlnetbench_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from dlnetbench_tpu.core import executor
 from dlnetbench_tpu.core.model_card import ModelCard
 from dlnetbench_tpu.core.model_stats import ModelStats
 from dlnetbench_tpu.core.schedule import sequence_schedule
@@ -88,8 +89,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                               with_comm=with_comm),
             mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
             check_vma=False)
-        jitted = jax.jit(fn)
-        return lambda: jitted(state0, kv, grads)
+        # donate state/KV block/grad shard (grad is only rebindable —
+        # hence only donated — when dp > 1 produces its allreduce output)
+        return executor.Program(fn=fn, args=(state0, kv, grads),
+                                donate_argnums=(0, 1, 2))
 
     # one ring pass per layer fwd + one bwd (bwd doubles compute, not
     # hops); shared by ring_body and the comm_model declaration
@@ -100,8 +103,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
             kv_b = col.ring_shift(kv_b, AXIS_SP)
         return kv_b
 
-    ring_fn = jax.jit(shard_map(ring_body, mesh=mesh, in_specs=(P(),),
-                                out_specs=P(), check_vma=False))
+    ring_prog = executor.Program(
+        fn=shard_map(ring_body, mesh=mesh, in_specs=(P(),),
+                     out_specs=P(), check_vma=False),
+        args=(kv,))
 
     meta = {
         "proxy": "ring_attention",
@@ -127,10 +132,15 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         "size_scale": cfg.size_scale,
         "time_scale": cfg.time_scale,
     }
+    compiled = executor.compile_programs(
+        {"full": make(True, True),
+         "compute": make(True, False),
+         "comm": make(False, True),
+         "ring_comm": ring_prog}, meta)
     return StepBundle(
-        full=make(True, True),
-        compute=make(True, False),
-        comm=make(False, True),
-        variants={"ring_comm": lambda: ring_fn(kv)},
+        full=compiled["full"],
+        compute=compiled["compute"],
+        comm=compiled["comm"],
+        variants={"ring_comm": compiled["ring_comm"]},
         global_meta=meta,
     )

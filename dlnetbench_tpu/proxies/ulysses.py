@@ -14,9 +14,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dlnetbench_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from dlnetbench_tpu.core import executor
 from dlnetbench_tpu.core.model_card import ModelCard
 from dlnetbench_tpu.core.model_stats import ModelStats
 from dlnetbench_tpu.core.schedule import sequence_schedule
@@ -89,8 +90,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
                               with_comm=with_comm),
             mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
             check_vma=False)
-        jitted = jax.jit(fn)
-        return lambda: jitted(state0, acts, grads)
+        # donate state/activations/grad shard (grad only donated when
+        # dp > 1 emits its allreduce output to rebind from)
+        return executor.Program(fn=fn, args=(state0, acts, grads),
+                                donate_argnums=(0, 1, 2))
 
     a2a_total = layers * 4  # 2 per layer fwd + 2 per layer bwd; shared
                             # by a2a_body and the comm_model declaration
@@ -100,8 +103,10 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
             a = col.alltoall(a.reshape(sp, -1), AXIS_SP).reshape(-1)
         return a
 
-    a2a_fn = jax.jit(shard_map(a2a_body, mesh=mesh, in_specs=(P(),),
-                               out_specs=P(), check_vma=False))
+    a2a_prog = executor.Program(
+        fn=shard_map(a2a_body, mesh=mesh, in_specs=(P(),),
+                     out_specs=P(), check_vma=False),
+        args=(acts,))
 
     meta = {
         "proxy": "ulysses",
@@ -125,10 +130,15 @@ def build(stats: ModelStats, card: ModelCard, cfg: ProxyConfig, *,
         "size_scale": cfg.size_scale,
         "time_scale": cfg.time_scale,
     }
+    compiled = executor.compile_programs(
+        {"full": make(True, True),
+         "compute": make(True, False),
+         "comm": make(False, True),
+         "a2a_comm": a2a_prog}, meta)
     return StepBundle(
-        full=make(True, True),
-        compute=make(True, False),
-        comm=make(False, True),
-        variants={"a2a_comm": lambda: a2a_fn(acts)},
+        full=compiled["full"],
+        compute=compiled["compute"],
+        comm=compiled["comm"],
+        variants={"a2a_comm": compiled["a2a_comm"]},
         global_meta=meta,
     )

@@ -17,6 +17,17 @@ without a SLURM dependency:
   ``job.variables`` role), so ``metrics.parser`` surfaces the swept axes
   as DataFrame columns and the Pareto/scaling plots group by them.
 
+Execution modes: a flag-only grid (no ``env:`` axes) runs IN PROCESS by
+default — one jax backend init, one burn calibration
+(``burnlib.calibrate``'s per-device cache), one tunnel-RTT calibration,
+and cached meshes (``parallel.mesh``) are shared across all grid points
+instead of being re-derived per point, which used to dominate
+small-grid wall-clock.  ``--subprocess`` forces the old
+process-per-point isolation; ``env:`` axes force it automatically
+(backend-init-time flags need a fresh process).  Re-runs of either mode
+warm-start compilation through the persistent compile cache when
+``DLNB_COMPILE_CACHE_DIR`` is set (core/executor.py).
+
 CLI::
 
     python -m dlnetbench_tpu.sweep dp --model gpt2_l_16_bfloat16 \
@@ -63,17 +74,45 @@ def point_command(proxy: str, point: dict[str, str],
     return argv, env
 
 
+def _run_point_in_process(argv: list[str], stream) -> int:
+    """Run one grid point by calling cli.main in THIS process (argv minus
+    the ``python -m dlnetbench_tpu.cli`` prefix); returns an exit code."""
+    from dlnetbench_tpu import cli
+    try:
+        return cli.main(argv[3:]) or 0
+    except SystemExit as e:  # argparse errors exit; the sweep must not
+        return int(e.code or 0) if not isinstance(e.code, str) else 2
+    except Exception as e:
+        print(f"[sweep] in-process point raised {type(e).__name__}: "
+              f"{str(e)[:200]}", file=stream)
+        return 1
+
+
 def run_sweep(proxy: str, axes: dict[str, list[str]],
               passthrough: list[str], *, dry_run: bool = False,
-              keep_going: bool = False, stream=None) -> int:
-    """Run every grid point; returns the number of FAILED points."""
+              keep_going: bool = False, stream=None,
+              in_process: bool | None = None) -> int:
+    """Run every grid point; returns the number of FAILED points.
+
+    ``in_process=None`` (auto) shares this process across points when no
+    ``env:`` axis demands a fresh backend: burn calibration, tunnel-RTT
+    calibration and mesh construction then happen ONCE for the whole
+    grid instead of once per point."""
     stream = stream or sys.stderr
     points = expand_grid(axes)
+    has_env_axis = any(k.startswith("env:") for k in axes)
+    if in_process is None:
+        in_process = not has_env_axis
+    if in_process and has_env_axis:
+        raise ValueError("env: axes need a fresh subprocess per point "
+                         "(backend-init-time flags); drop --in_process")
     failed = 0
     for i, point in enumerate(points):
         argv, env_over = point_command(proxy, point, passthrough)
         desc = ", ".join(f"{k}={v}" for k, v in point.items()) or "(single)"
-        print(f"[sweep {i + 1}/{len(points)}] {desc}", file=stream)
+        mode = "in-process" if in_process and not dry_run else ""
+        print(f"[sweep {i + 1}/{len(points)}] {desc}"
+              + (f" [{mode}]" if mode else ""), file=stream)
         if dry_run:
             import shlex
             prefix = "".join(f"{k}={shlex.quote(v)} "
@@ -81,12 +120,14 @@ def run_sweep(proxy: str, axes: dict[str, list[str]],
             print("  " + prefix + " ".join(map(shlex.quote, argv)),
                   file=stream)
             continue
-        env = {**os.environ, **env_over}
-        proc = subprocess.run(argv, env=env)
-        if proc.returncode != 0:
+        if in_process:
+            rc = _run_point_in_process(argv, stream)
+        else:
+            env = {**os.environ, **env_over}
+            rc = subprocess.run(argv, env=env).returncode
+        if rc != 0:
             failed += 1
-            print(f"[sweep] point failed (exit {proc.returncode}): {desc}",
-                  file=stream)
+            print(f"[sweep] point failed (exit {rc}): {desc}", file=stream)
             if not keep_going:
                 break
     return failed
@@ -121,6 +162,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--dry_run", action="store_true")
     p.add_argument("--keep_going", action="store_true",
                    help="continue past failed points")
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument("--in_process", action="store_true",
+                      help="force sharing this process across points "
+                           "(default for flag-only grids; invalid with "
+                           "env: axes)")
+    mode.add_argument("--subprocess", action="store_true",
+                      help="force a fresh subprocess per point (the old "
+                           "default; automatic for env: axes)")
     args = p.parse_args(argv)
 
     axes: dict[str, list[str]] = {}
@@ -133,8 +182,14 @@ def main(argv: list[str] | None = None) -> int:
             p.error(f"--axis {key!r} given twice; merge the value lists")
         axes[key] = vals
     passthrough = ["--model", args.model, "--out", args.out] + passthrough
-    failed = run_sweep(args.proxy, axes, passthrough, dry_run=args.dry_run,
-                       keep_going=args.keep_going)
+    in_process = True if args.in_process else \
+        (False if args.subprocess else None)
+    try:
+        failed = run_sweep(args.proxy, axes, passthrough,
+                           dry_run=args.dry_run, keep_going=args.keep_going,
+                           in_process=in_process)
+    except ValueError as e:
+        p.error(str(e))
     return 1 if failed else 0
 
 

@@ -26,37 +26,60 @@ def build_root(repo: Path | str, flavor: str = "release") -> Path:
     return Path(tempfile.gettempdir()) / f"dlnb-native-{flavor}-u{uid}-{tag}"
 
 
-def _claim(root: Path) -> None:
+def _claim(root: Path, attempts: int = 5) -> None:
     """Create (0700) and ownership-check the build dir right before use.
 
     /tmp is world-writable and the name is predictable, so another
     local user could pre-create it with a crafted build.ninja that
     ``ninja -C`` would then execute; checking at mkdir time (not at
     path-computation time) closes the window.
+
+    Retried: a CONCURRENT claimer can wipe the dir between our mkdir's
+    FileExistsError and the stat (its own group-writable-dir rebuild
+    path below does exactly that), which used to surface as an
+    unhandled FileNotFoundError instead of a second attempt (advisor
+    r5).  Each retry restarts the whole mkdir/stat/tighten sequence.
     """
-    try:
-        root.mkdir(mode=0o700)
-        created = True
-    except FileExistsError:
-        created = False
-    st = root.stat()
-    if hasattr(os, "getuid") and st.st_uid != os.getuid():
-        raise RuntimeError(
-            f"{root} exists but is not owned by uid {os.getuid()}")
-    # ownership alone is not enough: mkdir's mode applies only when the
-    # dir is CREATED (and is umask-subject then), so a same-uid but
-    # group/world-accessible dir from an earlier run or another tool
-    # would pass the uid check and its build.ninja be executed (advisor
-    # r4).  A PRE-EXISTING dir that was group/world-WRITABLE may
-    # already contain planted content — chmod cannot un-plant it, so
-    # wipe and rebuild; otherwise just tighten the bits.
-    if st.st_mode & 0o077:
-        if not created and st.st_mode & 0o022:
-            import shutil
-            shutil.rmtree(root)
+    last_exc: OSError | None = None
+    for _ in range(attempts):
+        try:
             root.mkdir(mode=0o700)
-        else:
-            root.chmod(0o700)
+            created = True
+        except FileExistsError:
+            created = False
+        try:
+            st = root.stat()
+        except FileNotFoundError as e:  # dir wiped under us: retry claim
+            last_exc = e
+            continue
+        if hasattr(os, "getuid") and st.st_uid != os.getuid():
+            raise RuntimeError(
+                f"{root} exists but is not owned by uid {os.getuid()}")
+        # ownership alone is not enough: mkdir's mode applies only when
+        # the dir is CREATED (and is umask-subject then), so a same-uid
+        # but group/world-accessible dir from an earlier run or another
+        # tool would pass the uid check and its build.ninja be executed
+        # (advisor r4).  A PRE-EXISTING dir that was group/world-
+        # WRITABLE may already contain planted content — chmod cannot
+        # un-plant it, so wipe and rebuild; otherwise just tighten the
+        # bits.
+        try:
+            if st.st_mode & 0o077:
+                if not created and st.st_mode & 0o022:
+                    import shutil
+                    shutil.rmtree(root)
+                    root.mkdir(mode=0o700)
+                else:
+                    root.chmod(0o700)
+        except (FileNotFoundError, FileExistsError) as e:
+            # racing claimer wiped (stat/chmod target gone) or re-created
+            # (our post-wipe mkdir collided) the dir — restart the claim
+            last_exc = e
+            continue
+        return
+    raise RuntimeError(
+        f"could not claim {root} after {attempts} attempts "
+        f"(concurrent claimers kept wiping it)") from last_exc
 
 
 def _run(cmd: list[str], what: str) -> None:

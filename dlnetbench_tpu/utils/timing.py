@@ -99,5 +99,33 @@ def time_callable(fn, *args, reps: int = 1, **kwargs) -> list[float]:
     return out
 
 
+def time_chain(fn, *args, k: int = 1, **kwargs) -> float:
+    """Run ``fn(*args)`` ``k`` times back-to-back with ONE fence after the
+    last call; returns per-iteration seconds ((elapsed - rtt) / k).
+
+    The per-rep fencing of ``time_callable`` charges every sample one
+    host round-trip of dispatch + fence latency — a constant bias that
+    dwarfs sub-millisecond programs (the tunnel's fence RTT alone is
+    ~75 ms there).  Chaining k dispatches under one fence amortizes that
+    cost to rtt/k per iteration.  The async runtime queues the k
+    launches; each program consumes the carried state of the previous
+    call (the executor rebinds donated carries), so the device executes
+    them strictly in sequence and the chain elapsed time is k honest
+    iterations.  Caller is responsible for warmup (compilation)."""
+    if k <= 1:
+        return time_callable(fn, *args, **kwargs)[0]
+    fence_transfer = _needs_transfer_fence()
+    rtt = tunnel_rtt_s() if fence_transfer else 0.0
+    t0 = time.perf_counter()
+    res = None
+    for _ in range(k):
+        res = fn(*args, **kwargs)
+    fenced = _transfer_fence(res) if fence_transfer else False
+    if not fenced:
+        jax.block_until_ready(res)
+    elapsed = time.perf_counter() - t0 - (rtt if fenced else 0.0)
+    return max(0.0, elapsed) / k
+
+
 def median_us(samples_s: list[float]) -> float:
     return statistics.median(samples_s) * 1e6

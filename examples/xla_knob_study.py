@@ -26,7 +26,6 @@ import itertools
 import json
 import statistics
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
@@ -68,10 +67,17 @@ def main() -> int:
             opts["xla_tpu_enable_latency_hiding_scheduler"] = (
                 "true" if lhs == "on" else "false")
         label = f"vmem={vmem//1024}MiB lhs={lhs}"
-        t0 = time.time()
         try:
-            f = jax.jit(train_k, compiler_options=opts)
-            _, losses = f(params, tokens)
+            # AOT via the execution engine: compile time is the recorded
+            # compile_ms, params are donated to a per-point private copy
+            # (each grid point's executable owns its own carry, so the
+            # shared params survive the whole sweep)
+            from dlnetbench_tpu.core import executor
+            f = executor.CompiledProgram(executor.Program(
+                fn=train_k, args=(params, tokens),
+                donate_argnums=bench_step.DONATE_ARGNUMS,
+                compiler_options=opts))
+            _, losses = f()
             losses[-1].item()
         except Exception as e:  # an unknown/rejected flag combination
             print(f"[{idx+1}/{len(points)}] {label}: compile FAILED "
@@ -79,9 +85,8 @@ def main() -> int:
             rows.append({"vmem_kib": vmem, "lhs": lhs,
                          "step_ms": None, "error": str(e)[:200]})
             continue
-        compile_s = time.time() - t0
-        samples = [t / K for t in
-                   time_callable(f, params, tokens, reps=args.reps)]
+        compile_s = f.stats["compile_ms"] / 1e3
+        samples = [t / K for t in time_callable(f, reps=args.reps)]
         step_ms = statistics.median(samples) * 1e3
         print(f"[{idx+1}/{len(points)}] {label}: {step_ms:.1f} ms "
               f"(compile {compile_s:.0f}s, spread "

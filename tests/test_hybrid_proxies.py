@@ -156,7 +156,16 @@ def test_pipeline_bubble_modeled(eight_devices, schedule):
 
     S=2,M=8 -> 9 tick-units of 1/16 model time; S=4,M=4 -> 7 tick-units.
     Bubble modeled: t(S=4)/t(S=2) ~ 7/9 = 0.78; bubble missing: ~ 0.5."""
+    import os
     from dlnetbench_tpu.core.model_card import load_model_card
+    # the analytic tick model assumes each active stage burns on its own
+    # processor; with fewer cores than stages the device threads
+    # timeshare and the measured ratio settles ~0.6 regardless of the
+    # schedule (observed on a 2-core host) — no discriminating power
+    if (os.cpu_count() or 1) < 4:
+        pytest.skip(f"needs >= 1 core per stage (S=4) for the "
+                    f"tick-parallel timing model; host has "
+                    f"{os.cpu_count()} cores")
     stats = _stats("gpt2_l_16_bfloat16")
     card = load_model_card("gpt2_l")
     cfg = ProxyConfig(warmup=2, runs=3, size_scale=1e-6, time_scale=0.5)

@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from dlnetbench_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from dlnetbench_tpu.core.schedule import Grid3D

@@ -72,7 +72,9 @@ def test_two_process_distributed_runtime(tmp_path):
         assert [m["process"] for m in meta] == [0, 1], meta
         # cross-process psum over the global 2-device mesh
         import jax.numpy as jnp
-        from jax import lax, shard_map
+        from jax import lax
+
+        from dlnetbench_tpu.utils.jax_compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
         nd = len(jax.devices())      # spans BOTH processes
         assert nd > len(jax.local_devices()), (nd, jax.local_devices())

@@ -10,7 +10,9 @@ import functools
 import jax
 import jax.numpy as jnp
 import pytest
-from jax import lax, shard_map
+from jax import lax
+
+from dlnetbench_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dlnetbench_tpu.models import layers as L

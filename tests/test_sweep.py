@@ -48,6 +48,54 @@ def test_axis_parsing_errors():
     assert key == "env:LIBTPU_INIT_ARGS" and vals == ["--a=1,2", "--b"]
 
 
+def test_in_process_mode_calls_cli_directly(monkeypatch):
+    """Flag-only grids default to in-process execution: cli.main is
+    invoked in THIS process (sharing burn calibration, meshes and the
+    jax backend across points) with the proxy argv, no subprocess."""
+    from dlnetbench_tpu import cli
+    calls = []
+    monkeypatch.setattr(cli, "main", lambda argv: calls.append(argv) or 0)
+
+    def boom(*a, **k):  # the subprocess path must never fire
+        raise AssertionError("subprocess.run called in in-process mode")
+    monkeypatch.setattr(sweep.subprocess, "run", boom)
+
+    failed = sweep.run_sweep("dp", {"num_buckets": ["2", "4"]},
+                             ["--model", "m"])
+    assert failed == 0
+    assert len(calls) == 2
+    assert calls[0][0] == "dp" and "--num_buckets" in calls[0]
+    assert calls[0][calls[0].index("--num_buckets") + 1] == "2"
+    assert calls[1][calls[1].index("--num_buckets") + 1] == "4"
+
+
+def test_env_axis_forces_subprocess(monkeypatch):
+    """env: axes need backend-init-time isolation: auto mode must take
+    the subprocess path, and forcing in-process is an error."""
+    ran = []
+
+    class _Proc:
+        returncode = 0
+
+    monkeypatch.setattr(sweep.subprocess, "run",
+                        lambda argv, env=None: ran.append((argv, env))
+                        or _Proc())
+    axes = {"env:XLA_FLAGS": ["--a", "--b"]}
+    assert sweep.run_sweep("dp", axes, ["--model", "m"]) == 0
+    assert len(ran) == 2 and ran[0][1]["XLA_FLAGS"] == "--a"
+    with pytest.raises(ValueError, match="fresh subprocess"):
+        sweep.run_sweep("dp", axes, ["--model", "m"], in_process=True)
+
+
+def test_in_process_point_failure_counted(monkeypatch):
+    from dlnetbench_tpu import cli
+    monkeypatch.setattr(cli, "main",
+                        lambda argv: (_ for _ in ()).throw(SystemExit(2)))
+    failed = sweep.run_sweep("dp", {"num_buckets": ["2", "4"]},
+                             ["--model", "m"], keep_going=True)
+    assert failed == 2
+
+
 def test_dry_run_prints_commands(capsys):
     rc = sweep.main(["dp", "--model", "gpt2_l_16_bfloat16",
                      "--out", "/dev/null", "--axis", "num_buckets=2,4",
