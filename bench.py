@@ -14,35 +14,72 @@ the headline train-step line (tail parsers read the final line; the
 auxiliary results also ride inside it as "fp8_mlp" / "fp8_swiglu" /
 "int8_matmul" / "int8_step"):
   {"metric": ..., "value": <step ms>, "unit": "ms",
+   "best": <fastest round ms>, "band": [lo, hi], "n": <rounds>,
    "vs_baseline": <achieved/roofline, 1.0 = roofline-perfect>, ...}
+
+Every line carries its band (metrics/stats.py): ``value`` is the round
+median, ``best``/``band`` show what the rounds actually did, and a
+bimodal sample set (the tunnel's known throughput states) is flagged
+with a ``note`` instead of shipping one unannotated draw.
+
+``--trace-out t.json`` additionally records host harness spans
+(compile/warmup/timed/aux phases) and one profiled headline iteration,
+merged into a single Chrome/Perfetto timeline (docs/OBSERVABILITY.md).
 """
 from __future__ import annotations
 
+import argparse
 import json
-import statistics
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
+from dlnetbench_tpu.metrics import spans
+from dlnetbench_tpu.metrics import stats as stats_mod
 from dlnetbench_tpu.models.bench_step import BATCH, SEQ, LAYERS, VOCAB
 
 
-def _measure_chain(fn, arg, k: int) -> float:
+def _measure_chain(fn, arg, k: int) -> dict:
     """AOT compile (core/executor.py: compile time can't leak into the
     first timed round) + TRUE fence (a device->host transfer — on the
-    tunnel backend block_until_ready only acks dispatch), then median
-    of 3 K-chained rounds, per-iteration seconds.  Shared by every
-    auxiliary bench line so fence/timing fixes happen once.  The carry
-    is donated; the executor rebinds it from the chain output."""
+    tunnel backend block_until_ready only acks dispatch), then the band
+    summary of 3 K-chained rounds in per-iteration SECONDS
+    ({"value": median, "best", "band", "n"} — metrics/stats.py).
+    Shared by every auxiliary bench line so fence/timing fixes happen
+    once.  The carry is donated; the executor rebinds it from the
+    chain output."""
     from dlnetbench_tpu.core import executor
     from dlnetbench_tpu.utils.timing import time_callable
     prog = executor.CompiledProgram(executor.Program(
         fn=fn, args=(arg,), donate_argnums=(0,)))
     out = prog()  # warm run (already compiled)
     _ = out[0, 0].item() if hasattr(out[0, 0], "item") else int(out[0, 0])
-    return statistics.median(time_callable(prog, reps=3)) / k
+    return stats_mod.summarize([t / k for t in time_callable(prog, reps=3)])
+
+
+def _band_ms(summary_s: dict) -> dict:
+    """The artifact-grade stat keys of a JSON line, in ms, from a
+    seconds-summary: best/band/n ride next to the median "value"."""
+    return {
+        "best": round(summary_s["best"] * 1e3, 3),
+        "band": [round(v * 1e3, 3) for v in summary_s["band"]],
+        "n": summary_s["n"],
+    }
+
+
+def _combine_linear(terms: list[tuple[float, dict]]) -> dict:
+    """Band summary of a weighted sum of independently-measured stages
+    (the swiglu chain sums 2x up + 1x down): medians/bests/bounds add
+    linearly; n is the weakest stage's sample count."""
+    return {
+        "value": sum(w * s["value"] for w, s in terms),
+        "best": sum(w * s["best"] for w, s in terms),
+        "band": [sum(w * s["band"][0] for w, s in terms),
+                 sum(w * s["band"][1] for w, s in terms)],
+        "n": min(s["n"] for _, s in terms),
+    }
 
 
 def _roofline_s(flops: int, nbytes: int, hw, dtype_key: str) -> float:
@@ -92,7 +129,8 @@ def _aux(name: str, fn, *args):
                        f"at +{elapsed:.0f}s — headline takes precedence")
         return None
     try:
-        return fn(*args)
+        with spans.span("aux", line=name):
+            return fn(*args)
     except Exception as e:
         _skipped(name, f"{type(e).__name__}: {str(e)[:160]}")
         return None
@@ -130,8 +168,40 @@ def _tpu_up_or_skip() -> bool:
     return True
 
 
-def main() -> int:
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="bench.py", description=__doc__)
+    p.add_argument("--trace-out", "--trace_out", dest="trace_out",
+                   default=None, metavar="PATH",
+                   help="write a merged host+device Chrome/Perfetto "
+                        "trace of this bench run (host harness spans + "
+                        "one profiled headline iteration)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    # programmatic callers (tests, __graft_entry__) pass no argv and get
+    # defaults; only the __main__ path below hands over sys.argv
+    args = _parse_args(argv if argv is not None else [])
+    tracer = spans.enable() if args.trace_out else None
+    try:
+        return _run_bench(args, tracer)
+    finally:
+        # never leak the process-global tracer past this run — an
+        # exception mid-bench must not leave later programmatic main()
+        # calls (tests, __graft_entry__) recording into a dead tracer
+        if spans.is_enabled():
+            spans.disable()
+
+
+def _run_bench(args, tracer) -> int:
     if not _tpu_up_or_skip():
+        if tracer is not None:
+            # no run happened, so there is no trace to write — but the
+            # process-global tracer must not leak into a later
+            # programmatic main() call
+            spans.disable()
+            print("trace-out: backend never came up, nothing to trace",
+                  file=sys.stderr)
         return 0  # the skip marker IS the artifact; rc=0 so it parses
 
     from dlnetbench_tpu.core.hardware import HARDWARE
@@ -189,7 +259,8 @@ def main() -> int:
     # examples/xla_knob_study.py so compiler-knob sweeps tune exactly
     # this program.
     K = 10  # train steps chained inside ONE program
-    train_k_fn, params, tokens, card, cfg = bench_step.build(K)
+    with spans.span("build", what="headline train_k"):
+        train_k_fn, params, tokens, card, cfg = bench_step.build(K)
 
     # per-compile compiler option (env XLA_FLAGS can't carry backend
     # flags through the tunnel's compile helper; compiler_options can);
@@ -208,14 +279,18 @@ def main() -> int:
     aot_stats = train_k.stats
     del params  # the executor owns a private donated copy
 
-    params2, losses = train_k()  # warm run (already compiled)
-    losses[-1].item()   # true fence (block_until_ready only acks dispatch
-                        # on the tunnel backend) so rep 1 starts clean
+    with spans.span("warmup", what="headline"):
+        params2, losses = train_k()  # warm run (already compiled)
+        losses[-1].item()   # true fence (block_until_ready only acks
+                            # dispatch on the tunnel) so rep 1 starts clean
 
     # three rounds of K in-program steps (each fences once); median guards
-    # against a slow round from tunnel or host jitter
-    samples = [t / K for t in time_callable(train_k, reps=3)]
-    step_s = statistics.median(samples)
+    # against a slow round from tunnel or host jitter — and the band of
+    # the three rounds ships on the line (metrics/stats.py)
+    with spans.span("timed", what="headline", reps=3, k=K):
+        step_summary = stats_mod.summarize(
+            [t / K for t in time_callable(train_k, reps=3)])
+    step_s = step_summary["value"]
     # materialize EVERY device value the headline will print BEFORE any
     # auxiliary line runs: an aux failure that poisons the backend (the
     # r5 int8-step OOM did) must not take the headline down with it at
@@ -272,6 +347,25 @@ def main() -> int:
         total_flops, step_bytes_bwd, HARDWARE[hw_key], "bfloat16")
     vs_baseline_bwd_aware = roofline_bwd_s / step_s
 
+    # --trace-out: one profiled headline iteration for the device half
+    # of the merged timeline — captured while the compiled program and
+    # its buffers are still alive, BEFORE the residency cleanup below
+    device_events = None
+    if args.trace_out:
+        try:
+            import tempfile
+            from dlnetbench_tpu.metrics import profiling
+            trace_dir = tempfile.mkdtemp(prefix="dlnb_bench_prof_")
+            with spans.span("profile", what="headline iteration"):
+                with jax.profiler.trace(trace_dir):
+                    # TRUE fence inside the trace window (tunnel
+                    # block_until_ready only acks dispatch — the
+                    # profiler must not close mid-execution)
+                    time_callable(train_k, reps=1)
+            device_events = profiling.load_trace_events(trace_dir)
+        except Exception as e:  # the trace is auxiliary to the artifact
+            print(f"trace-out device profile failed: {e}", file=sys.stderr)
+
     # free the headline's device buffers before any auxiliary line: the
     # params pytrees (executor-owned donated carry + the last outputs) +
     # the token batch are ~7 GB of HBM this chip no longer needs, and
@@ -298,10 +392,11 @@ def main() -> int:
     int8_sb = _aux("int8 switchback train step", _bench_int8_step, card,
                    hw_key, dev, step_s, opts, "switchback")
 
-    print(json.dumps({
+    headline = stats_mod.flag_low_mode({
         "metric": f"{_headline_metric_name()}, {dev.device_kind} ({hw_key})",
         "value": round(step_s * 1e3, 3),
         "unit": "ms",
+        **_band_ms(step_summary),
         "vs_baseline": round(vs_baseline, 4),
         "vs_baseline_causal": round(vs_baseline_causal, 4),
         "vs_baseline_bwd_aware": round(vs_baseline_bwd_aware, 4),
@@ -323,7 +418,17 @@ def main() -> int:
         **({"int8_matmul": int8} if int8 else {}),
         **({"int8_step": int8_step} if int8_step else {}),
         **({"int8_switchback_step": int8_sb} if int8_sb else {}),
-    }))
+    })
+    print(json.dumps(headline))
+    if tracer is not None:
+        spans.disable()
+        try:
+            spans.write_chrome_trace(args.trace_out, tracer, device_events)
+            print(f"merged host+device trace -> {args.trace_out}",
+                  file=sys.stderr)
+        except OSError as e:  # the headline already printed — keep rc 0
+            print(f"trace-out write failed ({e}); headline unaffected",
+                  file=sys.stderr)
     return 0
 
 
@@ -385,8 +490,9 @@ def _bench_int8_step(card, hw_key: str, dev, bf16_step_s: float,
     del params                    # executor owns a private donated copy
     _, losses = train_k()         # warm run (already compiled)
     losses[-1].item()             # true fence (see headline)
-    samples = [t / K for t in time_callable(train_k, reps=3)]
-    step_s, loss = statistics.median(samples), float(losses[-1])
+    summary = stats_mod.summarize(
+        [t / K for t in time_callable(train_k, reps=3)])
+    step_s, loss = summary["value"], float(losses[-1])
 
     lm_head_flops = 2 * BATCH * SEQ * card.embed_dim * VOCAB
     fwd_flops = roofline.model_flops(card, BATCH) + lm_head_flops
@@ -413,12 +519,14 @@ def _bench_int8_step(card, hw_key: str, dev, bf16_step_s: float,
                   f"{dev.device_kind} ({hw_key})",
         "value": round(step_s * 1e3, 3),
         "unit": "ms",
+        **_band_ms(summary),
         "speedup_vs_bf16": round(bf16_step_s / step_s, 4),
         "headline_bf16_ms": round(bf16_step_s * 1e3, 3),
         "vs_baseline": round(roofline_split_s / step_s, 4),
         "tflops_achieved": round(total_flops / step_s / 1e12, 2),
         "loss": round(loss, 4),
     }
+    line = stats_mod.flag_low_mode(line)
     print(json.dumps(line))
     return line
 
@@ -465,7 +573,8 @@ def _bench_fp8_mlp(card, hw_key: str, dev) -> dict | None:
             return fp8_dot(xc, w).astype(xc.dtype), ()
         return jax.lax.scan(body, x0, None, length=K)[0]
 
-    t_s = _measure_chain(chain, x, K)
+    summary = _measure_chain(chain, x, K)
+    t_s = summary["value"]
 
     flops = 2 * tokens * d * d
     # bytes per matmul: e4m3 operand reads + bf16 output write
@@ -478,10 +587,11 @@ def _bench_fp8_mlp(card, hw_key: str, dev) -> dict | None:
                   f"{fp8_peak/1e12:.0f} TF/s)",
         "value": round(t_s * 1e3, 3),
         "unit": "ms",
+        **_band_ms(summary),
         "vs_baseline": round(roofline_s / t_s, 4),
         "tflops_achieved": round(flops / t_s / 1e12, 2),
     }
-    line = _flag_above_peak(line)
+    line = stats_mod.flag_low_mode(_flag_above_peak(line))
     print(json.dumps(line))
     return line
 
@@ -535,9 +645,11 @@ def _bench_fp8_swiglu_chain(card, hw_key: str, dev) -> dict | None:
             return hc.at[:, :d].add(y.astype(hc.dtype) * 1e-6), ()
         return jax.lax.scan(body, h, None, length=K)[0]
 
-    # chain total: gate + up (two identical stages) + down
-    t_s = (2 * _measure_chain(up_chain, x, K)
-           + _measure_chain(down_chain, h0, K))
+    # chain total: gate + up (two identical stages) + down — each stage
+    # measured independently, bands added linearly
+    summary = _combine_linear([(2, _measure_chain(up_chain, x, K)),
+                               (1, _measure_chain(down_chain, h0, K))])
+    t_s = summary["value"]
 
     flops = 6 * tokens * d * f  # three T*D*F matmuls
     nbytes = int(BYTES_PER_ELEMENT["float8"]
@@ -552,11 +664,12 @@ def _bench_fp8_swiglu_chain(card, hw_key: str, dev) -> dict | None:
                   f"{fp8_peak/1e12:.0f} TF/s)",
         "value": round(t_s * 1e3, 3),
         "unit": "ms",
+        **_band_ms(summary),
         "vs_baseline": round(_roofline_s(flops, nbytes, hw, "float8")
                              / t_s, 4),
         "tflops_achieved": round(flops / t_s / 1e12, 2),
     }
-    line = _flag_above_peak(line)
+    line = stats_mod.flag_low_mode(_flag_above_peak(line))
     print(json.dumps(line))
     return line
 
@@ -599,7 +712,8 @@ def _bench_int8_matmul(card, hw_key: str, dev) -> dict | None:
             return (y >> 8).astype(jnp.int8), ()
         return jax.lax.scan(body, x0, None, length=K)[0]
 
-    t_s = _measure_chain(chain, x, K)
+    summary = _measure_chain(chain, x, K)
+    t_s = summary["value"]
 
     ops = 2 * tokens * d * d
     nbytes = int(BYTES_PER_ELEMENT["int8"] * (2 * tokens * d + d * d))
@@ -608,14 +722,15 @@ def _bench_int8_matmul(card, hw_key: str, dev) -> dict | None:
                   f"({hw_key}, int8 peak {int8_peak/1e12:.0f} TOP/s)",
         "value": round(t_s * 1e3, 3),
         "unit": "ms",
+        **_band_ms(summary),
         "vs_baseline": round(_roofline_s(ops, nbytes, hw, "int8") / t_s,
                              4),
         "tops_achieved": round(ops / t_s / 1e12, 2),
     }
-    line = _flag_above_peak(line)
+    line = stats_mod.flag_low_mode(_flag_above_peak(line))
     print(json.dumps(line))
     return line
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

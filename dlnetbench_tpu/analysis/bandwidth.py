@@ -20,6 +20,41 @@ counts from column names.
 from __future__ import annotations
 
 
+def transport_of(rec: dict) -> str:
+    """Transport provenance of a record's timed bytes — the column that
+    stops a loopback row from reading as fabric physics.
+
+    Schema-v2 records (and current native records) stamp
+    ``global.transport`` directly (``ici``, ``virtual-host``, ``shm``,
+    ``tcp:loopback``, ``tcp:ethernet``, ``host``, ...).  Older records
+    are classified from the identity they do carry — the native tier's
+    backend/executor keys, or the mesh header's virtual-fabric marker —
+    and only a record carrying nothing classifiable is ``unknown``."""
+    g = rec.get("global", {})
+    t = g.get("transport")
+    if t:
+        return str(t)
+    backend = g.get("backend")
+    if backend == "shm":
+        return "shm"
+    if backend == "tcp":
+        return "tcp"  # pre-stamp records don't say loopback vs ethernet
+    if backend == "pjrt":
+        # HostExecutor moves host memory; the plugin's collectives ride
+        # the real interconnect.  A hier run composes a TCP DCN leg.
+        execu = g.get("pjrt_executor")
+        local = "host" if execu == "host" else "ici"
+        return f"{local}+tcp" if g.get("dcn_transport") == "tcp" else local
+    mesh = rec.get("mesh", {})
+    if mesh.get("platform") == "cpu":
+        return "virtual-host"
+    if mesh.get("platform") == "tpu":
+        # mirror emit.transport_label: a multi-host record's collectives
+        # have a DCN leg and must not be classified as pure ICI
+        return "ici+dcn" if mesh.get("num_hosts", 1) > 1 else "ici"
+    return "unknown"
+
+
 def bus_factor(kind: str, n: int) -> float:
     n = max(int(n), 1)
     if kind == "allreduce":
@@ -44,6 +79,7 @@ def effective_bandwidth(records: list[dict]):
         model = g.get("comm_model")
         if not model:
             continue
+        transport = transport_of(rec)
         for rank_row in rec.get("ranks", []):
             for timer, components in model.items():
                 times = rank_row.get(timer)
@@ -126,17 +162,20 @@ def effective_bandwidth(records: list[dict]):
                                        else bus_total / (t_us * 1e-6)
                                        / 1e9),
                         "bound": bound,
+                        "transport": transport,
                     })
     return pd.DataFrame(rows)
 
 
 def bandwidth_summary(records: list[dict]):
     """Mean per (section, model, collective): the north-star table.
-    Carries the ``bound`` marker so lower-bound rows stay labeled."""
+    Carries the ``bound`` marker so lower-bound rows stay labeled, and
+    the ``transport`` provenance so a loopback/virtual-mesh mean can
+    never be averaged into (or mistaken for) a fabric figure."""
     bw = effective_bandwidth(records)
     if bw.empty:
         return bw
     return (bw.groupby(["section", "model", "collective", "group_size",
-                        "bound"])
+                        "bound", "transport"])
             [["time_us", "msg_bytes", "algbw_GBps", "busbw_GBps"]]
             .mean().reset_index())

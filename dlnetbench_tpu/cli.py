@@ -23,6 +23,7 @@ import sys
 
 from dlnetbench_tpu.core.model_card import arch_name_from_stats_name, load_model_card
 from dlnetbench_tpu.core.model_stats import load_model_stats
+from dlnetbench_tpu.metrics import spans
 from dlnetbench_tpu.metrics.emit import emit_result
 from dlnetbench_tpu.proxies.base import ProxyConfig, run_proxy
 
@@ -69,6 +70,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "with the JAX profiler and attach per-collective "
                         "device-op durations to the record (the cross-check "
                         "for the decomposition timers, SURVEY.md 7.3)")
+    p.add_argument("--trace-out", "--trace_out", dest="trace_out",
+                   default=None, metavar="PATH",
+                   help="write ONE merged Chrome/Perfetto trace: host "
+                        "harness spans (build/compile/warmup/timed/fence) "
+                        "on top, the device-op timeline of one profiled "
+                        "schedule iteration below, collectives colored by "
+                        "kind (metrics/spans.py; docs/OBSERVABILITY.md)")
     p.add_argument("--tag", action="append", default=[], metavar="KEY=VALUE",
                    help="attach a variable to the emitted record (the "
                         "analysis layer hoists it to a DataFrame column; "
@@ -221,8 +229,26 @@ def main(argv: list[str] | None = None) -> int:
                      f"mapping; supported: {sorted(jnp_dtypes)}")
     dtype = jnp_dtypes[dtype_name]
 
+    # span tracing covers the WHOLE config — build (with its compile
+    # spans), warmup, timed runs, the profiled iteration — so the merged
+    # timeline answers "where did this run's wall-clock go"
+    tracer = spans.enable() if args.trace_out else None
     try:
-        bundle = _build_bundle(args, parser, stats, cfg, devices, dtype)
+        return _run_measured(args, parser, stats, cfg, devices, dtype,
+                             dtype_name, variables, tracer)
+    finally:
+        # a failure anywhere in the run (backend error, parser.error's
+        # SystemExit) must not leak the process-global tracer into later
+        # runs in this process (sweep's in-process mode, test harnesses)
+        if spans.is_enabled():
+            spans.disable()
+
+
+def _run_measured(args, parser, stats, cfg, devices, dtype, dtype_name,
+                  variables, tracer) -> int:
+    try:
+        with spans.span("build", proxy=args.proxy, model=args.model):
+            bundle = _build_bundle(args, parser, stats, cfg, devices, dtype)
     except ImportError as e:
         parser.error(f"proxy {args.proxy!r} is not implemented yet ({e})")
     except ValueError as e:
@@ -231,9 +257,45 @@ def main(argv: list[str] | None = None) -> int:
     if variables:
         bundle.global_meta["variables"] = variables
     result = run_proxy(args.proxy, bundle, cfg)
-    if args.profile:
-        from dlnetbench_tpu.metrics.profiling import profile_collectives
-        result.global_meta["profile"] = profile_collectives(bundle.full)
+
+    # the profile/trace channels are AUXILIARY to the record: the timed
+    # runs above are already measured, and no trace failure may cost
+    # them — every step below degrades to a stderr note, never an abort
+    device_events = None
+    if args.profile or args.trace_out:
+        # one schedule iteration under the JAX profiler serves BOTH
+        # channels: per-collective stats for the record (--profile) and
+        # raw device-op events for the merged timeline (--trace-out)
+        try:
+            import tempfile
+            import jax
+            from dlnetbench_tpu.metrics import profiling
+            from dlnetbench_tpu.utils.timing import time_callable
+            trace_dir = tempfile.mkdtemp(prefix="dlnb_prof_")
+            with spans.span("profile", proxy=args.proxy):
+                with jax.profiler.trace(trace_dir):
+                    # TRUE fence inside the trace window — on the
+                    # tunnel backend block_until_ready only acks
+                    # dispatch, and the profiler context must not
+                    # close before the device work finishes
+                    time_callable(bundle.full, reps=1)
+            device_events = profiling.load_trace_events(trace_dir)
+            if args.profile:
+                result.global_meta["profile"] = \
+                    profiling.collective_stats(device_events)
+        except Exception as e:
+            print(f"profile/trace capture failed "
+                  f"({type(e).__name__}: {e}); record unaffected",
+                  file=sys.stderr)
+    if tracer is not None:
+        spans.disable()
+        try:
+            spans.write_chrome_trace(args.trace_out, tracer, device_events)
+            print(f"merged host+device trace -> {args.trace_out}",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"trace-out write failed ({e}); record unaffected",
+                  file=sys.stderr)
     emit_result(result, path=args.out)
     return 0
 

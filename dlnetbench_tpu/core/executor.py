@@ -44,6 +44,8 @@ from collections.abc import Callable
 
 import jax
 
+from dlnetbench_tpu.metrics import spans
+
 ENV_CACHE_DIR = "DLNB_COMPILE_CACHE_DIR"
 
 # Donation kill-switch.  Each donated program owns a PRIVATE clone of
@@ -128,17 +130,20 @@ class CompiledProgram:
         # ``lowered.out_info`` already carries — a separate eval_shape
         # pass would re-trace every program (tracing these unrolled
         # pipeline bodies costs as much as compiling them warm)
-        lowered = jax.jit(program.fn,
-                          donate_argnums=requested).lower(*args)
-        donate, self._rebind, undonated = _plan_donation(
-            jax.tree.leaves(lowered.out_info), args, requested)
-        if donate != requested:
-            # some requested donations have no output to rebind from
-            # (mode/schedule-dependent dummies): re-lower with only the
-            # kept set — the dropped buffers must NOT be invalidated
+        with spans.span("compile", fn=getattr(program.fn, "__name__",
+                                              type(program.fn).__name__)):
             lowered = jax.jit(program.fn,
-                              donate_argnums=donate).lower(*args)
-        self._compiled = lowered.compile(program.compiler_options)
+                              donate_argnums=requested).lower(*args)
+            donate, self._rebind, undonated = _plan_donation(
+                jax.tree.leaves(lowered.out_info), args, requested)
+            if donate != requested:
+                # some requested donations have no output to rebind from
+                # (mode/schedule-dependent dummies): re-lower with only
+                # the kept set — the dropped buffers must NOT be
+                # invalidated
+                lowered = jax.jit(program.fn,
+                                  donate_argnums=donate).lower(*args)
+            self._compiled = lowered.compile(program.compiler_options)
         compile_ms = (time.perf_counter() - t0) * 1e3
 
         # donation consumes the buffer, and sibling programs (full /
@@ -146,8 +151,9 @@ class CompiledProgram:
         # every donated argument gets a private device-side copy
         # (structurally identical to the original, so the executable
         # lowered above accepts it)
-        for argnum in donate:
-            args[argnum] = _clone(args[argnum])
+        with spans.span("donate-clone", argnums=list(donate)):
+            for argnum in donate:
+                args[argnum] = _clone(args[argnum])
         self._args = args
         self._treedef = jax.tree.structure(tuple(args))
 
@@ -165,13 +171,23 @@ class CompiledProgram:
     def __call__(self):
         outs = self._compiled(*self._args)
         if self._rebind:
-            flat_out = jax.tree.leaves(outs)
-            flat_args = jax.tree.leaves(tuple(self._args))
-            for arg_i, out_i in self._rebind:
-                flat_args[arg_i] = flat_out[out_i]
-            self._args = list(jax.tree.unflatten(self._treedef,
-                                                 flat_args))
+            # the rebind is host-side pytree bookkeeping inside the hot
+            # loop — span-tagged so a traced run shows its cost on the
+            # timeline, gated on is_enabled so an untraced timed rep
+            # pays nothing here (same discipline as timing._fence)
+            if spans.is_enabled():
+                with spans.span("rebind", pairs=len(self._rebind)):
+                    self._do_rebind(outs)
+            else:
+                self._do_rebind(outs)
         return outs
+
+    def _do_rebind(self, outs) -> None:
+        flat_out = jax.tree.leaves(outs)
+        flat_args = jax.tree.leaves(tuple(self._args))
+        for arg_i, out_i in self._rebind:
+            flat_args[arg_i] = flat_out[out_i]
+        self._args = list(jax.tree.unflatten(self._treedef, flat_args))
 
 
 def _clone(tree):

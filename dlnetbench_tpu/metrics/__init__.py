@@ -1,6 +1,26 @@
-from dlnetbench_tpu.metrics.emit import emit_result, result_to_record
-from dlnetbench_tpu.metrics.parser import (
-    load_records, records_to_dataframe, get_metrics_dataframe)
+"""Metrics package: emission, parsing, merging, profiling, spans, stats.
+
+Re-exports are resolved lazily (PEP 562): ``emit`` imports the proxy
+harness, which imports ``utils.timing``, which imports ``metrics.spans``
+— an eager ``from .emit import ...`` here would close that loop into a
+circular-import failure the moment anything imports the timing module
+first.
+"""
+from __future__ import annotations
 
 __all__ = ["emit_result", "result_to_record", "load_records",
            "records_to_dataframe", "get_metrics_dataframe"]
+
+_HOMES = {
+    "emit_result": "emit", "result_to_record": "emit",
+    "load_records": "parser", "records_to_dataframe": "parser",
+    "get_metrics_dataframe": "parser",
+}
+
+
+def __getattr__(name: str):
+    home = _HOMES.get(name)
+    if home is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(f"{__name__}.{home}"), name)

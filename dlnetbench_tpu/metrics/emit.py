@@ -7,15 +7,29 @@ ccutils macros), parsed downstream into pandas DataFrames.
 
 Here a run emits ONE self-describing JSON object (one line when streamed):
 
-    {"section": "<proxy>", "version": 1,
-     "global": {...},                       # the rank-0 globals
+    {"section": "<proxy>", "version": 2,
+     "global": {..., "transport": "ici"},   # the rank-0 globals
      "ranks": [{"rank": 0, "device_id": ..., "runtimes": [...],
-                "barrier_time": [...], ...}, ...]}
+                "barrier_time": [...],
+                "summary": {"runtimes": {"value": ..., "best": ...,
+                                         "band": [lo, hi], "n": N}, ...},
+                ...}, ...]}
 
 Per-"rank" rows are per *device*.  Timing is host-measured per iteration
 (single-controller), so timer arrays are shared across rows on a single
 host; rows still carry device identity/coords so multi-host runs and the
 analysis layer keep the reference's rank-resolved shape.
+
+Schema history:
+  v1 — raw timer arrays only.
+  v2 — adds (a) per-rank ``summary``: every timer array summarized to
+       the artifact-grade band form (``metrics.stats.summarize``), so a
+       record is self-describing without re-deriving statistics; (b) a
+       ``transport`` global naming what the timed bytes actually moved
+       over (``ici`` / virtual host mesh), so loopback numbers can never
+       be read as fabric physics.  v1 records still parse everywhere
+       (parser/merge treat both; ``summary`` is derived data and absent
+       from v1).
 """
 from __future__ import annotations
 
@@ -23,9 +37,10 @@ import json
 import socket
 import sys
 
+from dlnetbench_tpu.metrics.stats import summarize
 from dlnetbench_tpu.proxies.base import ProxyResult
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 
 def scheduler_variables(environ=None) -> dict:
@@ -63,11 +78,32 @@ def _process_identity() -> tuple[int, int]:
         return 0, 1
 
 
+def transport_label(mesh_info: dict) -> str:
+    """What the timed bytes actually moved over, from the mesh header.
+
+    A virtual CPU mesh's collectives move thread/loopback bytes — its
+    bandwidth rows must be labeled as such, not read as fabric physics
+    (the native tier stamps its own transports: shm, tcp:loopback,
+    tcp:ethernet, host, ici)."""
+    platform = mesh_info.get("platform")
+    if platform == "cpu":
+        return "virtual-host"
+    if platform == "tpu":
+        return "ici+dcn" if mesh_info.get("num_hosts", 1) > 1 else "ici"
+    return platform or "unknown"
+
+
 def result_to_record(result: ProxyResult) -> dict:
     mesh_info = result.global_meta.get("mesh", {})
     devices = mesh_info.get("devices", [{"id": 0, "process": 0}])
     hostname = socket.gethostname()
     proc, num_procs = _process_identity()
+    # schema v2: each timer array ships with its band summary — the
+    # record states value/best/band/n itself instead of leaving every
+    # reader to re-derive (and disagree on) the statistics.  One dict,
+    # shared across the per-device rows like the arrays themselves.
+    summary = {name: summarize(vals, ndigits=3)
+               for name, vals in result.timers_us.items()}
     ranks = []
     for i, dev in enumerate(devices):
         row = {
@@ -78,8 +114,16 @@ def result_to_record(result: ProxyResult) -> dict:
             **({"coords": dev["coords"]} if "coords" in dev else {}),
         }
         row.update(result.timers_us)
+        # outer dict copied per row: consumers that drop a key from one
+        # row's summary (metrics.merge's per-host energy dedup) must not
+        # silently edit every sibling row; the inner band dicts are
+        # never mutated per-row and stay shared
+        row["summary"] = dict(summary)
         ranks.append(row)
     g = {k: v for k, v in result.global_meta.items() if k != "mesh"}
+    # transport provenance (schema v2): proxies that know better (the
+    # native tier, future DCN-aware builds) pre-stamp their own
+    g.setdefault("transport", transport_label(mesh_info))
     if num_procs > 1:
         g.setdefault("num_processes", num_procs)
     return {

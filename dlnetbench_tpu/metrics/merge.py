@@ -95,6 +95,15 @@ def merge_records(records: list[dict]) -> dict:
             raise ValueError(
                 f"merge_records: process {proc} ran {rec.get('num_runs')} "
                 f"iterations, process 0 ran {base.get('num_runs')}")
+        # v1 and v2 records both merge, but never with each other — a
+        # mixed set means the hosts ran different harness builds, and
+        # half the merged rows would silently lack their band summaries
+        if rec.get("version") != base.get("version"):
+            raise ValueError(
+                f"merge_records: process {proc} emitted schema version "
+                f"{rec.get('version')}, process 0 emitted "
+                f"{base.get('version')} — records are from different "
+                f"harness builds")
 
     declared = base["global"].get("num_processes")
     if declared is not None and sorted(by_process) != list(range(declared)):
@@ -129,6 +138,10 @@ def merge_records(records: list[dict]) -> dict:
             continue
         if host in seen_hosts:
             del row["energy_consumed"]
+            # the v2 band summary is the channel readers are told to
+            # consume — it must not keep reporting the deduped energy
+            if isinstance(row.get("summary"), dict):
+                row["summary"].pop("energy_consumed", None)
         else:
             seen_hosts.add(host)
 

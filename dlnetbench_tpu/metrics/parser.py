@@ -16,7 +16,10 @@ from __future__ import annotations
 import json
 from pathlib import Path
 
-_TIMER_KEYS_EXCLUDE = {"rank", "device_id", "process_index", "hostname", "coords"}
+# non-timer per-rank keys; "summary" is the schema-v2 per-timer band
+# summaries (dict, not a sample array — must never be iterated as runs)
+_TIMER_KEYS_EXCLUDE = {"rank", "device_id", "process_index", "hostname",
+                       "coords", "summary"}
 
 
 def load_records(path: str | Path, section: str | None = None) -> list[dict]:
@@ -55,6 +58,13 @@ def validate_record(rec: dict) -> None:
                 raise ValueError(
                     f"rank {row['rank']} timer {k!r} has {len(v)} entries, "
                     f"expected {n}")
+        # schema v2: a summary must describe the samples it rides with
+        for k, s in (row.get("summary") or {}).items():
+            vals = row.get(k)
+            if isinstance(vals, list) and s.get("n") != len(vals):
+                raise ValueError(
+                    f"rank {row['rank']} summary for {k!r} claims n="
+                    f"{s.get('n')} but the timer has {len(vals)} samples")
     num_procs = rec["global"].get("num_processes")
     if num_procs is not None:
         procs = sorted({row.get("process_index", 0) for row in rows})

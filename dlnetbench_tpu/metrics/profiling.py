@@ -52,14 +52,25 @@ def classify_op(name: str) -> str | None:
 
 
 def load_trace_events(trace_dir: str | Path) -> list[dict]:
-    """All complete ('X') events from the newest trace.json.gz under
-    ``trace_dir`` (the layout jax.profiler.trace writes)."""
-    paths = sorted(glob.glob(f"{trace_dir}/**/*.trace.json.gz",
-                             recursive=True))
-    if not paths:
-        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
-    with gzip.open(paths[-1]) as f:
-        trace = json.load(f)
+    """All complete ('X') events from a Chrome trace.
+
+    Accepts either a directory (the layout ``jax.profiler.trace``
+    writes — the newest ``*.trace.json.gz`` under it is read) or a
+    single trace file, plain ``.json`` or gzipped — which is how the
+    merged host+device timelines ``metrics.spans.write_chrome_trace``
+    emits round-trip through the same loader."""
+    p = Path(trace_dir)
+    if p.is_file():
+        opener = gzip.open if p.name.endswith(".gz") else open
+        with opener(p) as f:
+            trace = json.load(f)
+    else:
+        paths = sorted(glob.glob(f"{trace_dir}/**/*.trace.json.gz",
+                                 recursive=True))
+        if not paths:
+            raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+        with gzip.open(paths[-1]) as f:
+            trace = json.load(f)
     return [e for e in trace.get("traceEvents", [])
             if e.get("ph") == "X" and "dur" in e]
 
@@ -89,9 +100,15 @@ def profile_collectives(fn, *args, trace_dir: str | Path | None = None,
     ``fn`` should be compiled already (profile the steady state, not
     tracing/compilation).  ``trace_dir`` defaults to a fresh temp dir.
     """
+    from dlnetbench_tpu.utils.timing import time_callable
+
     d = str(trace_dir) if trace_dir else tempfile.mkdtemp(prefix="dlnb_prof_")
     with jax.profiler.trace(d):
-        jax.block_until_ready(fn(*args, **kwargs))
+        # time_callable's transfer fence truly waits for the device work
+        # before the profiler context closes — on the tunnel backend a
+        # bare block_until_ready only acks dispatch and would truncate
+        # the trace mid-execution
+        time_callable(fn, *args, reps=1, **kwargs)
     return collective_stats(load_trace_events(d))
 
 

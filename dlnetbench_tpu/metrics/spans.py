@@ -1,0 +1,262 @@
+"""Host-side span tracing — the observability spine of the harness.
+
+Every emitted number in this repo is a *host* wall-clock measurement of
+an async device program; the device side already has a self-describing
+trace channel (``jax.profiler`` -> ``metrics/profiling.py``), but the
+host side — build, compile, warmup, calibration, fence waits, per-config
+sweep points — lived only in session logs.  This module gives the host
+side the same artifact-grade story:
+
+* ``span("name", key=value)`` is a context manager timing a region on
+  the process-wide monotonic clock (``time.perf_counter``), nestable
+  across threads (each thread keeps its own depth stack).
+* Tracing is OFF by default and the disabled path is near-zero cost:
+  ``span()`` returns a shared no-op singleton — no span object is
+  allocated, no clock is read, nothing is recorded.  (A keyword-attrs
+  call still builds its kwargs dict, so the hot measurement sites in
+  ``utils/timing.py`` additionally gate on ``is_enabled()`` — a timed
+  fence window in an untraced run pays nothing at all.)
+* ``write_chrome_trace`` exports the collected spans as Chrome-trace
+  ("Trace Event Format") complete events and MERGES them with the
+  device-op events the JAX profiler emitted for the same run, so ONE
+  ``trace.json`` (loadable in Perfetto / chrome://tracing) shows where
+  wall-clock went: host track on top (compile vs warmup vs timed vs
+  fence), per-device tracks below, collective ops colored by kind via
+  ``profiling.classify_op``.
+
+The tracer is deliberately NOT a per-collective measurement channel —
+that is the decomposition harness (proxies/base.py) and the device
+trace (metrics/profiling.py).  Spans attribute *phases* of the harness
+itself, the layer neither channel covers.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+
+# ---------------------------------------------------------------------
+# Tracer core.
+
+class _NullSpan:
+    """Shared disabled-mode span: entering/exiting does nothing and the
+    module hands out this one instance for every disabled ``span()``
+    call — the per-span allocation count when disabled is zero."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: records start on __enter__, appends a finished
+    record to its tracer on __exit__.  Exceptions propagate (the span
+    still closes, marked ``error``) so a failing phase stays visible in
+    the timeline instead of vanishing with its context."""
+    __slots__ = ("_tracer", "name", "attrs", "_t0", "_depth")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict | None):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._depth = self._tracer._push()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        tr = self._tracer
+        tr._pop()
+        if exc_type is not None:
+            attrs = dict(self.attrs or {})
+            attrs["error"] = exc_type.__name__
+            self.attrs = attrs
+        tr._record(self.name, self._t0, t1, self._depth, self.attrs)
+        return False
+
+
+class Tracer:
+    """Collects finished spans as plain dicts (name, ts/dur in us on the
+    tracer's own origin, thread id, nesting depth, attrs).  Thread-safe;
+    one tracer per measured run is the intended shape."""
+
+    def __init__(self):
+        self.origin = time.perf_counter()
+        self.spans: list[dict] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    # -- called by _Span --------------------------------------------
+    def _push(self) -> int:
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = depth + 1
+        return depth
+
+    def _pop(self) -> None:
+        self._local.depth = getattr(self._local, "depth", 1) - 1
+
+    def _record(self, name: str, t0: float, t1: float, depth: int,
+                attrs: dict | None) -> None:
+        rec = {
+            "name": name,
+            "ts_us": (t0 - self.origin) * 1e6,
+            "dur_us": (t1 - t0) * 1e6,
+            "tid": threading.get_ident(),
+            "depth": depth,
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        with self._lock:
+            self.spans.append(rec)
+
+    # -- public ------------------------------------------------------
+    def span(self, name: str, **attrs) -> _Span:
+        return _Span(self, name, attrs or None)
+
+
+# Module-level current tracer.  ``None`` means disabled — the common
+# case — and the ``span()`` fast path below is one global load, one
+# ``is None`` test, one return of the shared singleton.
+_TRACER: Tracer | None = None
+
+
+def enable() -> Tracer:
+    """Install (and return) a fresh tracer as the process tracer.
+    Subsequent ``span()`` calls record into it."""
+    global _TRACER
+    _TRACER = Tracer()
+    return _TRACER
+
+
+def disable() -> Tracer | None:
+    """Stop tracing; returns the tracer that was active (with its
+    collected spans) so callers can export after the measured region."""
+    global _TRACER
+    t, _TRACER = _TRACER, None
+    return t
+
+
+def current() -> Tracer | None:
+    return _TRACER
+
+
+def is_enabled() -> bool:
+    return _TRACER is not None
+
+
+def span(name: str, **attrs):
+    """Time a region when tracing is enabled; free when it is not."""
+    t = _TRACER
+    if t is None:
+        return NULL_SPAN
+    return t.span(name, **attrs)
+
+
+# ---------------------------------------------------------------------
+# Chrome-trace / Perfetto export.
+
+HOST_PID = 0          # host spans live on one process track
+_DEVICE_PID_BASE = 1  # device events keep their own pids shifted up
+
+# chrome://tracing reserved color names per collective kind — Perfetto
+# falls back to hashing the name, so the kind also rides in args.kind
+_KIND_CNAME = {
+    "allreduce": "thread_state_running",
+    "allgather": "thread_state_runnable",
+    "reduce_scatter": "thread_state_iowait",
+    "alltoall": "rail_animation",
+    "permute": "rail_response",
+    "send_recv": "rail_idle",
+}
+
+
+def host_events(tracer: Tracer, *, pid: int = HOST_PID) -> list[dict]:
+    """Tracer spans -> Chrome complete ('X') events on the host track."""
+    events: list[dict] = [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": "host (harness phases)"}},
+        {"ph": "M", "pid": pid, "name": "process_sort_index",
+         "args": {"sort_index": -1}},  # host track above device tracks
+    ]
+    for s in tracer.spans:
+        ev = {
+            "ph": "X",
+            "pid": pid,
+            "tid": s["tid"],
+            "name": s["name"],
+            "ts": s["ts_us"],
+            "dur": s["dur_us"],
+        }
+        args = dict(s.get("attrs") or {})
+        args["depth"] = s["depth"]
+        ev["args"] = args
+        events.append(ev)
+    return events
+
+
+def _colored_device_events(device_events: list[dict],
+                           align_to_us: float | None) -> list[dict]:
+    """Shift device events onto the host timeline and color collectives.
+
+    The device trace's timestamps are on the profiler's own epoch; only
+    their relative layout is meaningful here, so the earliest device
+    event is aligned to ``align_to_us`` on the host clock (the start of
+    the span that bracketed the profiled iteration when the caller
+    knows it, else 0).  Pids are shifted past the host pid so the
+    tracks never collide."""
+    from dlnetbench_tpu.metrics.profiling import classify_op
+
+    if not device_events:
+        return []
+    t_min = min(float(e.get("ts", 0.0)) for e in device_events)
+    shift = (align_to_us if align_to_us is not None else 0.0) - t_min
+    out = []
+    for e in device_events:
+        ev = dict(e)
+        ev["ts"] = float(e.get("ts", 0.0)) + shift
+        ev["pid"] = int(e.get("pid", 0)) + _DEVICE_PID_BASE
+        kind = classify_op(str(e.get("name", "")))
+        if kind is not None:
+            ev["cname"] = _KIND_CNAME[kind]
+            args = dict(ev.get("args") or {})
+            args["kind"] = kind
+            ev["args"] = args
+        out.append(ev)
+    return out
+
+
+def write_chrome_trace(path: str | Path, tracer: Tracer | None,
+                       device_events: list[dict] | None = None,
+                       align_span: str | None = "profile") -> dict:
+    """Write ONE merged Chrome trace: host spans + device-op events.
+
+    ``align_span`` names the host span whose start the earliest device
+    event is pinned to (the span that wrapped the profiled iteration);
+    when absent the device timeline starts at host ts 0.  Returns the
+    trace dict that was written (callers/tests can inspect it without
+    re-reading the file)."""
+    events: list[dict] = []
+    align_to = None
+    if tracer is not None:
+        events.extend(host_events(tracer))
+        if align_span is not None:
+            for s in tracer.spans:
+                if s["name"] == align_span:
+                    align_to = s["ts_us"]
+                    break
+    if device_events:
+        events.extend(_colored_device_events(device_events, align_to))
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    path = Path(path)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return trace

@@ -1,0 +1,77 @@
+"""Artifact-grade sample summaries: every short-chain stat self-describes.
+
+DLNetBench's contract is "the artifact is the result" — but a single
+number from a 3-sample chain on a tunnel-fenced backend is not a result,
+it is one draw from a distribution the round-5 verdict showed to be
+bimodal (tunnel throughput states).  This module is the ONE definition
+of how such samples ship:
+
+    {"value": median, "best": min, "band": [lo, hi], "n": N}
+
+* ``value`` — the median, the figure downstream comparisons use;
+* ``best`` — the minimum, the least-noise observation (host/tunnel
+  jitter only ever inflates a wall-clock sample);
+* ``band`` — the full observed range.  With n this small, percentiles
+  would be theater; the honest statement is "samples fell in here";
+* ``n`` — how many samples back the claim.
+
+``flag_low_mode`` mirrors ``bench._flag_above_peak``: a physically
+suspicious reading must never ship unannotated.  When the best sample
+sits far below the median, the samples straddle two modes (fast-path
+vs slow-path tunnel states) and the median is a mixture statistic, not
+a central tendency — the line is stamped with a ``note`` saying so.
+
+Used by bench.py's auxiliary JSON lines, by ``metrics.emit``'s
+schema-v2 per-timer summaries, and available to any analysis that
+wants one consistent band convention.
+"""
+from __future__ import annotations
+
+import statistics
+
+# best/value ratio below which the samples are declared bimodal: the
+# fastest observation is >30% under the median, which honest unimodal
+# wall-clock noise (inflation-only) does not produce
+LOW_MODE_RATIO = 0.7
+
+
+def summarize(samples: list[float], ndigits: int | None = None) -> dict:
+    """``{"value": median, "best": min, "band": [lo, hi], "n": N}`` for a
+    list of same-unit samples.  Empty input summarizes to zeros with
+    n=0 rather than raising — emitters must not die on a timer that
+    never fired."""
+    if not samples:
+        return {"value": 0.0, "best": 0.0, "band": [0.0, 0.0], "n": 0}
+    vals = [float(v) for v in samples]
+    out = {
+        "value": statistics.median(vals),
+        "best": min(vals),
+        "band": [min(vals), max(vals)],
+        "n": len(vals),
+    }
+    if ndigits is not None:
+        out["value"] = round(out["value"], ndigits)
+        out["best"] = round(out["best"], ndigits)
+        out["band"] = [round(v, ndigits) for v in out["band"]]
+    return out
+
+
+def flag_low_mode(line: dict, ratio: float = LOW_MODE_RATIO) -> dict:
+    """Annotate a summary-carrying dict whose samples straddle two modes.
+
+    Operates on the ``value``/``best`` keys (any unit) so it applies to
+    a raw ``summarize`` result and to a bench JSON line alike; appends
+    to an existing ``note`` (e.g. the above-peak flag) instead of
+    clobbering it."""
+    value = line.get("value") or 0.0
+    best = line.get("best")
+    n = line.get("n", 0)
+    if best is None or n < 2 or value <= 0:
+        return line
+    if best < ratio * value:
+        note = (f"bimodal samples: best {best:g} is "
+                f"{100 * (1 - best / value):.0f}% below the median over "
+                f"n={n} — the median mixes two modes (tunnel/host "
+                f"throughput states); read [band] not value")
+        line["note"] = f"{line['note']}; {note}" if line.get("note") else note
+    return line

@@ -37,6 +37,7 @@ from collections.abc import Callable
 
 import jax
 
+from dlnetbench_tpu.metrics import spans
 from dlnetbench_tpu.utils.timing import time_callable, time_chain
 
 DEFAULT_WARMUP = 3   # reference dp.cpp:65
@@ -115,7 +116,8 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
     # build time (core/executor.py), so these samples measure EXECUTION
     # only — compile time can no longer pollute estimate_runs through
     # the warmup mean the way a first-call jit compile did.
-    warmup_s = time_callable(bundle.full, reps=max(cfg.warmup, 1))
+    with spans.span("warmup", proxy=name, reps=max(cfg.warmup, 1)):
+        warmup_s = time_callable(bundle.full, reps=max(cfg.warmup, 1))
 
     runs = cfg.runs
     if cfg.min_exectime_s > 0:
@@ -126,8 +128,9 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
             bundle.full()
 
     if energy_sampler is None and cfg.measure_energy:
-        from dlnetbench_tpu.metrics.energy import detect_sampler
-        energy_sampler = detect_sampler()
+        with spans.span("calibrate", what="energy_sampler"):
+            from dlnetbench_tpu.metrics.energy import detect_sampler
+            energy_sampler = detect_sampler()
     if energy_sampler is not None:
         # which sensor produced energy_consumed — misattribution (wrong
         # hwmon device) must be visible in the record, not silent
@@ -144,7 +147,8 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
     # (dp.cpp:191); the decomposition channel has to earn it.
     measure_compute = cfg.measure_compute_only and bundle.compute is not None
     if measure_compute:
-        time_callable(bundle.compute, reps=1)  # warm outside the A/B loop
+        with spans.span("warmup", proxy=name, variant="compute"):
+            time_callable(bundle.compute, reps=1)  # warm outside A/B loop
 
     # fence chains: with reps_per_fence = K each chain is K back-to-back
     # dispatches fenced ONCE, and contributes one per-iteration sample
@@ -157,21 +161,24 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
     full_s: list[float] = []
     comp_s: list[float] = []
     energy_j: list[float] = []
-    for k in chains:
-        # Energy brackets ONLY the fenced full chain (reference per-rank
-        # energy_consumed arrays, plots/parser.py:172), reported per
-        # iteration.  The RTT-aware transfer fence inside time_chain
-        # guarantees the device work finished before the closing read;
-        # its host spin adds a constant per-chain offset that cancels
-        # across configs.
-        if energy_sampler is not None:
-            e0 = energy_sampler.read_joules()
-        t_full = time_chain(bundle.full, k=k)
-        if energy_sampler is not None:
-            energy_j.append(max(0.0, energy_sampler.read_joules() - e0) / k)
-        full_s.append(t_full)
-        if measure_compute:
-            comp_s.append(time_chain(bundle.compute, k=k))
+    with spans.span("timed", proxy=name, variant="full+compute",
+                    runs=runs, chains=len(chains)):
+        for k in chains:
+            # Energy brackets ONLY the fenced full chain (reference
+            # per-rank energy_consumed arrays, plots/parser.py:172),
+            # reported per iteration.  The RTT-aware transfer fence
+            # inside time_chain guarantees the device work finished
+            # before the closing read; its host spin adds a constant
+            # per-chain offset that cancels across configs.
+            if energy_sampler is not None:
+                e0 = energy_sampler.read_joules()
+            t_full = time_chain(bundle.full, k=k)
+            if energy_sampler is not None:
+                energy_j.append(max(0.0,
+                                    energy_sampler.read_joules() - e0) / k)
+            full_s.append(t_full)
+            if measure_compute:
+                comp_s.append(time_chain(bundle.compute, k=k))
     timers["runtimes"] = [t * 1e6 for t in full_s]
     if energy_sampler is not None:
         timers["energy_consumed"] = energy_j
@@ -185,14 +192,16 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
                                   for f, c in zip(full_s, comp_s)]
 
     if cfg.measure_comm_only and bundle.comm is not None:
-        time_callable(bundle.comm, reps=1)  # warm
-        comm_s = [time_chain(bundle.comm, k=k) for k in chains]
+        with spans.span("timed", proxy=name, variant="comm"):
+            time_callable(bundle.comm, reps=1)  # warm
+            comm_s = [time_chain(bundle.comm, k=k) for k in chains]
         timers["comm_time"] = [t * 1e6 for t in comm_s]
 
     if cfg.measure_comm_only and bundle.variants:
         for vname, vfn in bundle.variants.items():
-            time_callable(vfn, reps=1)  # warm
-            v_s = [time_chain(vfn, k=k) for k in chains]
+            with spans.span("timed", proxy=name, variant=vname):
+                time_callable(vfn, reps=1)  # warm
+                v_s = [time_chain(vfn, k=k) for k in chains]
             timers[f"{vname}_time"] = [t * 1e6 for t in v_s]
 
     return ProxyResult(

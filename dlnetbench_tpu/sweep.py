@@ -47,6 +47,8 @@ import os
 import subprocess
 import sys
 
+from dlnetbench_tpu.metrics import spans
+
 
 def expand_grid(axes: dict[str, list[str]]) -> list[dict[str, str]]:
     """Cartesian product of axes -> list of {axis: value} points."""
@@ -120,11 +122,16 @@ def run_sweep(proxy: str, axes: dict[str, list[str]],
             print("  " + prefix + " ".join(map(shlex.quote, argv)),
                   file=stream)
             continue
-        if in_process:
-            rc = _run_point_in_process(argv, stream)
-        else:
-            env = {**os.environ, **env_over}
-            rc = subprocess.run(argv, env=env).returncode
+        # one span per grid point: a traced sweep shows per-config
+        # wall-clock (and, in-process, the nested build/compile/timed
+        # spans of each point) on one timeline
+        with spans.span("sweep-point", point=desc, index=i,
+                        mode="in-process" if in_process else "subprocess"):
+            if in_process:
+                rc = _run_point_in_process(argv, stream)
+            else:
+                env = {**os.environ, **env_over}
+                rc = subprocess.run(argv, env=env).returncode
         if rc != 0:
             failed += 1
             print(f"[sweep] point failed (exit {rc}): {desc}", file=stream)
@@ -162,6 +169,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--dry_run", action="store_true")
     p.add_argument("--keep_going", action="store_true",
                    help="continue past failed points")
+    p.add_argument("--trace-out", "--trace_out", dest="trace_out",
+                   default=None, metavar="PATH",
+                   help="write a Chrome/Perfetto trace of the sweep: one "
+                        "host span per grid point (nesting each in-process "
+                        "point's build/compile/warmup/timed spans)")
     mode = p.add_mutually_exclusive_group()
     mode.add_argument("--in_process", action="store_true",
                       help="force sharing this process across points "
@@ -184,12 +196,24 @@ def main(argv: list[str] | None = None) -> int:
     passthrough = ["--model", args.model, "--out", args.out] + passthrough
     in_process = True if args.in_process else \
         (False if args.subprocess else None)
+    tracer = spans.enable() if args.trace_out else None
     try:
         failed = run_sweep(args.proxy, axes, passthrough,
                            dry_run=args.dry_run, keep_going=args.keep_going,
                            in_process=in_process)
     except ValueError as e:
         p.error(str(e))
+    finally:
+        if tracer is not None:
+            spans.disable()
+            try:
+                spans.write_chrome_trace(args.trace_out, tracer)
+                print(f"sweep trace -> {args.trace_out}", file=sys.stderr)
+            except OSError as e:
+                # the trace is auxiliary: a write failure must neither
+                # override the sweep's outcome nor mask an in-flight
+                # usage error from the except arm above
+                print(f"sweep trace write failed ({e})", file=sys.stderr)
     return 1 if failed else 0
 
 

@@ -21,6 +21,8 @@ import time
 import jax
 import jax.numpy as jnp
 
+from dlnetbench_tpu.metrics import spans
+
 _RTT_S: float | None = None
 
 
@@ -87,16 +89,31 @@ def time_callable(fn, *args, reps: int = 1, **kwargs) -> list[float]:
     for _ in range(reps):
         t0 = time.perf_counter()
         res = fn(*args, **kwargs)
-        # the transfer fence IS the wait; block_until_ready is only the
-        # fallback for empty results — on the tunnel backend it costs a
-        # dispatch-ack round-trip per output leaf (~100 ms for a params
-        # pytree) without actually fencing anything
-        fenced = _transfer_fence(res) if fence_transfer else False
-        if not fenced:
-            jax.block_until_ready(res)
+        fenced = _fence(res, fence_transfer, k=1)
         out.append(max(0.0,
                        time.perf_counter() - t0 - (rtt if fenced else 0.0)))
     return out
+
+
+def _fence(res, fence_transfer: bool, k: int) -> bool:
+    """Fence ``res``: the transfer fence IS the wait; block_until_ready
+    is only the fallback for empty results — on the tunnel backend it
+    costs a dispatch-ack round-trip per output leaf (~100 ms for a
+    params pytree) without actually fencing anything.
+
+    The span tagging the fence on a traced timeline is gated on
+    ``is_enabled`` so an untraced run's timed window pays NOTHING here —
+    not even the attrs dict a ``span(**kwargs)`` call would build."""
+    if spans.is_enabled():
+        with spans.span("fence", mode="transfer", k=k):
+            fenced = _transfer_fence(res) if fence_transfer else False
+            if not fenced:
+                jax.block_until_ready(res)
+        return fenced
+    fenced = _transfer_fence(res) if fence_transfer else False
+    if not fenced:
+        jax.block_until_ready(res)
+    return fenced
 
 
 def time_chain(fn, *args, k: int = 1, **kwargs) -> float:
@@ -120,9 +137,10 @@ def time_chain(fn, *args, k: int = 1, **kwargs) -> float:
     res = None
     for _ in range(k):
         res = fn(*args, **kwargs)
-    fenced = _transfer_fence(res) if fence_transfer else False
-    if not fenced:
-        jax.block_until_ready(res)
+    # the per-chain fence is span-tagged (traced runs only) so the
+    # merged timeline shows the host blocked-on-device tail distinct
+    # from the dispatch burst
+    fenced = _fence(res, fence_transfer, k=k)
     elapsed = time.perf_counter() - t0 - (rtt if fenced else 0.0)
     return max(0.0, elapsed) / k
 

@@ -992,7 +992,19 @@ class HierFabric : public Fabric {
 
   // This process runs its local ranks as threads (global rank = base + t).
   void launch(const std::function<void(int)>& body) override {
-    local_.launch([&](int lr) { body(base_ + lr); });
+    local_.launch([&](int lr) {
+      try {
+        body(base_ + lr);
+      } catch (...) {
+        // latch death IN the rank thread: the local fabric's launch
+        // catches this to rethrow on the main thread, where the TCP
+        // destructor's thread-local uncaught_exceptions() check alone
+        // would read 0 if the rethrown error is caught before teardown
+        // — the flag keeps the Bye suppressed either way (advisor r5)
+        tcp_.mark_dying();
+        throw;
+      }
+    });
   }
 
   std::vector<int> local_ranks() const override {
@@ -1020,6 +1032,13 @@ class HierFabric : public Fabric {
     meta["local_worlds"] = lw;
     meta["dcn_transport"] = "tcp";
     meta["p2p_transport"] = "host+tcp";
+    // composed provenance, overriding the local fabric's stamp: the
+    // ICI (or host-executor) leg plus the TCP DCN leg, loopback-labeled
+    // when the process mesh never leaves this machine
+    meta["transport"] =
+        std::string(local_.executor().platform() == "host" ? "host"
+                                                           : "ici") +
+        (tcp_.loopback() ? "+tcp:loopback" : "+tcp:ethernet");
     // every DCN leg is a block-routed direct exchange moving the
     // canonical algorithm's bytes (header comment), so busbw correction
     // factors apply; the allreduce leg rides the TCP ring/mesh per the

@@ -86,6 +86,12 @@ class CollectiveExecutor {
   virtual std::string compute_mode() const { return "host_sleep"; }
   // ns per burn iteration once calibrated (0 until then / host executor).
   virtual double burn_ns_per_iter() const { return 0.0; }
+  // Executor provenance for the record ("HostExecutor" |
+  // "PluginExecutor"): which implementation produced the measured
+  // collectives — a host-memory stand-in's numbers must never be read
+  // as device-fabric numbers downstream (analysis/bandwidth.py keys
+  // its transport column on this).
+  virtual std::string executor_kind() const = 0;
 };
 
 // Host reference executor: the same CollectiveProgram semantics computed
@@ -95,6 +101,8 @@ class CollectiveExecutor {
 // tests/test_pjrt_programs.py executing the same generated modules.
 class HostExecutor : public CollectiveExecutor {
  public:
+  std::string executor_kind() const override { return "HostExecutor"; }
+
   void run(const CollectiveProgram& prog,
            const std::vector<const void*>& srcs,
            const std::vector<void*>& dsts, DType dtype) override {
@@ -205,6 +213,8 @@ class PluginExecutor : public CollectiveExecutor {
   explicit PluginExecutor(const std::string& plugin_path,
                           std::vector<int> device_indices = {})
       : ctx_(plugin_path, std::move(device_indices)) {}
+
+  std::string executor_kind() const override { return "PluginExecutor"; }
 
   void run(const CollectiveProgram& prog,
            const std::vector<const void*>& srcs,
@@ -610,6 +620,7 @@ class PjrtFabric : public Fabric {
   DType dtype() const override { return dtype_; }
   std::string backend() const override { return "pjrt"; }
   CollectiveExecutor& executor() { return *exec_; }
+  const CollectiveExecutor& executor() const { return *exec_; }
 
   std::unique_ptr<ProxyCommunicator> world_comm(int rank) override {
     return std::make_unique<PjrtCommunicator>(world_set_, exec_.get(), rank,
@@ -690,6 +701,13 @@ class PjrtFabric : public Fabric {
     std::string plat = exec_->platform();
     meta["device"] = plat == "host" ? "cpu" : plat;
     meta["p2p_transport"] = "host";
+    // executor/transport provenance: which implementation moved the
+    // timed bytes, and over what.  A HostExecutor collective is host
+    // memory traffic; only the real plugin's collectives ride the
+    // device interconnect.  analysis/bandwidth.py surfaces this as the
+    // summary table's `transport` column.
+    meta["executor"] = exec_->executor_kind();
+    meta["transport"] = plat == "host" ? "host" : "ici";
     meta["compute_mode"] = exec_->compute_mode();
     if (exec_->burn_ns_per_iter() > 0)
       meta["burn_ns_per_iter"] = exec_->burn_ns_per_iter();

@@ -517,6 +517,10 @@ class ShmFabric : public Fabric {
     meta["backend"] = "shm";
     meta["device"] = "cpu";
     meta["compute_mode"] = "host_sleep";
+    // in-process thread fabric: the timed bytes never leave this
+    // process's memory — the provenance that keeps these rows from
+    // reading as fabric bandwidth (analysis/bandwidth.py `transport`)
+    meta["transport"] = "shm";
     mesh["platform"] = "shm";
     mesh["device_kind"] = "thread-rank";
   }

@@ -26,6 +26,7 @@
 #pragma once
 
 #include <arpa/inet.h>
+#include <ifaddrs.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -358,8 +359,75 @@ class TcpFabric : public Fabric {
         env && *env)
       ring_threshold_bytes_ = static_cast<std::size_t>(std::stoll(env));
     if (world_size > 1) bootstrap(coordinator);
+    // Transport provenance from the CONNECTED peer sockets (not the
+    // coordinator string, which could be a hostname resolving to
+    // loopback): the mesh is loopback only when every peer is THIS
+    // machine — a 127/8 (or ::1) address, or one of this host's own
+    // interface addresses (co-hosted ranks dialing the eth0 IP still
+    // move kernel memory, not wire bytes).  This classifies uniformly
+    // across processes — co-hosted worlds see all-local peers
+    // everywhere, and in any world with a remote host the full mesh
+    // gives EVERY process a remote peer — so the per-process records a
+    // multi-host merge compares always agree.
+    for (int fd : fds_)
+      if (fd >= 0 && !fd_peer_is_local(fd)) {
+        loopback_ = false;
+        break;
+      }
     for (int r = 0; r < world_; ++r)
       if (r != rank_) start_reader(r);
+  }
+
+  static bool sockaddr_is_loopback(const sockaddr_storage& ss) {
+    if (ss.ss_family == AF_INET) {
+      const auto& a = reinterpret_cast<const sockaddr_in&>(ss);
+      return (ntohl(a.sin_addr.s_addr) >> 24) == 127;
+    }
+    if (ss.ss_family == AF_INET6) {
+      const auto& a6 = reinterpret_cast<const sockaddr_in6&>(ss);
+      if (IN6_IS_ADDR_LOOPBACK(&a6.sin6_addr)) return true;
+      if (IN6_IS_ADDR_V4MAPPED(&a6.sin6_addr))
+        return a6.sin6_addr.s6_addr[12] == 127;  // ::ffff:127.x.y.z
+    }
+    return false;
+  }
+
+  static bool fd_peer_is_local(int fd) {
+    sockaddr_storage ss{};
+    socklen_t len = sizeof ss;
+    if (::getpeername(fd, reinterpret_cast<sockaddr*>(&ss), &len) != 0)
+      return true;  // unknowable: never over-credit as network physics
+    if (sockaddr_is_loopback(ss)) return true;
+    // same-host via a non-loopback interface address: compare against
+    // this machine's own addresses (kernel-routed either way)
+    ifaddrs* ifs = nullptr;
+    bool local = false;
+    if (::getifaddrs(&ifs) == 0) {
+      for (const ifaddrs* i = ifs; i; i = i->ifa_next) {
+        if (!i->ifa_addr || i->ifa_addr->sa_family != ss.ss_family)
+          continue;
+        if (ss.ss_family == AF_INET) {
+          const auto* ia = reinterpret_cast<const sockaddr_in*>(i->ifa_addr);
+          if (ia->sin_addr.s_addr ==
+              reinterpret_cast<const sockaddr_in&>(ss).sin_addr.s_addr) {
+            local = true;
+            break;
+          }
+        } else if (ss.ss_family == AF_INET6) {
+          const auto* ia6 =
+              reinterpret_cast<const sockaddr_in6*>(i->ifa_addr);
+          if (std::memcmp(&ia6->sin6_addr,
+                          &reinterpret_cast<const sockaddr_in6&>(ss)
+                               .sin6_addr,
+                          sizeof(in6_addr)) == 0) {
+            local = true;
+            break;
+          }
+        }
+      }
+      ::freeifaddrs(ifs);
+    }
+    return local;
   }
 
   ~TcpFabric() override {
@@ -375,8 +443,15 @@ class TcpFabric : public Fabric {
     // every waiter — failure would then surface only as a serial
     // cascade of direct-wait desync errors masking the real cause
     // (advisor r4).  Skipping the Bye lets peers see the EOF for what
-    // it is: a death.
-    if (std::uncaught_exceptions() == 0) {
+    // it is: a death.  ``uncaught_exceptions()`` is THREAD-LOCAL: when
+    // the failing rank's exception was caught on another thread (a
+    // launch wrapper storing it to rethrow, a test harness swallowing
+    // it) and the fabric is destroyed later on the main thread, the
+    // count here reads 0 — so the rank-thread exception handlers also
+    // latch the ``dying_`` flag, and a dying fabric never says Bye
+    // regardless of which thread runs the destructor (advisor r5).
+    if (std::uncaught_exceptions() == 0 &&
+        !dying_.load(std::memory_order_acquire)) {
       for (int r = 0; r < world_; ++r) {
         if (r == rank_ || fds_[r] < 0) continue;
         tcp::FrameHeader h{};
@@ -435,9 +510,18 @@ class TcpFabric : public Fabric {
                                              rank_, dtype_, num_slots_, name);
   }
 
+  // The rank is dying mid-run: suppress the clean-departure Bye even if
+  // the destructor later runs on a thread with no in-flight exception.
+  void mark_dying() { dying_.store(true, std::memory_order_release); }
+
   // One process = one rank: body runs once, in this thread.
   void launch(const std::function<void(int)>& body) override {
-    body(rank_);
+    try {
+      body(rank_);
+    } catch (...) {
+      mark_dying();  // fail-fast must survive destruction elsewhere
+      throw;
+    }
   }
 
   std::vector<int> local_ranks() const override { return {rank_}; }
@@ -448,6 +532,10 @@ class TcpFabric : public Fabric {
     meta["device"] = "cpu";
     meta["compute_mode"] = "host_sleep";
     meta["num_processes"] = world_;
+    // loopback sockets move kernel memory at memcpy speed; only the
+    // ethernet classification is network physics (analysis/bandwidth.py
+    // surfaces this as the summary table's `transport` column)
+    meta["transport"] = loopback_ ? "tcp:loopback" : "tcp:ethernet";
     // allreduces at/above this many bytes ride the bandwidth-optimal
     // ring (2(n-1)/n x count on the wire); smaller ones and the
     // gather-style ops use the pairwise full mesh (which for
@@ -466,6 +554,7 @@ class TcpFabric : public Fabric {
   }
 
   std::size_t ring_threshold_bytes() const { return ring_threshold_bytes_; }
+  bool loopback() const { return loopback_; }
 
   // payload+header bytes this process actually wrote to sockets —
   // layered fabrics (hier_fabric.hpp) stamp it into their own records
@@ -682,6 +771,11 @@ class TcpFabric : public Fabric {
   tcp::Inbox inbox_;
   std::atomic<std::uint32_t> next_comm_id_{0};
   std::atomic<bool> closing_{false};
+  // set by the rank-thread exception handlers (launch wrappers here and
+  // in HierFabric): the destructor must not send Bye for a dying rank
+  // even when it runs on a thread whose uncaught_exceptions() is 0
+  std::atomic<bool> dying_{false};
+  bool loopback_ = true;
   std::size_t ring_threshold_bytes_ = 64 * 1024;
   std::atomic<std::uint64_t> bytes_sent_{0};
 };

@@ -12,7 +12,9 @@
 // runs unchanged.
 #pragma once
 
+#include <algorithm>
 #include <chrono>
+#include <cstdint>
 #include <map>
 #include <string>
 #include <vector>
@@ -89,6 +91,35 @@ struct RankReport {
   const TimerSet* timers = nullptr;
 };
 
+// Band summary of one timer array — the Python tier's
+// metrics.stats.summarize mirrored exactly ({value: median, best: min,
+// band: [lo, hi], n}), so records from both tiers self-describe their
+// statistics the same way (schema v2).
+inline Json band_summary(const std::vector<double>& vals) {
+  Json s = Json::object();
+  if (vals.empty()) {
+    s["value"] = 0.0;
+    s["best"] = 0.0;
+    Json band = Json::array();
+    band.push_back(0.0);
+    band.push_back(0.0);
+    s["band"] = band;
+    s["n"] = std::int64_t{0};
+    return s;
+  }
+  std::vector<double> v(vals);
+  std::sort(v.begin(), v.end());
+  const std::size_t n = v.size();
+  s["value"] = n % 2 ? v[n / 2] : (v[n / 2 - 1] + v[n / 2]) / 2.0;
+  s["best"] = v.front();
+  Json band = Json::array();
+  band.push_back(v.front());
+  band.push_back(v.back());
+  s["band"] = band;
+  s["n"] = static_cast<std::int64_t>(n);
+  return s;
+}
+
 // Assemble the run record in the exact schema of the Python tier's
 // metrics.emit.result_to_record (section/version/global/mesh/num_runs/
 // warmup_times/ranks) so one parser serves both tiers.
@@ -98,7 +129,7 @@ inline Json make_record(const std::string& section, const Json& global_meta,
                         const std::vector<RankReport>& ranks) {
   Json rec = Json::object();
   rec["section"] = section;
-  rec["version"] = 1;
+  rec["version"] = 2;
   rec["global"] = global_meta;
   rec["mesh"] = mesh_meta;
   rec["num_runs"] = num_runs;
@@ -114,12 +145,16 @@ inline Json make_record(const std::string& section, const Json& global_meta,
     row["hostname"] = r.hostname;
     if (r.extra.is_object())
       for (const auto& [k, v] : r.extra.fields()) row[k] = v;
-    if (r.timers)
+    if (r.timers) {
+      Json summary = Json::object();
       for (const auto& [name, vals] : r.timers->all()) {
         Json arr = Json::array();
         for (double v : vals) arr.push_back(v);
         row[name] = arr;
+        summary[name] = band_summary(vals);
       }
+      row["summary"] = summary;  // schema v2: stats ride the record
+    }
     rows.push_back(row);
   }
   rec["ranks"] = rows;

@@ -28,3 +28,19 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
     return devs
+
+
+@pytest.fixture(scope="session")
+def native_bin():
+    """ONE shared native build tree for the whole session, whichever
+    lane is running: the default lane and the opt-in ``-m native_slow``
+    heavy lane both resolve (and incrementally rebuild) the same
+    out-of-tree CMake/Ninja tree via utils.native_build, so splitting
+    the suite into lanes never costs a second configure+build."""
+    import shutil
+    from pathlib import Path
+
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        pytest.skip("cmake/ninja not available")
+    from dlnetbench_tpu.utils.native_build import native_bin as _locate
+    return _locate(Path(__file__).resolve().parent.parent)

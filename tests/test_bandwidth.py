@@ -136,6 +136,55 @@ def test_blocked_refusal_keys_on_component_span():
     assert legacy.iloc[0]["bound"] == "fullmesh"
 
 
+def test_transport_column_locked():
+    """Acceptance lock: every bandwidth row and summary row carries the
+    transport provenance column — a stamped record's value verbatim, a
+    legacy record classified from its identity keys — so loopback and
+    virtual-mesh figures can never read as fabric physics."""
+    from dlnetbench_tpu.analysis.bandwidth import transport_of
+
+    # stamped (schema v2 / current native): verbatim
+    stamped = _record({"comm_time": [
+        {"kind": "allreduce", "group": 2, "bytes": 2000}]},
+        {"comm_time": [2.0]})
+    stamped["global"]["transport"] = "tcp:loopback"
+    bw = effective_bandwidth([stamped])
+    assert (bw["transport"] == "tcp:loopback").all()
+    s = bandwidth_summary([stamped])
+    assert "transport" in s.columns
+    assert (s["transport"] == "tcp:loopback").all()
+
+    # legacy classification paths (records that predate the stamp)
+    assert transport_of({"global": {"backend": "shm"}}) == "shm"
+    assert transport_of({"global": {"backend": "tcp"}}) == "tcp"
+    assert transport_of({"global": {"backend": "pjrt",
+                                    "pjrt_executor": "host"}}) == "host"
+    assert transport_of({"global": {"backend": "pjrt",
+                                    "pjrt_executor": "tpu"}}) == "ici"
+    assert transport_of({"global": {"backend": "pjrt",
+                                    "pjrt_executor": "host",
+                                    "dcn_transport": "tcp"}}) == "host+tcp"
+    assert transport_of({"global": {},
+                         "mesh": {"platform": "cpu"}}) == "virtual-host"
+    assert transport_of({"global": {},
+                         "mesh": {"platform": "tpu"}}) == "ici"
+    # a legacy multi-host TPU record's collectives have a DCN leg: the
+    # fallback must mirror emit.transport_label, not flatten to ici
+    assert transport_of({"global": {},
+                         "mesh": {"platform": "tpu",
+                                  "num_hosts": 4}}) == "ici+dcn"
+    assert transport_of({"global": {}}) == "unknown"
+
+    # two transports never average into one summary row
+    other = _record({"comm_time": [
+        {"kind": "allreduce", "group": 2, "bytes": 2000}]},
+        {"comm_time": [4.0]})
+    other["global"]["transport"] = "tcp:ethernet"
+    s2 = bandwidth_summary([stamped, other])
+    assert len(s2) == 2
+    assert set(s2["transport"]) == {"tcp:loopback", "tcp:ethernet"}
+
+
 def test_zero_time_and_missing_model_skipped():
     rec = _record({"barrier_time": [
         {"kind": "allreduce", "group": 8, "bytes": 100}]},

@@ -1,0 +1,56 @@
+"""utils/native_build.py build-dir claim: the concurrent-wipe retry
+(advisor r5).  A racing claimer can wipe the directory between our
+mkdir's FileExistsError and the stat — _claim must restart the whole
+mkdir/stat/tighten sequence instead of surfacing FileNotFoundError, and
+must give up with a diagnostic once the attempts are exhausted.
+
+(The permission-discipline cases live in test_native.py; these run
+without cmake/ninja since _claim touches only the filesystem.)
+"""
+from __future__ import annotations
+
+import pytest
+
+from dlnetbench_tpu.utils.native_build import _claim
+
+
+class _FlakyDir:
+    """Path stand-in emulating a concurrent claimer that wins the first
+    ``wipes`` rounds: mkdir sees the dir exist, stat sees it already
+    wiped.  After that the real directory claims cleanly."""
+
+    def __init__(self, real, wipes: int):
+        self.real = real
+        self.wipes = wipes
+        self.attempt = 0
+
+    def mkdir(self, mode):
+        self.attempt += 1
+        if self.attempt <= self.wipes:
+            raise FileExistsError(self)  # the racer holds it...
+        self.real.mkdir(mode=mode)
+
+    def stat(self):
+        if self.attempt <= self.wipes:
+            raise FileNotFoundError(self)  # ...and wiped it under us
+        return self.real.stat()
+
+    def chmod(self, mode):
+        self.real.chmod(mode)
+
+    def __fspath__(self):  # shutil.rmtree compatibility
+        return str(self.real)
+
+
+def test_claim_retries_after_concurrent_wipe(tmp_path):
+    target = tmp_path / "bld"
+    _claim(_FlakyDir(target, wipes=2))
+    assert target.is_dir()
+    assert (target.stat().st_mode & 0o777) == 0o700
+
+
+def test_claim_gives_up_after_bounded_attempts(tmp_path):
+    flaky = _FlakyDir(tmp_path / "never", wipes=10**9)
+    with pytest.raises(RuntimeError, match="could not claim"):
+        _claim(flaky, attempts=3)
+    assert flaky.attempt == 3  # bounded, not an infinite spin

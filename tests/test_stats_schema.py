@@ -1,0 +1,235 @@
+"""Artifact-grade stat bands (metrics/stats.py) and record schema v2
+(metrics/emit.py): band summaries ride every timer, transport provenance
+rides every record, and committed v1 records keep parsing/merging.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from dlnetbench_tpu.metrics.stats import flag_low_mode, summarize
+
+V1_FIXTURE = Path(__file__).parent / "data" / "record_v1.jsonl"
+
+
+# ---------------------------------------------------------------------
+# stats.summarize / flag_low_mode
+
+
+def test_summarize_band_shape():
+    s = summarize([3.0, 1.0, 2.0])
+    assert s == {"value": 2.0, "best": 1.0, "band": [1.0, 3.0], "n": 3}
+
+
+def test_summarize_empty_and_rounding():
+    assert summarize([]) == {"value": 0.0, "best": 0.0,
+                             "band": [0.0, 0.0], "n": 0}
+    s = summarize([1.23456, 2.34567], ndigits=2)
+    assert s["value"] == pytest.approx(1.79)
+    assert s["band"] == [1.23, 2.35]
+
+
+def test_flag_low_mode_flags_bimodal():
+    line = flag_low_mode({"value": 100.0, "best": 40.0, "n": 3})
+    assert "bimodal" in line["note"]
+    # appends to an existing note (the above-peak flag) instead of
+    # clobbering it
+    line2 = flag_low_mode({"value": 100.0, "best": 40.0, "n": 3,
+                           "note": "above-peak reading"})
+    assert line2["note"].startswith("above-peak reading; ")
+
+
+def test_flag_low_mode_leaves_unimodal_and_tiny_n_alone():
+    assert "note" not in flag_low_mode({"value": 100.0, "best": 85.0,
+                                        "n": 3})
+    # n=1 can't witness bimodality; absent best can't either
+    assert "note" not in flag_low_mode({"value": 100.0, "best": 10.0,
+                                        "n": 1})
+    assert "note" not in flag_low_mode({"value": 100.0})
+
+
+def test_bench_band_helpers():
+    import bench
+
+    s = {"value": 0.002, "best": 0.001, "band": [0.001, 0.003], "n": 3}
+    ms = bench._band_ms(s)
+    assert ms == {"best": 1.0, "band": [1.0, 3.0], "n": 3}
+    comb = bench._combine_linear([(2, s), (1, s)])
+    assert comb["value"] == pytest.approx(0.006)
+    assert comb["best"] == pytest.approx(0.003)
+    assert comb["band"][1] == pytest.approx(0.009)
+    assert comb["n"] == 3
+
+
+# ---------------------------------------------------------------------
+# schema v2 emission
+
+
+def _fake_result(timers=None, mesh=None):
+    from dlnetbench_tpu.proxies.base import ProxyResult
+
+    mesh = mesh if mesh is not None else {
+        "platform": "cpu", "device_kind": "host", "num_hosts": 1,
+        "devices": [{"id": 0, "process": 0}, {"id": 1, "process": 0}]}
+    return ProxyResult(
+        name="dp",
+        global_meta={"proxy": "dp", "model": "m", "world_size": 2,
+                     "mesh": mesh},
+        timers_us=timers or {"runtimes": [100.0, 50.0, 110.0],
+                             "barrier_time": [10.0, 11.0, 12.0]},
+        warmup_times_us=[900.0],
+        num_runs=3,
+    )
+
+
+def test_v2_record_carries_summaries_and_transport():
+    from dlnetbench_tpu.metrics.emit import SCHEMA_VERSION, result_to_record
+    from dlnetbench_tpu.metrics.parser import validate_record
+
+    assert SCHEMA_VERSION == 2
+    rec = result_to_record(_fake_result())
+    assert rec["version"] == 2
+    assert rec["global"]["transport"] == "virtual-host"
+    for row in rec["ranks"]:
+        s = row["summary"]["runtimes"]
+        assert s["value"] == 100.0 and s["best"] == 50.0
+        assert s["band"] == [50.0, 110.0] and s["n"] == 3
+        assert row["summary"]["barrier_time"]["n"] == 3
+    validate_record(rec)
+    # the record is json-serializable as emitted
+    json.dumps(rec)
+
+
+def test_transport_label_tiers():
+    from dlnetbench_tpu.metrics.emit import transport_label
+
+    assert transport_label({"platform": "cpu"}) == "virtual-host"
+    assert transport_label({"platform": "tpu", "num_hosts": 1}) == "ici"
+    assert transport_label({"platform": "tpu",
+                            "num_hosts": 4}) == "ici+dcn"
+    assert transport_label({}) == "unknown"
+
+
+def test_v2_summary_must_match_samples():
+    from dlnetbench_tpu.metrics.emit import result_to_record
+    from dlnetbench_tpu.metrics.parser import validate_record
+
+    rec = result_to_record(_fake_result())
+    rec["ranks"][0]["summary"]["runtimes"]["n"] = 99
+    with pytest.raises(ValueError, match="claims n=99"):
+        validate_record(rec)
+
+
+def test_presstamped_transport_wins():
+    from dlnetbench_tpu.metrics.emit import result_to_record
+
+    r = _fake_result()
+    r.global_meta["transport"] = "tcp:ethernet"
+    assert result_to_record(r)["global"]["transport"] == "tcp:ethernet"
+
+
+# ---------------------------------------------------------------------
+# v1 backward compatibility — the committed fixture must keep parsing
+# through every consumer for as long as old artifacts exist
+
+
+def test_committed_v1_fixture_still_parses():
+    from dlnetbench_tpu.metrics.parser import (
+        load_records, records_to_dataframe, validate_record)
+
+    recs = load_records(V1_FIXTURE)
+    assert len(recs) == 1 and recs[0]["version"] == 1
+    assert "summary" not in recs[0]["ranks"][0]
+    validate_record(recs[0])
+    df = records_to_dataframe(recs)
+    assert len(df) == 2 * recs[0]["num_runs"]
+    assert (df["runtime"] > 0).all()
+
+
+def test_v1_fixture_flows_through_bandwidth_with_transport():
+    from dlnetbench_tpu.analysis.bandwidth import bandwidth_summary
+    from dlnetbench_tpu.metrics.parser import load_records
+
+    recs = load_records(V1_FIXTURE)
+    summary = bandwidth_summary(recs)
+    assert not summary.empty
+    # no stamped transport: classified from the backend it does declare
+    assert (summary["transport"] == "shm").all()
+
+
+def test_merge_refuses_mixed_schema_versions():
+    from dlnetbench_tpu.metrics.merge import merge_records
+
+    v1 = json.loads(V1_FIXTURE.read_text())
+    v1["global"]["num_processes"] = 2
+    v1["ranks"][1]["process_index"] = 1
+    v2 = json.loads(json.dumps(v1))
+    v2["version"] = 2
+    v2["process"] = 1
+    v2["ranks"][1]["hostname"] = "host1"
+    with pytest.raises(ValueError, match="schema version"):
+        merge_records([v1, v2])
+
+
+def test_v2_summary_dicts_are_per_row():
+    """Dropping a key from one row's summary (the merge energy dedup
+    does exactly this) must not edit sibling rows."""
+    from dlnetbench_tpu.metrics.emit import result_to_record
+
+    rec = result_to_record(_fake_result())
+    del rec["ranks"][0]["summary"]["runtimes"]
+    assert "runtimes" in rec["ranks"][1]["summary"]
+
+
+def test_merge_energy_dedup_strips_summary_too():
+    """Co-hosted processes: the deduped row must lose energy_consumed
+    from BOTH channels — the raw array and the v2 band summary readers
+    are told to consume — while the surviving row keeps both."""
+    from dlnetbench_tpu.metrics.merge import merge_records
+
+    def proc_rec(p):
+        rec = json.loads(V1_FIXTURE.read_text())
+        rec["version"] = 2
+        rec["process"] = p
+        rec["global"]["num_processes"] = 2
+        for i, row in enumerate(rec["ranks"]):
+            row["process_index"] = i
+            row["hostname"] = "samehost"  # co-hosted: one counter
+            row["energy_consumed"] = [5.0 + p, 6.0 + p]
+            row["summary"] = {
+                "runtimes": summarize(row["runtimes"]),
+                "energy_consumed": summarize(row["energy_consumed"]),
+            }
+        return rec
+
+    merged = merge_records([proc_rec(0), proc_rec(1)])
+    keeper, deduped = merged["ranks"]
+    assert "energy_consumed" in keeper
+    assert "energy_consumed" in keeper["summary"]
+    assert "energy_consumed" not in deduped
+    assert "energy_consumed" not in deduped["summary"]
+    assert "runtimes" in deduped["summary"]  # only energy was deduped
+
+
+def test_merge_keeps_v2_summaries_per_process():
+    from dlnetbench_tpu.metrics.merge import merge_records
+
+    def proc_rec(p):
+        rec = json.loads(V1_FIXTURE.read_text())
+        rec["version"] = 2
+        rec["process"] = p
+        rec["global"]["num_processes"] = 2
+        for i, row in enumerate(rec["ranks"]):
+            row["process_index"] = i  # rank i owned by process i
+            row["hostname"] = f"host{i}"
+            row["runtimes"] = [100.0 + 10 * p, 110.0 + 10 * p]
+            row["summary"] = {"runtimes": summarize(row["runtimes"])}
+        return rec
+
+    merged = merge_records([proc_rec(0), proc_rec(1)])
+    assert merged["version"] == 2
+    # each process's rows keep ITS summaries (its own clock's bands)
+    assert merged["ranks"][0]["summary"]["runtimes"]["best"] == 100.0
+    assert merged["ranks"][1]["summary"]["runtimes"]["best"] == 110.0

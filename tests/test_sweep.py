@@ -69,6 +69,27 @@ def test_in_process_mode_calls_cli_directly(monkeypatch):
     assert calls[1][calls[1].index("--num_buckets") + 1] == "4"
 
 
+def test_sweep_emits_one_span_per_point(monkeypatch):
+    """With tracing enabled (the --trace_out path), every grid point is
+    wrapped in a 'sweep-point' span tagged with its axis values, so a
+    traced sweep attributes wall-clock per configuration."""
+    from dlnetbench_tpu import cli
+    from dlnetbench_tpu.metrics import spans
+
+    monkeypatch.setattr(cli, "main", lambda argv: 0)
+    tracer = spans.enable()
+    try:
+        failed = sweep.run_sweep("dp", {"num_buckets": ["2", "4"]},
+                                 ["--model", "m"])
+    finally:
+        spans.disable()
+    assert failed == 0
+    points = [s for s in tracer.spans if s["name"] == "sweep-point"]
+    assert [s["attrs"]["point"] for s in points] == \
+        ["num_buckets=2", "num_buckets=4"]
+    assert all(s["attrs"]["mode"] == "in-process" for s in points)
+
+
 def test_env_axis_forces_subprocess(monkeypatch):
     """env: axes need backend-init-time isolation: auto mode must take
     the subprocess path, and forcing in-process is an error."""
