@@ -8,11 +8,13 @@ uses (reference python/model_stats.py:47-50, re-derived for TPU in
 core/roofline.py).
 
 Prints the auxiliary low-precision JSON lines first — fp8 MLP matmul,
-fp8 swiglu stage-chain, int8 matmul, the end-to-end int8-MLP train
-step, each against the chip's OWN low-precision roofline — and LAST
-the headline train-step line (tail parsers read the final line; the
-auxiliary results also ride inside it as "fp8_mlp" / "fp8_swiglu" /
-"int8_matmul" / "int8_step"):
+fp8 swiglu stage-chain, int8 matmul, the paired fused-vs-composed
+quantized-matmul A/B lines (r6, ops/quantized_matmul.py), the
+end-to-end int8-MLP train step, each against the chip's OWN
+low-precision roofline — and LAST the headline train-step line (tail
+parsers read the final line; the auxiliary results also ride inside it
+as "fp8_mlp" / "fp8_swiglu" / "int8_matmul" / "int8_fused_ab" /
+"fp8_fused_ab" / "int8_step"):
   {"metric": ..., "value": <step ms>, "unit": "ms",
    "best": <fastest round ms>, "band": [lo, hi], "n": <rounds>,
    "vs_baseline": <achieved/roofline, 1.0 = roofline-perfect>, ...}
@@ -41,22 +43,54 @@ from dlnetbench_tpu.metrics import stats as stats_mod
 from dlnetbench_tpu.models.bench_step import BATCH, SEQ, LAYERS, VOCAB
 
 
-def _measure_chain(fn, arg, k: int) -> dict:
-    """AOT compile (core/executor.py: compile time can't leak into the
-    first timed round) + TRUE fence (a device->host transfer — on the
-    tunnel backend block_until_ready only acks dispatch), then the band
-    summary of 3 K-chained rounds in per-iteration SECONDS
-    ({"value": median, "best", "band", "n"} — metrics/stats.py).
-    Shared by every auxiliary bench line so fence/timing fixes happen
-    once.  The carry is donated; the executor rebinds it from the
-    chain output."""
+def _fence_first_leaf(out) -> None:
+    """TRUE fence on a program result of any pytree shape (a
+    device->host transfer — on the tunnel backend block_until_ready
+    only acks dispatch): pull one element of the first leaf."""
+    leaf = jax.tree.leaves(out)[0]
+    first = leaf.reshape(-1)[0] if getattr(leaf, "ndim", 0) else leaf
+    _ = first.item() if hasattr(first, "item") else float(first)
+
+
+def _compile_chain(fn, arg):
+    """AOT compile one chained microbench (core/executor.py: compile
+    time can't leak into the first timed round; the persistent compile
+    cache — DLNB_COMPILE_CACHE_DIR, enabled inside CompiledProgram —
+    makes the known ~300 s multi-large-matmul compile pathology a
+    once-per-cache cost instead of once per run) + warm run + fence.
+    The carry is donated; the executor rebinds it from the chain
+    output."""
     from dlnetbench_tpu.core import executor
-    from dlnetbench_tpu.utils.timing import time_callable
     prog = executor.CompiledProgram(executor.Program(
         fn=fn, args=(arg,), donate_argnums=(0,)))
-    out = prog()  # warm run (already compiled)
-    _ = out[0, 0].item() if hasattr(out[0, 0], "item") else int(out[0, 0])
+    _fence_first_leaf(prog())  # warm run (already compiled)
+    return prog
+
+
+def _measure_chain(fn, arg, k: int) -> dict:
+    """Compile+warm via ``_compile_chain``, then the band summary of 3
+    K-chained rounds in per-iteration SECONDS ({"value": median,
+    "best", "band", "n"} — metrics/stats.py).  Shared by every
+    auxiliary bench line so fence/timing fixes happen once."""
+    from dlnetbench_tpu.utils.timing import time_callable
+    prog = _compile_chain(fn, arg)
     return stats_mod.summarize([t / k for t in time_callable(prog, reps=3)])
+
+
+def _measure_paired(progs: dict, k: int, rounds: int = 3):
+    """The r4-MLP-study pairing protocol (docs/PERF.md r4): within each
+    round every variant is timed back-to-back (adjacent in time), so
+    per-round RATIOS between variants cancel the tunnel's slow drift —
+    the only microbench comparison that carries signal through its
+    ±10-30 % run-to-run noise.  Returns per-variant band summaries (s
+    per iteration) and the raw per-round sample lists for ratio
+    bands."""
+    from dlnetbench_tpu.utils.timing import time_callable
+    times: dict[str, list[float]] = {name: [] for name in progs}
+    for _ in range(rounds):
+        for name, prog in progs.items():
+            times[name].append(time_callable(prog, reps=1)[0] / k)
+    return {n: stats_mod.summarize(ts) for n, ts in times.items()}, times
 
 
 def _band_ms(summary_s: dict) -> dict:
@@ -205,9 +239,20 @@ def _run_bench(args, tracer) -> int:
         return 0  # the skip marker IS the artifact; rc=0 so it parses
 
     from dlnetbench_tpu.core.hardware import HARDWARE
+    from dlnetbench_tpu.core import executor
     from dlnetbench_tpu.core import roofline
     from dlnetbench_tpu.models import bench_step
     from dlnetbench_tpu.utils.timing import time_callable
+
+    # opt into the persistent compile cache (DLNB_COMPILE_CACHE_DIR)
+    # BEFORE the first compile of the run: the multi-large-matmul chains
+    # below are the known ~300 s compile pathology on this toolchain
+    # (PERF.md r4) — with the cache set, that cost is paid once per
+    # cache, not per bench run; the directory is stamped into the
+    # headline so the artifact records warm-vs-cold provenance
+    cache_dir = executor.enable_persistent_cache()
+    if cache_dir:
+        print(f"persistent compile cache: {cache_dir}", file=sys.stderr)
 
     dev = jax.devices()[0]
     # "TPU v5 lite" -> tpu_v5e, "TPU v5p"/"TPU v4"/"TPU v6 lite" likewise
@@ -271,7 +316,6 @@ def _run_bench(args, tracer) -> int:
     # compile_ms, never inside a timed round), params are donated so the
     # optimizer update reuses their buffers in place (aliasing recorded
     # in memory_analysis), and each call rebinds the donated carry
-    from dlnetbench_tpu.core import executor
     train_k = executor.CompiledProgram(executor.Program(
         fn=train_k_fn, args=(params, tokens),
         donate_argnums=bench_step.DONATE_ARGNUMS,
@@ -381,6 +425,10 @@ def _run_bench(args, tracer) -> int:
     fp8_chain = _aux("fp8 swiglu chain", _bench_fp8_swiglu_chain,
                      card, hw_key, dev)
     int8 = _aux("int8 matmul", _bench_int8_matmul, card, hw_key, dev)
+    int8_ab = _aux("int8 fused-quant A/B", _bench_quant_fused_ab,
+                   card, hw_key, dev, "int8")
+    fp8_ab = _aux("fp8 fused-quant A/B", _bench_quant_fused_ab,
+                  card, hw_key, dev, "float8")
     # LAST among the aux lines: they are the most expensive (a full
     # train-step compile+measure each) and the only ones with a known
     # backend-poisoning failure mode (the r5 composed-VJP OOM) —
@@ -413,9 +461,12 @@ def _run_bench(args, tracer) -> int:
         "compile_ms": aot_stats.get("compile_ms"),
         **({"memory_analysis": aot_stats["memory_analysis"]}
            if "memory_analysis" in aot_stats else {}),
+        **({"compile_cache_dir": cache_dir} if cache_dir else {}),
         **({"fp8_mlp": fp8} if fp8 else {}),
         **({"fp8_swiglu": fp8_chain} if fp8_chain else {}),
         **({"int8_matmul": int8} if int8 else {}),
+        **({"int8_fused_ab": int8_ab} if int8_ab else {}),
+        **({"fp8_fused_ab": fp8_ab} if fp8_ab else {}),
         **({"int8_step": int8_step} if int8_step else {}),
         **({"int8_switchback_step": int8_sb} if int8_sb else {}),
     })
@@ -728,6 +779,133 @@ def _bench_int8_matmul(card, hw_key: str, dev) -> dict | None:
         "tops_achieved": round(ops / t_s / 1e12, 2),
     }
     line = stats_mod.flag_low_mode(_flag_above_peak(line))
+    print(json.dumps(line))
+    return line
+
+
+def _ab_line(metric: str, summaries_s: dict, round_times_s: dict,
+             flops_per_iter: int, roofline_s: float) -> dict:
+    """Assemble one paired fused-vs-composed A/B JSON line (pure —
+    tests/test_bench_aux.py locks this schema).  The line's headline
+    ``value`` is the FUSED median (the path under test); every variant
+    ships its own artifact-grade ``{value, best, band, n}`` sub-object
+    in ms, and each non-composed variant a paired per-round ratio band
+    vs composed (ratio < 1.0 = fused faster)."""
+    fused = summaries_s["fused"]
+    line = {
+        "metric": metric,
+        "value": round(fused["value"] * 1e3, 3),
+        "unit": "ms",
+        **_band_ms(fused),
+        "vs_baseline": round(roofline_s / fused["value"], 4),
+        "tflops_fused": round(flops_per_iter / fused["value"] / 1e12, 2),
+        "tflops_composed": round(
+            flops_per_iter / summaries_s["composed"]["value"] / 1e12, 2),
+    }
+    for name, s in summaries_s.items():
+        line[name] = {"value": round(s["value"] * 1e3, 3), **_band_ms(s)}
+    comp_rounds = round_times_s["composed"]
+    for name in summaries_s:
+        if name == "composed":
+            continue
+        ratios = [t / c for t, c in zip(round_times_s[name], comp_rounds)]
+        line[f"ratio_{name}_vs_composed"] = stats_mod.summarize(
+            ratios, ndigits=4)
+    return stats_mod.flag_low_mode(_flag_above_peak(line))
+
+
+def _bench_quant_fused_ab(card, hw_key: str, dev, fmt: str) -> dict | None:
+    """Paired fused-vs-composed quantized-matmul A/B at the bench shape
+    (ISSUE 3 tentpole; protocol = the r4 MLP study's interleaved
+    rounds).  Three variants of the (T,D)@(D,F) up-projection chained
+    K deep:
+
+    * ``composed`` — the shipped XLA recipe (ops/int8.py int8_dot /
+      ops/fp8.py fp8_dot): per-step amax reduction, rescale/cast to a
+      materialized quantized copy, post-matmul sa*sb — each stage its
+      own HBM pass.
+    * ``fused`` — the Pallas kernel (ops/quantized_matmul.py): fresh
+      amax still reduced by XLA (one read of x), but quantization
+      happens in the kernel prologue in VMEM and sa*sb in the
+      epilogue — the quantized activation never exists in HBM.
+    * ``fused_delayed`` — the amax additionally carried through the
+      chain as state (SwitchBack/FP8-recipe delayed scaling): NO
+      amax reduction on the hot path at all.
+
+    The weight-quantization pass is loop-invariant and hoisted by XLA
+    in ALL variants (weights pre-quantized once per chain), so the A/B
+    isolates exactly the per-step activation-quantization overhead."""
+    import jax.numpy as jnp
+
+    from dlnetbench_tpu.core.hardware import BYTES_PER_ELEMENT, HARDWARE
+    from dlnetbench_tpu.ops import quantized_matmul as qmm
+
+    hw = HARDWARE[hw_key]
+    peak_key = "int8" if fmt == "int8" else "float8"
+    label = f"{'int8' if fmt == 'int8' else 'fp8'} fused-quant A/B"
+    try:
+        peak = hw.peak(peak_key)
+    except ValueError:
+        _skipped(f"{label} ({hw_key})", f"{hw_key} has no {peak_key} peak")
+        return None
+
+    if fmt == "int8":
+        from dlnetbench_tpu.ops.int8 import int8_dot as composed_dot
+        fused_dot_op = qmm.int8_dot_fused
+        delayed_op = qmm.int8_dot_fused_delayed
+    else:
+        from dlnetbench_tpu.ops.fp8 import fp8_dot as composed_dot
+        fused_dot_op = qmm.fp8_dot_fused
+        delayed_op = qmm.fp8_dot_fused_delayed
+
+    tokens, d, f = BATCH * SEQ, card.embed_dim, card.ff_dim
+    x = jax.random.normal(jax.random.key(11), (tokens, d), jnp.bfloat16)
+    w = jax.random.normal(jax.random.key(12), (d, f), jnp.bfloat16) * 0.02
+    # K=8 like the fp8 swiglu stages: these are single-matmul scan
+    # bodies, but the fused variants add a Pallas call per step and the
+    # composed fp8 body is the known compile-pathology shape — keep the
+    # per-variant compile bounded (the persistent cache, enabled in
+    # _compile_chain, amortizes re-runs)
+    K = 8
+
+    def chain_of(dot):
+        def chain(x0):
+            def body(xc, _):
+                y = dot(xc, w)
+                # feed a slice back so the dot cannot be loop-hoisted
+                return (xc + y[:, :d] * 1e-6).astype(xc.dtype), ()
+            return jax.lax.scan(body, x0, None, length=K)[0]
+        return chain
+
+    def delayed_chain(carry):
+        def body(c, _):
+            xc, am = c
+            y, am_next = delayed_op(xc, w, am)
+            return ((xc + y[:, :d] * 1e-6).astype(xc.dtype), am_next), ()
+        return jax.lax.scan(body, carry, None, length=K)[0]
+
+    amax0 = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    progs = {
+        "composed": _compile_chain(chain_of(composed_dot), x),
+        "fused": _compile_chain(chain_of(fused_dot_op), x),
+        "fused_delayed": _compile_chain(delayed_chain, (x, amax0)),
+    }
+    summaries, round_times = _measure_paired(progs, K)
+
+    flops = 2 * tokens * d * f
+    # fused-path traffic model: x read once in bf16 (no quantized copy
+    # materialized), pre-quantized weights read, bf16 output written
+    nbytes = int(BYTES_PER_ELEMENT["bfloat16"] * (tokens * d + tokens * f)
+                 + BYTES_PER_ELEMENT[peak_key] * d * f)
+    line = _ab_line(
+        f"{label}: fused-quantization Pallas matmul (VMEM prologue "
+        f"quantize + in-register sa*sb epilogue; fused_delayed carries "
+        f"amax as chain state) vs composed XLA recipe, paired "
+        f"interleaved rounds, {tokens} tok D={d} F={f}, "
+        f"{dev.device_kind} ({hw_key}, {peak_key} peak "
+        f"{peak/1e12:.0f} T/s)",
+        summaries, round_times, flops,
+        _roofline_s(flops, nbytes, hw, peak_key))
     print(json.dumps(line))
     return line
 

@@ -47,8 +47,26 @@ def bench_cfg(card, **overrides):
 def make_train_k(cfg, k: int):
     """K optimizer steps chained in one program: on the tunnel backend
     every dispatch costs ~2-7 ms of host->device latency a real
-    training loop never serializes on; chaining measures the DEVICE."""
+    training loop never serializes on; chaining measures the DEVICE.
+
+    With ``cfg.quant_scaling == "delayed"`` the scan carry is
+    ``(params, qstate)`` — the per-layer amax state rides the chain
+    exactly as it would ride a real training loop, which is the point
+    of delayed scaling (the fresh-amax reduction is off the hot path,
+    its replacement data flows step to step)."""
     from dlnetbench_tpu.models import transformer as tfm
+
+    if tfm.needs_qstate(cfg):
+        def train_k(carry, t):
+            def body(carry, _):
+                p, qs = carry
+                (loss, new_qs), g = jax.value_and_grad(
+                    tfm.loss_fn, has_aux=True)(p, t, cfg, qs)
+                p = jax.tree.map(lambda a, b: a - 1e-3 * b.astype(a.dtype),
+                                 p, g)
+                return (p, new_qs), loss
+            return jax.lax.scan(body, carry, None, length=k)
+        return train_k
 
     def train_k(p, t):
         def body(p, _):
@@ -61,12 +79,16 @@ def make_train_k(cfg, k: int):
 
 
 def build(k: int = 10, **cfg_overrides):
-    """(train_k_fn, params, tokens, card, cfg) at the bench shape."""
+    """(train_k_fn, carry, tokens, card, cfg) at the bench shape; the
+    carry is the params pytree, or ``(params, qstate)`` when the config
+    threads delayed-scaling state (both donate as argument 0)."""
     import jax.numpy as jnp  # noqa: F401  (jax initialized before use)
     from dlnetbench_tpu.models import transformer as tfm
     card = bench_card()
     cfg = bench_cfg(card, **cfg_overrides)
-    params = tfm.init_params(jax.random.key(0), cfg)
+    carry = tfm.init_params(jax.random.key(0), cfg)
+    if tfm.needs_qstate(cfg):
+        carry = (carry, tfm.init_qstate(cfg))
     tokens = jax.random.randint(jax.random.key(1), (BATCH, SEQ + 1), 0,
                                 VOCAB)
-    return make_train_k(cfg, k), params, tokens, card, cfg
+    return make_train_k(cfg, k), carry, tokens, card, cfg

@@ -181,6 +181,53 @@ def _swiglu_split_bwd(res, dy):
 swiglu_split_bwd.defvjp(_swiglu_split_fwd, _swiglu_split_bwd)
 
 
+def quantized_swiglu(x, w_gate, w_up, w_down, *, mlp_dtype: str,
+                     quant_fusion: str = "composed",
+                     int8_backward: str = "master", amax_state=None):
+    """The ONE dispatch point for the low-precision SwiGLU recipes
+    (transformer._block calls this; TransformerConfig validates the
+    combinations):
+
+    * ``quant_fusion="composed"`` — the original XLA paths
+      (ops/int8.py swiglu_int8 / swiglu_int8_sb, ops/fp8.py
+      swiglu_fp8): quantization as separate amax/rescale passes.
+    * ``quant_fusion="fused"`` — the fused-quantization Pallas kernels
+      (ops/quantized_matmul.py): scale application inlined into the
+      matmul prologue/epilogue.
+    * ``amax_state`` (a ``[amax_x, amax_h]`` f32 pair, fused only) —
+      delayed scaling: scales come from the PREVIOUS step's amaxes and
+      the return value is ``(y, new_state)`` instead of ``y``.
+
+    Imports are lazy (ops imports this module's sibling namespace)."""
+    if amax_state is not None and quant_fusion != "fused":
+        # mirror TransformerConfig's validation for direct callers: the
+        # carried amax is a fused-kernel side output — a composed call
+        # handing state would otherwise silently get the fused path
+        raise ValueError(
+            "quantized_swiglu: amax_state (delayed scaling) requires "
+            "quant_fusion='fused'")
+    if mlp_dtype == "int8":
+        from dlnetbench_tpu.ops import int8 as q8
+        if amax_state is not None:
+            return q8.swiglu_int8_fused_delayed(x, w_gate, w_up, w_down,
+                                                amax_state)
+        if quant_fusion == "fused":
+            return q8.swiglu_int8_fused(x, w_gate, w_up, w_down)
+        if int8_backward == "switchback":
+            return q8.swiglu_int8_sb(x, w_gate, w_up, w_down)
+        return q8.swiglu_int8(x, w_gate, w_up, w_down)
+    if mlp_dtype == "float8":
+        from dlnetbench_tpu.ops import fp8 as qf8
+        if amax_state is not None:
+            return qf8.swiglu_fp8_fused_delayed(x, w_gate, w_up, w_down,
+                                                amax_state)
+        if quant_fusion == "fused":
+            return qf8.swiglu_fp8_fused(x, w_gate, w_up, w_down)
+        return qf8.swiglu_fp8(x, w_gate, w_up, w_down)
+    raise ValueError(f"quantized_swiglu: not a quantized mlp_dtype "
+                     f"{mlp_dtype!r}")
+
+
 def gelu_mlp(x, w_in, b_in, w_out, b_out):
     # same bf16-rounding discipline as swiglu: don't let autodiff save
     # the f32 [B, S, ff_dim] pre-activation

@@ -91,6 +91,26 @@ class TransformerConfig:
                              # quantized to int8, dW stays master —
                              # a RECIPE change, opt-in; loss-drift
                              # measured in docs/studies/int8_step_r5
+    quant_fusion: str = "composed" # low-precision MLP matmul impl
+                             # (mlp_dtype float8/int8 only): "composed"
+                             # = quantization as separate XLA passes
+                             # (amax reduce, rescale/cast, post-matmul
+                             # sa*sb — each an HBM round trip);
+                             # "fused" = the Pallas kernels in
+                             # ops/quantized_matmul.py, which quantize
+                             # the activation tile in VMEM and apply
+                             # sa*sb in the epilogue (the r6 attack on
+                             # the fp8 chain's 0.56-of-peak and the
+                             # int8 step's quantization overhead)
+    quant_scaling: str = "dynamic" # "dynamic" = fresh per-tensor amax
+                             # each call; "delayed" (fused only) = the
+                             # amax is CARRIED from the previous step
+                             # as per-layer state threaded through the
+                             # train step (init_qstate/forward's
+                             # qstate arg; SwitchBack / FP8-recipe
+                             # style), so the fresh-amax HBM reduction
+                             # leaves the hot path — scales lag one
+                             # step and saturate on overflow
     mlp_backward: str = "fused"    # SwiGLU backward: "fused" = plain
                              # autodiff (the r4-measured winner);
                              # "split" = pure dots behind barriers
@@ -131,6 +151,26 @@ class TransformerConfig:
             raise ValueError(
                 f"mlp_dtype={self.mlp_dtype!r} currently covers the "
                 f"dense SwiGLU path only")
+        if self.quant_fusion not in ("composed", "fused"):
+            raise ValueError(f"unknown quant_fusion {self.quant_fusion!r}; "
+                             f"expected 'composed' or 'fused'")
+        if self.quant_scaling not in ("dynamic", "delayed"):
+            raise ValueError(
+                f"unknown quant_scaling {self.quant_scaling!r}; "
+                f"expected 'dynamic' or 'delayed'")
+        if self.quant_fusion == "fused" and self.mlp_dtype == "bfloat16":
+            raise ValueError(
+                "quant_fusion='fused' requires mlp_dtype='float8' or "
+                "'int8' (there is nothing to quantize in bf16)")
+        if self.quant_scaling == "delayed" and self.quant_fusion != "fused":
+            raise ValueError(
+                "quant_scaling='delayed' requires quant_fusion='fused' "
+                "(the carried amax is a fused-kernel side output)")
+        if self.quant_fusion == "fused" and self.int8_backward != "master":
+            raise ValueError(
+                "quant_fusion='fused' covers the master-dtype "
+                "(straight-through) backward only; SwitchBack's "
+                "quantized dx dots are a composed-path recipe")
         if self.mlp_backward not in ("split", "fused", "pallas"):
             raise ValueError(f"unknown mlp_backward {self.mlp_backward!r}; "
                              f"expected 'split', 'fused' or 'pallas'")
@@ -176,6 +216,27 @@ class TransformerConfig:
         return jnp.dtype(self.dtype)
 
 
+
+
+def needs_qstate(cfg: TransformerConfig) -> bool:
+    """True when the step must thread delayed-scaling amax state
+    (``init_qstate`` -> ``forward(..., qstate=...)`` ->
+    ``(out, new_qstate)``)."""
+    return cfg.quant_scaling == "delayed"
+
+
+def init_qstate(cfg: TransformerConfig):
+    """Initial delayed-scaling state: per layer ``[amax_x, amax_h]``
+    (gate/up share the x amax; down uses the h amax), f32.
+
+    Initialized to 1.0 — an order-of-magnitude guess for unit-variance
+    bf16 activations, NOT a calibration: the first step quantizes
+    against it (saturating at the format edge if it is low) and emits
+    the true amaxes, so the state self-corrects after one step (the
+    standard delayed-scaling warm-in; arXiv:2209.05433 §4)."""
+    if not needs_qstate(cfg):
+        raise ValueError("init_qstate: cfg.quant_scaling != 'delayed'")
+    return jnp.ones((cfg.num_layers, 2), jnp.float32)
 
 
 def init_params(key, cfg: TransformerConfig) -> dict:
@@ -234,8 +295,10 @@ def init_params(key, cfg: TransformerConfig) -> dict:
     return params
 
 
-def _block(cfg: TransformerConfig, x, lp, positions):
-    """One decoder block; x: [B, S, D], lp: this layer's param slice."""
+def _block(cfg: TransformerConfig, x, lp, positions, qs_row=None):
+    """One decoder block; x: [B, S, D], lp: this layer's param slice.
+    ``qs_row`` is this layer's delayed-scaling amax state (delayed
+    quant only) — when given, returns ``(x, new_qs_row)``."""
     b, s, d = x.shape
     if cfg.gated:
         y = L.rmsnorm(x, lp["norm1"])
@@ -261,15 +324,14 @@ def _block(cfg: TransformerConfig, x, lp, positions):
                      lp["w_gate"], lp["w_up"], lp["w_down"],
                      cfg.top_k).reshape(b, s, d)
         else:
-            if cfg.mlp_dtype == "float8":
-                from dlnetbench_tpu.ops.fp8 import swiglu_fp8
-                mlp_fn = swiglu_fp8
-            elif cfg.mlp_dtype == "int8":
-                from dlnetbench_tpu.ops.int8 import (swiglu_int8,
-                                                     swiglu_int8_sb)
-                mlp_fn = (swiglu_int8_sb
-                          if cfg.int8_backward == "switchback"
-                          else swiglu_int8)
+            new_qs_row = None
+            if cfg.mlp_dtype in ("float8", "int8"):
+                mlp_fn = functools.partial(
+                    L.quantized_swiglu, mlp_dtype=cfg.mlp_dtype,
+                    quant_fusion=cfg.quant_fusion,
+                    int8_backward=cfg.int8_backward)
+                if qs_row is not None:
+                    mlp_fn = functools.partial(mlp_fn, amax_state=qs_row)
             elif cfg.mlp_backward == "pallas":
                 from dlnetbench_tpu.ops.mlp_backward import \
                     swiglu_pallas_bwd
@@ -289,14 +351,27 @@ def _block(cfg: TransformerConfig, x, lp, positions):
                 # intermediates) in backward instead of saving them
                 mlp_fn = jax.checkpoint(mlp_fn)
             y2 = mlp_fn(y, lp["w_gate"], lp["w_up"], lp["w_down"])
+            if qs_row is not None:
+                y2, new_qs_row = y2
     else:
         y = L.layernorm(x, lp["norm2"], lp["norm2_b"])
         y2 = L.gelu_mlp(y, lp["w_in"], lp["b_in"], lp["w_out"], lp["b_out"])
+    if qs_row is not None:
+        return x + y2, new_qs_row
     return x + y2
 
 
-def forward(params: dict, tokens, cfg: TransformerConfig):
-    """tokens [B, S] int32 -> logits [B, S, V]."""
+def forward(params: dict, tokens, cfg: TransformerConfig, qstate=None):
+    """tokens [B, S] int32 -> logits [B, S, V].
+
+    With ``cfg.quant_scaling == "delayed"``, ``qstate`` (the
+    ``init_qstate``-shaped [L, 2] amax carry) is REQUIRED and the
+    return value is ``(logits, new_qstate)`` — the caller threads the
+    new state into the next step."""
+    delayed = needs_qstate(cfg)
+    if delayed and qstate is None:
+        raise ValueError("cfg.quant_scaling='delayed' requires the "
+                         "qstate carry (models.transformer.init_qstate)")
     x = params["embed"][tokens]
     s = tokens.shape[1]
     positions = jnp.arange(s)
@@ -309,26 +384,51 @@ def forward(params: dict, tokens, cfg: TransformerConfig):
                   if cfg.remat_policy == "dots" else None)
         block = jax.checkpoint(_block, static_argnums=(0,), policy=policy)
 
+    new_qstate = None
     if cfg.scan_layers:
-        def body(carry, lp):
-            return block(cfg, carry, lp, positions), None
+        if delayed:
+            def body(carry, xs):
+                lp, qs_row = xs
+                return block(cfg, carry, lp, positions, qs_row)
 
-        x, _ = jax.lax.scan(body, x, params["layers"])
+            x, new_qstate = jax.lax.scan(body, x,
+                                         (params["layers"], qstate))
+        else:
+            def body(carry, lp):
+                return block(cfg, carry, lp, positions), None
+
+            x, _ = jax.lax.scan(body, x, params["layers"])
     else:
+        new_rows = []
         for li in range(cfg.num_layers):
             lp = jax.tree.map(lambda a: a[li], params["layers"])
-            x = block(cfg, x, lp, positions)
+            if delayed:
+                x, row = block(cfg, x, lp, positions, qstate[li])
+                new_rows.append(row)
+            else:
+                x = block(cfg, x, lp, positions)
+        if delayed:
+            new_qstate = jnp.stack(new_rows)
     if cfg.gated:
         x = L.rmsnorm(x, params["final_norm"])
     else:
         x = L.layernorm(x, params["final_norm"], params["final_norm_b"])
     head = params["embed"].T if cfg.tied_embeddings else params["head"]
-    return jnp.dot(x, head,
-                   preferred_element_type=(jnp.float32 if cfg.logits_f32
-                                           else x.dtype))
+    logits = jnp.dot(x, head,
+                     preferred_element_type=(jnp.float32 if cfg.logits_f32
+                                             else x.dtype))
+    if delayed:
+        return logits, new_qstate
+    return logits
 
 
-def loss_fn(params: dict, tokens, cfg: TransformerConfig):
-    """Next-token cross-entropy on a [B, S+1] token batch."""
+def loss_fn(params: dict, tokens, cfg: TransformerConfig, qstate=None):
+    """Next-token cross-entropy on a [B, S+1] token batch.  With
+    delayed quantization scaling the return value is
+    ``(loss, new_qstate)`` (``jax.value_and_grad(..., has_aux=True)``
+    shape — the state is an aux output, not part of the loss)."""
+    if needs_qstate(cfg):
+        logits, new_qstate = forward(params, tokens[:, :-1], cfg, qstate)
+        return L.cross_entropy(logits, tokens[:, 1:]), new_qstate
     logits = forward(params, tokens[:, :-1], cfg)
     return L.cross_entropy(logits, tokens[:, 1:])

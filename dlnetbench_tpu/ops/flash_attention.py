@@ -37,7 +37,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_F32 = jnp.float32
+from dlnetbench_tpu.ops import pallas_common
+
+_F32 = pallas_common.F32
 _LANES = 128                 # TPU lane width; head dim padded to this
 _SUBLANES = 8                # fp32 sublane tile: row vectors (lse, D) are
                              # stored (B, H, 8, S) so blocks are (8, block_q)
@@ -65,13 +67,13 @@ def _compiler_params():
     outer (batch, head, row-block) axes are independent — declaring them
     ``parallel`` lets Mosaic pipeline DMA across grid rows instead of
     treating the whole grid as one sequential chain (measured: the 2048
-    forward blocks are ~1.7x slower without it).  The VMEM cap is raised
-    above the 16 MiB default so 2048-wide blocks keep double-buffering
+    forward blocks are ~1.7x slower without it).  The VMEM cap stays at
+    64 MiB (tighter than the matmul-family default — these kernels hold
+    more live blocks per lane) so 2048-wide blocks keep double-buffering
     headroom on v5e/v5p (128 MiB physical VMEM)."""
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-        vmem_limit_bytes=64 * 1024 * 1024,
-    )
+    return pallas_common.compiler_params(
+        ("parallel", "parallel", "parallel", "arbitrary"),
+        vmem_limit_mb=64)
 
 
 def _pick_block(seq_len: int, candidates=_BLOCK_CANDIDATES) -> int | None:
@@ -91,8 +93,7 @@ def flash_supported(q, k, v) -> bool:
             and hq % hkv == 0)
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+_interpret = pallas_common.interpret_mode
 
 
 def _mask_causal(s, i, j, block_q: int, block_k: int):

@@ -25,10 +25,10 @@ artifact; the remaining gap to peak is quantization overhead.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
+
+from dlnetbench_tpu.ops import quantized_matmul as qmm
 
 _F32 = jnp.float32
 _E4M3_MAX = 448.0      # float8_e4m3fn finite max
@@ -36,12 +36,10 @@ _E4M3_MAX = 448.0      # float8_e4m3fn finite max
 
 def _quantize(x):
     """Per-tensor dynamic scaling to e4m3: returns (x_q, scale) with
-    x ~= x_q * scale.  The scale is clamped away from zero so an
-    all-zero tensor stays representable."""
-    amax = jnp.max(jnp.abs(x.astype(_F32)))
-    scale = jnp.maximum(amax, 1e-12) / _E4M3_MAX
-    xq = (x.astype(_F32) / scale).astype(jnp.float8_e4m3fn)
-    return xq, scale
+    x ~= x_q * scale.  Delegates to the ONE definition in
+    ops/quantized_matmul.py (shared with the fused Pallas kernels, so
+    the fused-vs-composed A/B compares recipes, not scale formulas)."""
+    return qmm.quantize_tensor(x, "float8")
 
 
 @jax.custom_vjp
@@ -60,19 +58,10 @@ def _fp8_dot_fwd(x, w):
     return out.astype(x.dtype), (x, w)
 
 
-def straight_through_dot_bwd(res, g):
-    """Master-dtype backward shared by every quantized dot (fp8, int8 —
-    ops/int8.py imports this): quantization treated as identity, so the
-    gradient matmuls are the plain bf16/f32 ones."""
-    x, w = res
-    gf = g.astype(_F32)
-    dx = jnp.dot(gf, w.astype(_F32).T).astype(x.dtype)
-    # contract all leading (batch) axes of x against g: dw [K, N]
-    lead = tuple(range(x.ndim - 1))
-    dw = jax.lax.dot_general(
-        x.astype(_F32), gf, ((lead, lead), ((), ()))).astype(w.dtype)
-    return dx, dw
-
+# master-dtype backward shared by every quantized dot (fp8, int8 —
+# ops/int8.py imports this name); the definition lives beside the
+# fused kernels in ops/quantized_matmul.py
+straight_through_dot_bwd = qmm.straight_through_dot_bwd
 
 _fp8_dot_bwd = straight_through_dot_bwd
 
@@ -87,3 +76,46 @@ def swiglu_fp8(x, w_gate, w_up, w_down):
     u = fp8_dot(x, w_up)
     h = (jax.nn.silu(g.astype(_F32)) * u.astype(_F32)).astype(g.dtype)
     return fp8_dot(h, w_down)
+
+
+@jax.custom_vjp
+def swiglu_fp8_fused(x, w_gate, w_up, w_down):
+    """SwiGLU with all three matmuls through the fused-quantization
+    Pallas kernel (ops/quantized_matmul.py): per-tensor e4m3 scales
+    applied in the kernel prologue/epilogue instead of as separate XLA
+    passes — the attack on the fp8 chain's 0.56-of-peak quantization
+    overhead (docs/PERF.md r5/r6).  Whole-op custom VJP so the backward
+    recomputes ``h`` instead of saving it (the same residual contract
+    as swiglu_int8); backward is straight-through in the master
+    dtype."""
+    out, _ = qmm.swiglu_fused_fwd_res(x, w_gate, w_up, w_down, "float8")
+    return out
+
+
+def _swiglu_fp8_fused_fwd(x, w_gate, w_up, w_down):
+    return qmm.swiglu_fused_fwd_res(x, w_gate, w_up, w_down, "float8")
+
+
+swiglu_fp8_fused.defvjp(_swiglu_fp8_fused_fwd, qmm.swiglu_master_bwd)
+
+
+@jax.custom_vjp
+def swiglu_fp8_fused_delayed(x, w_gate, w_up, w_down, qs):
+    """Delayed-scaling fused-SwiGLU (e4m3): ``qs`` is this layer's
+    carried ``[amax_x, amax_h]`` f32 state from the PREVIOUS step —
+    the scales come from it, so no fresh-amax HBM reduction runs on
+    the hot path; the kernel emits this step's amaxes as the state for
+    the next step (FP8-recipe delayed scaling, arXiv:2209.05433).
+    Returns ``(y, new_qs)``; the state carries no gradient."""
+    (out, new_qs), _ = qmm.swiglu_fused_delayed_fwd_res(
+        x, w_gate, w_up, w_down, qs, "float8")
+    return out, new_qs
+
+
+def _swiglu_fp8_fused_delayed_fwd(x, w_gate, w_up, w_down, qs):
+    return qmm.swiglu_fused_delayed_fwd_res(
+        x, w_gate, w_up, w_down, qs, "float8")
+
+
+swiglu_fp8_fused_delayed.defvjp(_swiglu_fp8_fused_delayed_fwd,
+                                qmm.swiglu_delayed_master_bwd)

@@ -25,6 +25,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from dlnetbench_tpu.ops import quantized_matmul as qmm
+
 _F32 = jnp.float32
 _QMAX = 127.0
 
@@ -32,11 +34,11 @@ _QMAX = 127.0
 def _quantize(x):
     """Per-tensor symmetric scaling to int8: (x_q, scale) with
     x ~= x_q * scale; the scale is clamped so an all-zero tensor stays
-    representable."""
-    amax = jnp.max(jnp.abs(x.astype(_F32)))
-    scale = jnp.maximum(amax, 1e-12) / _QMAX
-    xq = jnp.clip(jnp.round(x.astype(_F32) / scale), -_QMAX, _QMAX)
-    return xq.astype(jnp.int8), scale
+    representable.  Delegates to the ONE definition in
+    ops/quantized_matmul.py (shared with the fused Pallas kernels,
+    which is what makes the fused-vs-composed int8 results EXACTLY
+    equal, not just close)."""
+    return qmm.quantize_tensor(x, "int8")
 
 
 @jax.custom_vjp
@@ -129,42 +131,66 @@ def _swiglu_int8_fwd(x, w_gate, w_up, w_down):
     return out, (x, g, u, w_gate, w_up, w_down)
 
 
-def _swiglu_bwd_impl(res, dy, act_dot):
-    """Shared SwiGLU backward: ``act_dot(a, b)`` (master-dtype result)
-    runs the three ACTIVATION-GRADIENT matmuls (dh, and the two dx
-    legs) — a plain matmul for the straight-through recipe, the
-    quantized int8 dot for SwitchBack.  Everything else (h recompute
-    instead of save, silu derivative, the three master-dtype dW
-    matmuls) exists ONCE here."""
-    x, g, u, w_gate, w_up, w_down = res
-    gf, uf = g.astype(_F32), u.astype(_F32)
-    silu_g = jax.nn.silu(gf)
-    h = (silu_g * uf).astype(g.dtype)          # recomputed, not saved
-
-    # down projection: activation grad via act_dot, dW in master dtype
-    dh = act_dot(dy, w_down.T).astype(_F32)
-    d_wd = jnp.matmul(h.reshape(-1, h.shape[-1]).T,
-                      dy.reshape(-1, dy.shape[-1])).astype(w_down.dtype)
-
-    # silu(g) * u elementwise backward
-    sg = jax.nn.sigmoid(gf)
-    d_g = (dh * uf * (sg * (1.0 + gf * (1.0 - sg)))).astype(g.dtype)
-    d_u = (dh * silu_g).astype(u.dtype)
-
-    # gate/up projections
-    d_wg = jnp.matmul(x.reshape(-1, x.shape[-1]).T,
-                      d_g.reshape(-1, d_g.shape[-1])).astype(w_gate.dtype)
-    d_wu = jnp.matmul(x.reshape(-1, x.shape[-1]).T,
-                      d_u.reshape(-1, d_u.shape[-1])).astype(w_up.dtype)
-    d_x = (act_dot(d_g, w_gate.T) + act_dot(d_u, w_up.T)).astype(x.dtype)
-    return d_x, d_wg, d_wu, d_wd
+# shared SwiGLU backward — one definition for the composed, fused and
+# SwitchBack recipes, living beside the fused kernels (ops/
+# quantized_matmul.py) so the fp8 fused path uses it without an import
+# cycle; ``act_dot`` selects plain-matmul vs quantized activation-grad
+# dots, everything else (h recompute, silu derivative, master-dtype dW
+# matmuls) exists once there
+_swiglu_bwd_impl = qmm.swiglu_bwd_impl
 
 
-def _swiglu_int8_bwd(res, dy):
-    return _swiglu_bwd_impl(res, dy, jnp.matmul)
+# the master-dtype backward shared with the fp8 swiglus (one
+# definition, ops/quantized_matmul.py)
+_swiglu_int8_bwd = qmm.swiglu_master_bwd
 
 
 swiglu_int8.defvjp(_swiglu_int8_fwd, _swiglu_int8_bwd)
+
+
+@jax.custom_vjp
+def swiglu_int8_fused(x, w_gate, w_up, w_down):
+    """SwiGLU with all three matmuls through the fused-quantization
+    Pallas kernel (ops/quantized_matmul.py): activation quantization in
+    the kernel prologue, int32 MXU accumulation, ``sa*sb`` epilogue
+    in-register — the composed recipe's separate amax/rescale HBM
+    passes are gone and the quantized activation never exists in HBM.
+    Numerically EXACTLY equal to ``swiglu_int8`` (shared scale
+    definition, associative int32 accumulation); same residual
+    contract (``h`` recomputed, not saved) and the same master-dtype
+    straight-through backward."""
+    out, _ = qmm.swiglu_fused_fwd_res(x, w_gate, w_up, w_down, "int8")
+    return out
+
+
+def _swiglu_int8_fused_fwd(x, w_gate, w_up, w_down):
+    return qmm.swiglu_fused_fwd_res(x, w_gate, w_up, w_down, "int8")
+
+
+swiglu_int8_fused.defvjp(_swiglu_int8_fused_fwd, _swiglu_int8_bwd)
+
+
+@jax.custom_vjp
+def swiglu_int8_fused_delayed(x, w_gate, w_up, w_down, qs):
+    """Delayed-scaling fused-SwiGLU (int8): ``qs`` is this layer's
+    carried ``[amax_x, amax_h]`` f32 state from the PREVIOUS step
+    (SwitchBack-style delayed scaling, arXiv:2304.13013) — no
+    fresh-amax HBM reduction on the hot path; the kernel emits this
+    step's amaxes as next-step state.  A stale scale saturates at
+    +-127 and self-corrects the following step.  Returns
+    ``(y, new_qs)``; the state carries no gradient."""
+    (out, new_qs), _ = qmm.swiglu_fused_delayed_fwd_res(
+        x, w_gate, w_up, w_down, qs, "int8")
+    return out, new_qs
+
+
+def _swiglu_int8_fused_delayed_fwd(x, w_gate, w_up, w_down, qs):
+    return qmm.swiglu_fused_delayed_fwd_res(
+        x, w_gate, w_up, w_down, qs, "int8")
+
+
+swiglu_int8_fused_delayed.defvjp(_swiglu_int8_fused_delayed_fwd,
+                                 qmm.swiglu_delayed_master_bwd)
 
 
 @jax.custom_vjp

@@ -26,23 +26,17 @@ because the rebuild executes the real compute tier.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_F32 = jnp.float32
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _compiler_params(sem):
-    return pltpu.CompilerParams(dimension_semantics=sem,
-                                vmem_limit_bytes=100 * 1024 * 1024)
+from dlnetbench_tpu.ops.pallas_common import (
+    F32 as _F32,
+    compiler_params as _compiler_params,
+    fit_block,
+    interpret_mode as _interpret,
+)
 
 
 def _silu_parts(g_f32):
@@ -73,10 +67,8 @@ def dgdu(dy, wd, g, u, *, block_m: int = 1024, block_n: int = 2048):
     """
     t, d = dy.shape
     f = wd.shape[0]
-    while t % block_m:
-        block_m //= 2
-    while f % block_n:
-        block_n //= 2
+    block_m = fit_block(t, block_m)
+    block_n = fit_block(f, block_n)
     grid = (t // block_m, f // block_n)
     return pl.pallas_call(
         _dgdu_kernel,
@@ -132,12 +124,9 @@ def dwd(g, u, dy, *, block_f: int = 2048, block_d: int = 2048,
     """dWd [F, D] = h^T @ dy with h = silu(g) * u recomputed per tile."""
     t, f = g.shape
     d = dy.shape[1]
-    while f % block_f:
-        block_f //= 2
-    while d % block_d:
-        block_d //= 2
-    while t % block_k:
-        block_k //= 2
+    block_f = fit_block(f, block_f)
+    block_d = fit_block(d, block_d)
+    block_k = fit_block(t, block_k)
     grid = (f // block_f, d // block_d, t // block_k)
     return pl.pallas_call(
         _dwd_kernel,

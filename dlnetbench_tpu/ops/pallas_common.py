@@ -1,0 +1,66 @@
+"""Shared shims for every Pallas TPU kernel in ops/.
+
+Before this module existed, ``_interpret()``, ``_compiler_params`` and
+the fp32 constant were copy-pasted per kernel file (``mlp_backward.py``,
+``flash_attention.py``); a fix to any of them (e.g. the interpret-mode
+gate growing a force-override for debugging) had to be applied N times.
+Everything here is the single definition the kernel files import.
+
+The reference has no kernels at all (its compute tier is roofline
+``usleep``); this module exists because the rebuild's real-compute tier
+keeps growing Pallas kernels and they must all make the same
+backend/VMEM decisions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+# jax renamed TPUCompilerParams -> CompilerParams after 0.4.x; the
+# fields are identical.  Resolving it HERE (the one shim module) is
+# what turned the seed's 16 "Pallas-on-CPU" tier-1 failures — every
+# kernel file AttributeError-ing on the new name under jax 0.4.37 —
+# into passes (same spirit as utils/jax_compat.py for shard_map).
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+# fp32: the accumulation / epilogue dtype of every kernel (MXU
+# accumulators, online-softmax state, quantization scales)
+F32 = jnp.float32
+
+# Default Mosaic VMEM cap for the matmul-family kernels: raised above
+# the 16 MiB default so 1-2k-wide blocks keep double-buffering headroom
+# on v5e/v5p (128 MiB physical VMEM).  flash_attention uses a tighter
+# 64 MiB cap (its three kernels hold more live blocks per lane).
+DEFAULT_VMEM_LIMIT_MB = 100
+
+
+def interpret_mode() -> bool:
+    """True when Pallas kernels must run under ``interpret=True`` — any
+    non-TPU backend, which is how the CPU-mesh tier-1 lane unit-tests
+    every kernel without hardware."""
+    return jax.default_backend() != "tpu"
+
+
+def compiler_params(dimension_semantics,
+                    vmem_limit_mb: int = DEFAULT_VMEM_LIMIT_MB):
+    """Mosaic params shared by the kernels: per-kernel dimension
+    semantics (``"parallel"`` outer axes let Mosaic pipeline DMA across
+    grid rows; accumulator-carrying minor axes must be
+    ``"arbitrary"``), VMEM cap in MiB."""
+    return _CompilerParams(
+        dimension_semantics=tuple(dimension_semantics),
+        vmem_limit_bytes=vmem_limit_mb * 1024 * 1024)
+
+
+def fit_block(dim: int, block: int) -> int:
+    """Largest power-of-two-halving of ``block`` that divides ``dim`` —
+    the block-shrinking idiom every matmul-family wrapper used inline
+    (``while dim % block: block //= 2``).  Raises if even block=1 does
+    not divide (dim <= 0)."""
+    if dim <= 0:
+        raise ValueError(f"fit_block: non-positive dim {dim}")
+    while dim % block:
+        block //= 2
+    return block

@@ -39,6 +39,59 @@ def test_above_peak_readings_are_flagged():
     assert "note" not in ok
 
 
+def test_ab_line_schema_locked():
+    """The fused-vs-composed A/B lines are BENCH artifacts (VERDICT r5
+    top_next: aux results must appear in BENCH, not just session logs)
+    — lock the artifact-grade stat-band schema: headline
+    {value, unit, best, band, n}, one {value, best, band, n} sub-object
+    per variant, and a paired per-round ratio band per non-composed
+    variant."""
+    import bench
+
+    summaries = {
+        "composed": {"value": 2.0, "best": 1.9, "band": [1.9, 2.2], "n": 3},
+        "fused": {"value": 1.0, "best": 0.9, "band": [0.9, 1.2], "n": 3},
+        "fused_delayed": {"value": 0.8, "best": 0.7, "band": [0.7, 0.9],
+                          "n": 3},
+    }
+    rounds = {"composed": [2.0, 1.9, 2.2], "fused": [1.0, 0.9, 1.2],
+              "fused_delayed": [0.8, 0.7, 0.9]}
+    line = bench._ab_line("int8 fused-quant A/B (test)", summaries,
+                          rounds, flops_per_iter=10 ** 12,
+                          roofline_s=0.5)
+    # headline band schema in ms
+    assert line["unit"] == "ms"
+    for key in ("value", "best", "band", "n"):
+        assert key in line, key
+    assert line["value"] == 1000.0 and line["n"] == 3
+    assert line["band"] == [900.0, 1200.0]
+    # per-variant sub-objects carry the same band schema
+    for name in summaries:
+        sub = line[name]
+        for key in ("value", "best", "band", "n"):
+            assert key in sub, (name, key)
+        assert len(sub["band"]) == 2
+    # paired ratio bands, fused vs composed pairing per round
+    r = line["ratio_fused_vs_composed"]
+    for key in ("value", "best", "band", "n"):
+        assert key in r, key
+    assert r["value"] == 0.5 and r["n"] == 3
+    assert "ratio_fused_delayed_vs_composed" in line
+    assert "ratio_composed_vs_composed" not in line
+    # roofline ratio rides along (and the above-peak guard applies)
+    assert line["vs_baseline"] == 0.5
+
+
+def test_band_ms_schema():
+    """Every aux line builds its band keys through _band_ms — lock the
+    seconds->ms conversion and key set."""
+    import bench
+
+    got = bench._band_ms({"value": 0.0021, "best": 0.002,
+                          "band": [0.002, 0.0025], "n": 3})
+    assert got == {"best": 2.0, "band": [2.0, 2.5], "n": 3}
+
+
 def test_aux_deadline_skips_instead_of_running(capsys, monkeypatch):
     """Past the wall-clock deadline the aux fn must not even start —
     the headline line takes precedence over auxiliary coverage."""

@@ -178,7 +178,18 @@ def test_barrier_time_uses_matched_compute_samples():
     res = run_proxy("t", bundle, cfg)
     barrier_ms = [t / 1000 for t in res.timers_us["barrier_time"]]
     assert len(barrier_ms) == 3
-    for b in barrier_ms:
-        assert 1.0 < b < 6.0, (
-            f"barrier_time {barrier_ms} — matched samples give ~2 ms each; "
-            "a spread like [0, 2, 12] means a mean-compute subtraction")
+    # The mean-subtraction bug's signature is the SPREAD ([0, 2, 12]:
+    # the per-run drift leaks in, blowing the top sample far past the
+    # matched ~2 ms), so the top sample and the median carry the guard.
+    # A single low sample is tolerated: under whole-suite host load a
+    # sleep pair can inflate unevenly and one matched difference clamps
+    # to ~0 (observed flake [0.0, 2.0, 2.8] on the loaded 2-core host).
+    assert max(barrier_ms) < 6.0, (
+        f"barrier_time {barrier_ms} — matched samples give ~2 ms each; "
+        "a spread like [0, 2, 12] means a mean-compute subtraction")
+    import statistics as _stats
+    assert 1.0 < _stats.median(barrier_ms) < 6.0, (
+        f"barrier_time {barrier_ms} — matched samples give ~2 ms each")
+    assert sum(1 for b in barrier_ms if b <= 1.0) <= 1, (
+        f"barrier_time {barrier_ms} — more than one collapsed sample is "
+        "a subtraction bug, not host jitter")

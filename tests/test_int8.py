@@ -99,6 +99,50 @@ def test_swiglu_int8_fused_vjp_matches_composed():
                             atol=1e-3, rtol=1e-3), name
 
 
+def test_swiglu_int8_residual_contract_no_hidden_h():
+    """The r5 OOM fix's CONTRACT, pinned (ISSUE 3 satellite): the fused
+    whole-SwiGLU VJP must save exactly TWO [T, F] residuals (the g/u
+    pre-activations — the same set the bf16 path saves) and NOT the
+    hidden ``h = silu(g)*u``, which is what made the composed int8_dot
+    form OOM at the no-remat bench shape (345 MB/layer it re-saves as
+    the down-projection residual).  ``jax.vjp``'s returned function is
+    a pytree whose leaves ARE the saved residuals, so the contract is
+    directly observable in interpret/CPU mode; the composed form is
+    measured alongside to prove the counter distinguishes them."""
+    t, d, f = 48, 32, 40
+    x = jax.random.normal(jax.random.key(30), (t, d), jnp.bfloat16)
+    wg = jax.random.normal(jax.random.key(31), (d, f), jnp.bfloat16) * 0.1
+    wu = jax.random.normal(jax.random.key(32), (d, f), jnp.bfloat16) * 0.1
+    wd = jax.random.normal(jax.random.key(33), (f, d), jnp.bfloat16) * 0.1
+
+    def composed(x, wg, wu, wd):
+        g = int8_dot(x, wg)
+        u = int8_dot(x, wu)
+        h = (jax.nn.silu(g.astype(jnp.float32))
+             * u.astype(jnp.float32)).astype(g.dtype)
+        return int8_dot(h, wd)
+
+    def tf_residuals(fn):
+        out, vjp = jax.vjp(fn, x, wg, wu, wd)
+        return out, vjp, sum(1 for l in jax.tree.leaves(vjp)
+                             if getattr(l, "shape", None) == (t, f))
+
+    out_f, vjp_f, n_fused = tf_residuals(swiglu_int8)
+    out_c, vjp_c, n_comp = tf_residuals(composed)
+    assert n_fused == 2, f"fused VJP saves {n_fused} [T,F] residuals " \
+                         f"(expected exactly g and u — h must be " \
+                         f"recomputed, not saved)"
+    assert n_comp > n_fused, "composed form no longer materializes h; " \
+                             "the contract test lost its control"
+    # and the recompute-instead-of-save backward matches the composed
+    # gradients to tolerance (identical math, different residual plan)
+    cot = jax.random.normal(jax.random.key(34), out_f.shape, out_f.dtype)
+    for a, b, name in zip(vjp_f(cot), vjp_c(cot),
+                          ("dx", "dwg", "dwu", "dwd")):
+        assert jnp.allclose(a.astype(jnp.float32), b.astype(jnp.float32),
+                            atol=1e-3, rtol=1e-3), name
+
+
 def test_flash_bwd_blocks_override_fails_loud(monkeypatch):
     """The sweep env knob must raise on malformed strings and
     non-divisor blocks — a truncated grid would silently compute wrong
