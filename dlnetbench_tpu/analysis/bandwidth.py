@@ -81,6 +81,11 @@ def effective_bandwidth(records: list[dict]):
             continue
         transport = transport_of(rec)
         for rank_row in rec.get("ranks", []):
+            # measured comm–compute overlap fraction (schema v2+,
+            # proxies/base.py): one dimensionless sample per run, riding
+            # every bandwidth row of that run so the summary can say how
+            # much of the declared traffic was actually hidden
+            ov = rank_row.get("overlap_fraction")
             for timer, components in model.items():
                 times = rank_row.get(timer)
                 if not times:
@@ -163,19 +168,26 @@ def effective_bandwidth(records: list[dict]):
                                        / 1e9),
                         "bound": bound,
                         "transport": transport,
+                        "overlap": (float(ov[run])
+                                    if ov is not None and run < len(ov)
+                                    else float("nan")),
                     })
     return pd.DataFrame(rows)
 
 
 def bandwidth_summary(records: list[dict]):
     """Mean per (section, model, collective): the north-star table.
-    Carries the ``bound`` marker so lower-bound rows stay labeled, and
-    the ``transport`` provenance so a loopback/virtual-mesh mean can
-    never be averaged into (or mistaken for) a fabric figure."""
+    Carries the ``bound`` marker so lower-bound rows stay labeled, the
+    ``transport`` provenance so a loopback/virtual-mesh mean can never
+    be averaged into (or mistaken for) a fabric figure, and the mean
+    measured ``overlap`` fraction (NaN where the record's run didn't
+    measure the A/B decomposition) so every bandwidth figure says how
+    much of that traffic compute actually hid."""
     bw = effective_bandwidth(records)
     if bw.empty:
         return bw
     return (bw.groupby(["section", "model", "collective", "group_size",
                         "bound", "transport"])
-            [["time_us", "msg_bytes", "algbw_GBps", "busbw_GBps"]]
+            [["time_us", "msg_bytes", "algbw_GBps", "busbw_GBps",
+              "overlap"]]
             .mean().reset_index())

@@ -56,6 +56,33 @@ def summarize(samples: list[float], ndigits: int | None = None) -> dict:
     return out
 
 
+def overlap_fraction(full, compute, comm) -> list[float]:
+    """Measured communication–compute overlap from the A/B decomposition
+    (proxies/base.py: full / compute-only / comm-only variants), per
+    matched sample:
+
+        overlap_i = (Tc_i + Tm_i - T_both_i) / min(Tc_i, Tm_i)
+
+    1.0 = the shorter leg is fully hidden behind the longer; 0.0 = fully
+    serialized (T_both = Tc + Tm); negative = interference (running
+    together is SLOWER than back-to-back — contention for the same
+    HBM/ICI resources).  Values are not clamped: an out-of-[0, 1]
+    reading is a measurement statement, and the band convention
+    (``summarize``) is how it ships.  Samples whose min leg is ~0 —
+    below 0.1% of the largest leg, e.g. a time_chain sample nearly
+    cancelled by the RTT subtraction — yield 0.0 (nothing to hide; an
+    unbounded ratio from a degenerate denominator must never dominate a
+    summary mean)."""
+    out = []
+    for f, c, m in zip(full, compute, comm):
+        denom = min(c, m)
+        if denom <= 0 or denom <= 1e-3 * max(f, c, m):
+            out.append(0.0)
+        else:
+            out.append((c + m - f) / denom)
+    return out
+
+
 def flag_low_mode(line: dict, ratio: float = LOW_MODE_RATIO) -> dict:
     """Annotate a summary-carrying dict whose samples straddle two modes.
 

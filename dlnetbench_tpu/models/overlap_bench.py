@@ -1,0 +1,153 @@
+"""Paired overlap-vs-baseline A/B of the SPMD train step.
+
+The measurement half of the r7 overlap work (ISSUE 4): build the real
+dp x pp x tp training step twice — baseline (blocking psum TP schedule +
+monolithic grad sync) and overlapped (``tp_overlap="decomposed"`` +
+``grad_sync="bucketed"``) — each in the three A/B decomposition variants
+(``models/spmd.py`` full / compute / comm), then time all six programs in
+interleaved rounds (the r4 pairing protocol: adjacent in time, so ratios
+cancel drift) and report
+
+* wall time per config with artifact-grade ``{value, best, band, n}``
+  stat bands (metrics/stats.py),
+* the paired per-round ratio band (ratio < 1.0 = overlap wins), and
+* the **measured overlap fraction** per config
+  (``metrics/stats.overlap_fraction``: (Tc + Tm - T_both)/min(Tc, Tm))
+  — the number the decomposition exists to move.
+
+Used by ``bench.py`` (real chips, >= 2 devices) and the multichip
+driver's dryrun (virtual 8-CPU mesh — scheduling-level signal only, the
+transport is loopback).  ``assemble_line`` is pure so the JSON schema is
+locked by tests without building a mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+
+from dlnetbench_tpu.metrics import stats as stats_mod
+
+
+def assemble_line(metric: str, walls_s: dict[str, list[float]],
+                  overlaps: dict[str, list[float]]) -> dict:
+    """One paired overlap-vs-baseline JSON line (pure — the schema is
+    locked by tests/test_bench_aux.py).
+
+    ``walls_s``: per-round full-step seconds for "baseline" and
+    "overlapped"; ``overlaps``: per-round measured overlap fractions for
+    the same two configs.  The headline ``value`` is the OVERLAPPED
+    median (the path under test); the baseline ships as a sub-object and
+    the per-round ratio band pairs them."""
+    summaries = {name: stats_mod.summarize(ts) for name, ts in
+                 walls_s.items()}
+    over = summaries["overlapped"]
+    line = {
+        "metric": metric,
+        "value": round(over["value"] * 1e3, 3),
+        "unit": "ms",
+        "best": round(over["best"] * 1e3, 3),
+        "band": [round(v * 1e3, 3) for v in over["band"]],
+        "n": over["n"],
+    }
+    for name, s in summaries.items():
+        line[name] = {
+            "value": round(s["value"] * 1e3, 3),
+            "best": round(s["best"] * 1e3, 3),
+            "band": [round(v * 1e3, 3) for v in s["band"]],
+            "n": s["n"],
+        }
+    # a 0.0 baseline wall (a time_chain sample fully cancelled by the
+    # RTT subtraction) makes the pair meaningless — drop it rather than
+    # shipping an unbounded ratio; n on the band says how many survived
+    ratios = [o / b for o, b in zip(walls_s["overlapped"],
+                                    walls_s["baseline"]) if b > 0]
+    line["ratio_overlapped_vs_baseline"] = stats_mod.summarize(ratios,
+                                                               ndigits=4)
+    line["overlap_fraction"] = {
+        name: stats_mod.summarize(vals, ndigits=4)
+        for name, vals in overlaps.items()}
+    return stats_mod.flag_low_mode(line)
+
+
+def _mesh_desc(mesh) -> str:
+    return "x".join(f"{a}={s}" for a, s in
+                    zip(mesh.axis_names, mesh.devices.shape))
+
+
+def build_programs(n_devices: int | None = None, devices=None,
+                   cfg_kwargs: dict | None = None):
+    """(mesh, cfgs, programs, params, tokens): six jitted step programs —
+    {config: {variant: fn(params, tokens)}} for the baseline and
+    overlapped configs in all three A/B variants, on one mesh."""
+    from dlnetbench_tpu.models import spmd
+
+    devices = devices if devices is not None else jax.devices()
+    n = n_devices or len(devices)
+    dp, pp, tp = spmd.factor_mesh(n)
+    from dlnetbench_tpu.parallel.mesh import make_grid_mesh
+    mesh = make_grid_mesh(dp=dp, pp=pp, tp=tp, devices=devices[:n])
+    kw = dict(cfg_kwargs or {})
+    kw.setdefault("batch", dp * 2 * 2)
+    kw.setdefault("num_microbatches", 2)
+    base = spmd.SpmdConfig(tp_overlap="none", grad_sync="monolithic", **kw)
+    over = dataclasses.replace(base, tp_overlap="decomposed",
+                               grad_sync="bucketed")
+    cfgs = {"baseline": base, "overlapped": over}
+    programs = {name: {v: spmd.make_train_step(mesh, cfg, variant=v)
+                       for v in spmd.VARIANTS}
+                for name, cfg in cfgs.items()}
+    params = spmd.init_params(jax.random.key(0), base)
+    tokens = jax.random.randint(jax.random.key(1),
+                                (base.batch, base.seq_len + 1), 0,
+                                base.vocab_size)
+    return mesh, cfgs, programs, params, tokens
+
+
+def measure(n_devices: int | None = None, devices=None,
+            cfg_kwargs: dict | None = None, rounds: int = 3,
+            reps: int = 2) -> dict:
+    """Run the paired A/B and return the JSON-able line (not printed).
+
+    Needs >= 2 devices (a 1-device "mesh" has no communication to
+    overlap) — raises ValueError below that, which bench.py's ``_aux``
+    degrades to a skipped marker."""
+    from dlnetbench_tpu.utils.timing import time_chain
+
+    devices = devices if devices is not None else jax.devices()
+    n = n_devices or len(devices)
+    if n < 2:
+        raise ValueError(f"overlap A/B needs >= 2 devices, have {n}")
+    mesh, cfgs, programs, params, tokens = build_programs(
+        n, devices, cfg_kwargs)
+
+    thunks = {name: {v: partial(fn, params, tokens)
+                     for v, fn in vs.items()}
+              for name, vs in programs.items()}
+    for vs in thunks.values():            # compile + warm outside timing
+        for fn in vs.values():
+            jax.block_until_ready(fn())
+
+    times: dict[str, dict[str, list[float]]] = {
+        name: {v: [] for v in vs} for name, vs in thunks.items()}
+    for _ in range(rounds):
+        # every (config, variant) timed back-to-back within the round:
+        # per-round ratios and overlap fractions use MATCHED samples
+        for name, vs in thunks.items():
+            for v, fn in vs.items():
+                times[name][v].append(time_chain(fn, k=reps))
+
+    walls = {name: ts["full"] for name, ts in times.items()}
+    overlaps = {name: stats_mod.overlap_fraction(
+        ts["full"], ts["compute"], ts["comm"])
+        for name, ts in times.items()}
+    cfg = cfgs["baseline"]
+    metric = (f"spmd overlap A/B: tp_overlap=decomposed"
+              f"(chunks={cfgs['overlapped'].tp_overlap_chunks}) + "
+              f"grad_sync=bucketed vs blocking baseline, "
+              f"mesh {_mesh_desc(mesh)}, L={cfg.num_layers} "
+              f"S={cfg.seq_len} B={cfg.batch}, "
+              f"overlap_fraction=(Tc+Tm-Tboth)/min(Tc,Tm) from the "
+              f"full/compute/comm decomposition")
+    return assemble_line(metric, walls, overlaps)

@@ -25,6 +25,16 @@ Backward is ``jax.grad`` *through the collectives* (XLA transposes
 ppermute/psum/all_to_all), then gradients are psum'd over every mesh axis
 a parameter is replicated on.  The driver's ``dryrun_multichip`` entry
 jit-compiles and runs this step on an N-virtual-device mesh.
+
+r7 overlap layer (docs/PERF.md round 7): ``tp_overlap="decomposed"``
+replaces the blocking TP collectives with ppermute-pipelined collective
+matmuls (ops/collective_matmul.py, forward and backward);
+``grad_sync="bucketed"`` streams the DP grad psums per layer group in
+reverse-layer order during backward instead of one end-of-step psum; and
+``make_train_step(variant=...)`` provides the compute-only / comm-only
+legs of the proxy tier's A/B decomposition for the real step, feeding
+the measured overlap-fraction metric (metrics/stats.overlap_fraction,
+models/overlap_bench.py).
 """
 from __future__ import annotations
 
@@ -41,10 +51,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dlnetbench_tpu import ops
 from dlnetbench_tpu.models import layers as Lyr
+from dlnetbench_tpu.ops import collective_matmul as CM
 from dlnetbench_tpu.ops import sequence_parallel as SP
+from dlnetbench_tpu.parallel import collectives as col
 from dlnetbench_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP, make_grid_mesh
 
 _F32 = jnp.float32
+
+# A/B decomposition variants of the train step (proxies/base.py timing
+# protocol applied to the real-compute tier): "compute" strips every
+# collective (local shape-preserving stand-ins), "comm" strips the heavy
+# math (broadcast stubs with the same dataflow edges) — so the measured
+# overlap fraction (metrics/stats.overlap_fraction) has its Tc and Tm.
+VARIANTS = ("full", "compute", "comm")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,6 +101,23 @@ class SpmdConfig:
     #   ulysses   all_to_all to head-sharding and back; full-sequence local
     #             attention in between (flash kernel eligible)
     sp_mode: str = "megatron"
+    # How the TP-block collectives execute (megatron QKV/out projections
+    # and the vocab-parallel head):
+    #   none        blocking all_gather / psum_scatter around plain dots
+    #   decomposed  ppermute-pipelined collective matmuls
+    #               (ops/collective_matmul.py): the gather/scatter is
+    #               broken into ring chunks interleaved with the
+    #               dependent matmul, forward AND backward (custom VJPs)
+    tp_overlap: str = "none"
+    tp_overlap_chunks: int = 2   # row chunks per ring block (overlap grain)
+    # DP gradient sync schedule:
+    #   monolithic  one psum of the whole grad tree after backward
+    #   bucketed    per-layer-group psums issued in reverse-layer order,
+    #               chained with collectives.tie so each bucket's sync
+    #               streams as soon as its grads materialize (ZeRO/FSDP
+    #               bucketing, the dp proxy's schedule made real)
+    grad_sync: str = "monolithic"
+    grad_bucket_layers: int = 1  # local layers per bucket
 
     @property
     def head_dim(self) -> int:
@@ -99,6 +135,12 @@ class SpmdConfig:
         checks = [
             (self.sp_mode in ("megatron", "ring", "ulysses"),
              f"unknown sp_mode {self.sp_mode!r}"),
+            (self.tp_overlap in ("none", "decomposed"),
+             f"unknown tp_overlap {self.tp_overlap!r}"),
+            (self.tp_overlap_chunks >= 1, "tp_overlap_chunks < 1"),
+            (self.grad_sync in ("monolithic", "bucketed"),
+             f"unknown grad_sync {self.grad_sync!r}"),
+            (self.grad_bucket_layers >= 1, "grad_bucket_layers < 1"),
             (self.num_layers % pp == 0, "layers % pp != 0"),
             (self.batch % (dp * self.num_microbatches) == 0,
              "batch % (dp*microbatches) != 0"),
@@ -197,22 +239,42 @@ def _replicated_axes(spec: P) -> tuple:
 # --------------------------------------------------------------------- #
 # Per-device (shard_map) forward
 # --------------------------------------------------------------------- #
-def _moe_block(cfg: SpmdConfig, tp: int, y, lp):
+def _local_a2a(x, tp: int, split_axis: int, concat_axis: int):
+    """Shape-equivalent local stand-in for a tiled all_to_all (compute
+    A/B variant: same output shape, zero wire traffic)."""
+    parts = jnp.split(x, tp, axis=split_axis)
+    return jnp.concatenate(parts, axis=concat_axis)
+
+
+def _moe_block(cfg: SpmdConfig, tp: int, y, lp, comm_on=True,
+               compute_on=True):
     """y: [mb, S/tp, d] local tokens; experts sharded over tp (EP)."""
     mb, s_loc, d = y.shape
     x2 = y.reshape(mb * s_loc, d)
-    # capacity-based one-hot dispatch (GShard style) — the shared math in
-    # models/layers.py, so the single-device sparse MoE and this
-    # EP-sharded path can never drift apart
-    ein, disp, gate = Lyr.moe_dispatch(x2, lp["w_router"], cfg.num_experts,
-                                       cfg.top_k, cfg.capacity_factor)
+    if compute_on:
+        # capacity-based one-hot dispatch (GShard style) — the shared
+        # math in models/layers.py, so the single-device sparse MoE and
+        # this EP-sharded path can never drift apart
+        ein, disp, gate = Lyr.moe_dispatch(x2, lp["w_router"],
+                                           cfg.num_experts, cfg.top_k,
+                                           cfg.capacity_factor)
+    else:   # comm variant: dispatch stubbed, buffer shapes preserved
+        cap = max(1, int(cfg.capacity_factor * x2.shape[0] * cfg.top_k
+                         / cfg.num_experts))
+        ein = CM.comm_stub((cfg.num_experts, cap, d), _F32, x2,
+                           lp["w_router"])
+        disp = gate = None
     # EP all_to_all: [E, C, d] -> [E/tp, C*tp, d] (each rank gets its experts'
     # tokens from every peer — the hybrid_3d_moe dispatch A2A)
     if tp > 1:
-        ein = lax.all_to_all(ein, AXIS_TP, split_axis=0, concat_axis=1,
-                             tiled=True)
+        ein = (lax.all_to_all(ein, AXIS_TP, split_axis=0, concat_axis=1,
+                              tiled=True) if comm_on
+               else _local_a2a(ein, tp, 0, 1))
     ein = ein.astype(cfg.jdtype)
-    if cfg.mlp_int8:
+    if not compute_on:
+        out = CM.comm_stub(ein.shape, _F32, ein, lp["w_gate"],
+                           lp["w_up"], lp["w_down"])
+    elif cfg.mlp_int8:
         from dlnetbench_tpu.ops.int8 import int8_dot_batched
         g = int8_dot_batched(ein, lp["w_gate"].astype(cfg.jdtype))
         u = int8_dot_batched(ein, lp["w_up"].astype(cfg.jdtype))
@@ -228,13 +290,18 @@ def _moe_block(cfg: SpmdConfig, tp: int, y, lp):
         out = jnp.einsum("ech,ehd->ecd", h.astype(cfg.jdtype),
                          lp["w_down"], preferred_element_type=_F32)
     if tp > 1:  # combine A2A (reverse reshard)
-        out = lax.all_to_all(out, AXIS_TP, split_axis=1, concat_axis=0,
-                             tiled=True)
-    y2 = Lyr.moe_combine(out, disp, gate)
+        out = (lax.all_to_all(out, AXIS_TP, split_axis=1, concat_axis=0,
+                              tiled=True) if comm_on
+               else _local_a2a(out, tp, 1, 0))
+    if compute_on:
+        y2 = Lyr.moe_combine(out, disp, gate)
+    else:
+        y2 = CM.comm_stub((mb * s_loc, d), _F32, out)
     return y2.reshape(mb, s_loc, d).astype(y.dtype)
 
 
-def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions):
+def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions, comm_on=True,
+                 compute_on=True):
     """One decoder block under TP+SP; x: [mb, S/tp, d] sequence-sharded.
 
     ``positions``: the GLOBAL positions matching the sequence length rope
@@ -243,24 +310,70 @@ def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions):
     """
     mb, s_loc, d = x.shape
     dh = cfg.head_dim
+    decomposed = cfg.tp_overlap == "decomposed"
 
     y = Lyr.rmsnorm(x, lp["norm1"])
     if cfg.sp_mode == "megatron" and tp > 1:
         # gather the full sequence, shard the heads (Megatron SP)
         h_loc = cfg.num_heads // tp
         hkv_loc = cfg.num_kv_heads // tp
-        y = lax.all_gather(y, AXIS_TP, axis=1, tiled=True)   # [mb, S, d]
-        s_full = y.shape[1]
-        q = jnp.dot(y, lp["wq"]).reshape(mb, s_full, h_loc, dh)
-        k = jnp.dot(y, lp["wk"]).reshape(mb, s_full, hkv_loc, dh)
-        v = jnp.dot(y, lp["wv"]).reshape(mb, s_full, hkv_loc, dh)
-        q, k = Lyr.rope(q, k, positions)
-        att = ops.attention(q, k, v, causal=True,
-                            impl=cfg.attention_impl).reshape(
-            mb, s_full, d // tp)
-        out = jnp.dot(att, lp["wo"])                          # partial sums
-        # reduce partials and scatter back to sequence shards
-        out = lax.psum_scatter(out, AXIS_TP, scatter_dimension=1, tiled=True)
+        qw, kvw = h_loc * dh, hkv_loc * dh
+        if decomposed:
+            # collective matmul: the gather rides the QKV projection as
+            # ppermute-pipelined chunks (one fused weight so a single
+            # ring serves all three column-parallel projections —
+            # concatenated ONCE per step outside the layer scan by
+            # local_loss, not per layer per microbatch here)
+            qkv = CM.all_gather_matmul(
+                y, lp["w_qkv"], AXIS_TP, gather_axis=1,
+                chunks=cfg.tp_overlap_chunks,
+                fake_compute=not compute_on, fake_comm=not comm_on)
+            s_full = qkv.shape[1]
+            q = qkv[..., :qw].reshape(mb, s_full, h_loc, dh)
+            k = qkv[..., qw:qw + kvw].reshape(mb, s_full, hkv_loc, dh)
+            v = qkv[..., qw + kvw:].reshape(mb, s_full, hkv_loc, dh)
+        else:
+            y = (lax.all_gather(y, AXIS_TP, axis=1, tiled=True)
+                 if comm_on else jnp.concatenate([y] * tp, axis=1))
+            s_full = y.shape[1]
+            if compute_on:
+                q = jnp.dot(y, lp["wq"]).reshape(mb, s_full, h_loc, dh)
+                k = jnp.dot(y, lp["wk"]).reshape(mb, s_full, hkv_loc, dh)
+                v = jnp.dot(y, lp["wv"]).reshape(mb, s_full, hkv_loc, dh)
+            else:
+                q = CM.comm_stub((mb, s_full, h_loc, dh), y.dtype, y,
+                                 lp["wq"])
+                k = CM.comm_stub((mb, s_full, hkv_loc, dh), y.dtype, y,
+                                 lp["wk"])
+                v = CM.comm_stub((mb, s_full, hkv_loc, dh), y.dtype, y,
+                                 lp["wv"])
+        if compute_on:
+            q, k = Lyr.rope(q, k, positions)
+            att = ops.attention(q, k, v, causal=True,
+                                impl=cfg.attention_impl).reshape(
+                mb, s_full, d // tp)
+        else:
+            att = CM.comm_stub((mb, s_full, d // tp), q.dtype, q, k, v)
+        if decomposed:
+            # reduce partials and scatter back to sequence shards, the
+            # ring way: each hop overlaps the next block's partial matmul
+            out = CM.matmul_reduce_scatter(
+                att, lp["wo"], AXIS_TP, scatter_axis=1,
+                chunks=cfg.tp_overlap_chunks,
+                fake_compute=not compute_on, fake_comm=not comm_on)
+        else:
+            out = (jnp.dot(att, lp["wo"]) if compute_on
+                   else CM.comm_stub((mb, s_full, d), att.dtype, att,
+                                     lp["wo"]))         # partial sums
+            # reduce partials and scatter back to sequence shards
+            out = (lax.psum_scatter(out, AXIS_TP, scatter_dimension=1,
+                                    tiled=True) if comm_on
+                   else lax.slice_in_dim(out, 0, s_loc, axis=1))
+    elif not compute_on:
+        # comm variant reaching here means tp == 1 (the megatron-only
+        # variant guard): the block has no collectives at all — stub it
+        out = CM.comm_stub((mb, s_loc, d), x.dtype, y, lp["wq"],
+                           lp["wo"])
     else:
         # sequence stays sharded: project this shard with ALL heads
         # (attention weights replicated over tp in these modes)
@@ -280,10 +393,11 @@ def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions):
     x = x + out
 
     y = Lyr.rmsnorm(x, lp["norm2"])
-    return x + _moe_block(cfg, tp, y, lp)
+    return x + _moe_block(cfg, tp, y, lp, comm_on, compute_on)
 
 
-def _vocab_parallel_ce(logits_loc, targets, tp: int, vocab: int):
+def _vocab_parallel_ce(logits_loc, targets, tp: int, vocab: int,
+                       comm_on=True):
     """Megatron-style vocab-parallel cross entropy.
 
     ``logits_loc``: [..., V/tp] — this rank's vocab shard of the logits for
@@ -296,21 +410,80 @@ def _vocab_parallel_ce(logits_loc, targets, tp: int, vocab: int):
     lg = logits_loc.astype(_F32)
     # the max shift is numerical stabilization only — constant wrt autodiff
     m = jnp.max(lax.stop_gradient(lg), axis=-1)
-    gmax = lax.pmax(m, AXIS_TP)
+    gmax = lax.pmax(m, AXIS_TP) if comm_on else m
     sumexp = jnp.sum(jnp.exp(lg - gmax[..., None]), axis=-1)
-    denom = lax.psum(sumexp, AXIS_TP)
+    denom = lax.psum(sumexp, AXIS_TP) if comm_on else sumexp
     local_t = targets - shard * v_loc
     in_range = (local_t >= 0) & (local_t < v_loc)
     tval = jnp.take_along_axis(
         lg, jnp.clip(local_t, 0, v_loc - 1)[..., None], axis=-1)[..., 0]
-    tval = lax.psum(jnp.where(in_range, tval, 0.0), AXIS_TP)
+    tval = jnp.where(in_range, tval, 0.0)
+    if comm_on:
+        tval = lax.psum(tval, AXIS_TP)
     return jnp.mean(jnp.log(denom) + gmax - tval)
 
 
-def make_train_step(mesh: Mesh, cfg: SpmdConfig):
+def _bucketed_grad_sync(cfg: SpmdConfig, grads: dict, specs: dict,
+                        dp: int, pp: int):
+    """ZeRO/FSDP-style bucketed DP grad sync: per-layer-group psums in
+    reverse-layer order (later layers' grads materialize first in
+    backward), each bucket ``tie``-d to the previous bucket's result so
+    XLA streams the syncs during backward instead of fusing them into
+    one end-of-step collective.  Elementwise-identical math to the
+    monolithic path (psum commutes with slicing)."""
+    def sync_leaf(g, sp):
+        g = lax.psum(g, AXIS_DP) / dp
+        rep = _replicated_axes(sp)
+        return lax.psum(g, rep) if rep else g
+
+    is_p = lambda x: isinstance(x, P)  # noqa: E731
+    dep = None
+
+    def sync_part(part, spec_part):
+        nonlocal dep
+        if dep is not None:
+            part = jax.tree.map(lambda g: col.tie(g, dep), part)
+        out = jax.tree.map(sync_leaf, part, spec_part, is_leaf=is_p)
+        dep = jax.tree.leaves(out)[0]
+        return out
+
+    # head + final_norm first: their grads are ready at the start of
+    # backward; then layer groups last-to-first; embed's grads complete
+    # only when backward finishes, so its bucket goes last
+    tail = sync_part({"head": grads["head"],
+                      "final_norm": grads["final_norm"]},
+                     {"head": specs["head"],
+                      "final_norm": specs["final_norm"]})
+    layers_local = cfg.num_layers // pp
+    step_l = min(cfg.grad_bucket_layers, layers_local)
+    bounds = list(range(0, layers_local, step_l)) + [layers_local]
+    slices = {}
+    for b in reversed(range(len(bounds) - 1)):
+        lo, hi = bounds[b], bounds[b + 1]
+        part = {k: v[lo:hi] for k, v in grads["layers"].items()}
+        slices[b] = sync_part(part, specs["layers"])
+    head_bucket = sync_part({"embed": grads["embed"]},
+                            {"embed": specs["embed"]})
+    layers = {k: jnp.concatenate([slices[b][k]
+                                  for b in range(len(bounds) - 1)], axis=0)
+              for k in grads["layers"]}
+    return {"embed": head_bucket["embed"], "layers": layers,
+            "final_norm": tail["final_norm"], "head": tail["head"]}
+
+
+def make_train_step(mesh: Mesh, cfg: SpmdConfig, variant: str = "full"):
     dp, pp, tp = (mesh.devices.shape[mesh.axis_names.index(a)]
                   for a in (AXIS_DP, AXIS_PP, AXIS_TP))
     cfg.validate(dp, pp, tp)
+    if variant not in VARIANTS:
+        raise ValueError(f"unknown variant {variant!r}; one of {VARIANTS}")
+    comm_on = variant != "compute"
+    compute_on = variant != "comm"
+    if variant != "full" and cfg.sp_mode != "megatron":
+        raise ValueError(
+            "A/B decomposition variants are defined for sp_mode='megatron' "
+            "(ring/ulysses interleave comm and compute inside "
+            "ops/sequence_parallel.py, where the split has no meaning)")
     specs = param_specs(cfg.sp_mode)
     mb_size = cfg.batch // (dp * cfg.num_microbatches)
     m = cfg.num_microbatches
@@ -329,10 +502,24 @@ def make_train_step(mesh: Mesh, cfg: SpmdConfig):
         else:
             positions = tp_idx * s_loc + jnp.arange(s_loc)
 
+        layers_xs = params_loc["layers"]
+        if (cfg.tp_overlap == "decomposed" and cfg.sp_mode == "megatron"
+                and tp > 1):
+            # fuse the stacked column-parallel QKV weights ONCE per step
+            # (autodiff splits the grad back through the concat): doing
+            # this inside the scan body would copy the full QKV weight
+            # per layer per microbatch — XLA cannot hoist a concat of
+            # loop-carried slices out of a differentiated scan
+            layers_xs = {**layers_xs,
+                         "w_qkv": jnp.concatenate(
+                             [layers_xs["wq"], layers_xs["wk"],
+                              layers_xs["wv"]], axis=-1)}
+
         def run_stage(x):
             def body(carry, lp):
-                return _stage_block(cfg, tp, carry, lp, positions), None
-            out, _ = lax.scan(body, x, params_loc["layers"])
+                return _stage_block(cfg, tp, carry, lp, positions,
+                                    comm_on, compute_on), None
+            out, _ = lax.scan(body, x, layers_xs)
             return out
 
         ticks = m + pp - 1
@@ -354,14 +541,31 @@ def make_train_step(mesh: Mesh, cfg: SpmdConfig):
             if tp > 1:
                 # gather the sequence so every rank scores all tokens
                 # against its vocab shard, then vocab-parallel CE
-                xh = lax.all_gather(xh, AXIS_TP, axis=1, tiled=True)
-                logits_loc = jnp.dot(xh, params_loc["head"],
-                                     preferred_element_type=_F32)
+                if cfg.tp_overlap == "decomposed":
+                    # the gather rides the parallel-head projection as a
+                    # decomposed collective matmul
+                    logits_loc = CM.all_gather_matmul(
+                        xh, params_loc["head"], AXIS_TP, gather_axis=1,
+                        chunks=cfg.tp_overlap_chunks,
+                        fake_compute=not compute_on,
+                        fake_comm=not comm_on,
+                        preferred_element_type=_F32)
+                else:
+                    xh = (lax.all_gather(xh, AXIS_TP, axis=1, tiled=True)
+                          if comm_on
+                          else jnp.concatenate([xh] * tp, axis=1))
+                    logits_loc = (
+                        jnp.dot(xh, params_loc["head"],
+                                preferred_element_type=_F32) if compute_on
+                        else CM.comm_stub(
+                            xh.shape[:-1] + (params_loc["head"].shape[-1],),
+                            _F32, xh, params_loc["head"]))
                 # divided by tp: every tp rank computes the same replicated
                 # scalar, so each seeds 1/tp of the cotangent — the psum
                 # transposes inside the CE then deliver exactly 1 in total
                 mb_loss = _vocab_parallel_ce(logits_loc, tgt, tp,
-                                             cfg.vocab_size) / tp
+                                             cfg.vocab_size,
+                                             comm_on) / tp
             else:
                 logits = jnp.dot(xh, params_loc["head"],
                                  preferred_element_type=_F32)
@@ -369,7 +573,7 @@ def make_train_step(mesh: Mesh, cfg: SpmdConfig):
             is_last = stage == pp - 1
             loss_sum = loss_sum + jnp.where(valid & is_last, mb_loss, 0.0)
             # stream activations to the next stage
-            if pp > 1:
+            if pp > 1 and comm_on:
                 perm = [(i, i + 1) for i in range(pp - 1)]
                 x_carry = lax.ppermute(x_out, AXIS_PP, perm)
             else:
@@ -383,14 +587,25 @@ def make_train_step(mesh: Mesh, cfg: SpmdConfig):
 
     def step_local(params_loc, tokens_loc):
         loss, grads = jax.value_and_grad(local_loss)(params_loc, tokens_loc)
-        # grad sync: psum over dp (data parallel, mean) ...
-        grads = jax.tree.map(lambda g: lax.psum(g, AXIS_DP) / dp, grads)
-        # ... and over every axis the param is replicated on (transpose of
-        # the implicit broadcast in the manual-sharding forward)
-        grads = jax.tree.map(
-            lambda g, sp: lax.psum(g, _replicated_axes(sp))
-            if _replicated_axes(sp) else g,
-            grads, specs, is_leaf=lambda x: isinstance(x, P))
+        if not comm_on:
+            # compute variant: no sync, no loss reassembly — values are
+            # wrong by construction, only the wall time is consumed
+            new_params = jax.tree.map(
+                lambda p_, g: p_ - cfg.lr * g.astype(p_.dtype),
+                params_loc, grads)
+            return new_params, loss
+        if cfg.grad_sync == "bucketed":
+            grads = _bucketed_grad_sync(cfg, grads, specs, dp, pp)
+        else:
+            # grad sync: psum over dp (data parallel, mean) ...
+            grads = jax.tree.map(lambda g: lax.psum(g, AXIS_DP) / dp, grads)
+            # ... and over every axis the param is replicated on
+            # (transpose of the implicit broadcast in the manual-sharding
+            # forward)
+            grads = jax.tree.map(
+                lambda g, sp: lax.psum(g, _replicated_axes(sp))
+                if _replicated_axes(sp) else g,
+                grads, specs, is_leaf=lambda x: isinstance(x, P))
         # reassemble the replicated loss value for reporting: sum the
         # last-stage / per-tp-rank shares, mean over dp groups
         loss = lax.psum(loss, (AXIS_PP, AXIS_TP))

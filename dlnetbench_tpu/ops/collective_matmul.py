@@ -1,0 +1,329 @@
+"""Decomposed collective matmuls: overlap the collective with its matmul.
+
+DLNetBench's subject is the communication schedule, yet the TP blocks in
+``models/spmd.py`` end in *blocking* collectives: ``all_gather`` feeding a
+projection, and a projection feeding ``psum_scatter``.  PERF.md r4 showed
+XLA will not line these up with the dependent compute by itself ("what
+they save in traffic they lose in scheduling") — so this module does it by
+hand, the classic TPU-native way (Wang et al., *Overlap Communication with
+Dependent Computation via Decomposition*, ASPLOS'23): break the collective
+into per-shard chunks moved with ``lax.ppermute`` and interleave each
+chunk's hop with the part of the matmul that is already data-complete.
+
+Two ops, both called *inside* ``shard_map`` over a named mesh axis:
+
+* ``all_gather_matmul(x, w, axis)`` ==
+  ``dot(lax.all_gather(x, axis, axis=gather_axis, tiled=True), w)``.
+  Each rank computes its own block's matmul immediately, then receives
+  peer blocks over a **bidirectional ring** (half the peers arrive over
+  the +1 direction, half over the -1 direction — both ICI link
+  directions busy) and matmuls each block as it lands.  Per-row math is
+  identical to gather-then-dot, so the forward matches the fused path
+  exactly up to dot tiling.
+
+* ``matmul_reduce_scatter(a, w, axis)`` ==
+  ``lax.psum_scatter(dot(a, w), axis, scatter_dimension=scatter_axis,
+  tiled=True)``.  A ring reduce-scatter where each hop's transfer
+  overlaps the *next* destination block's partial matmul; bidirectional
+  by splitting the output columns in half, one half per ring direction.
+  Accumulation order is ring order, not XLA's psum_scatter order, so
+  results match the fused path to f32 reduction tolerance (documented;
+  tests pin it).
+
+``chunks`` subdivides every block matmul along its row axis, shrinking
+the compute quantum between permutes so the schedule has finer grain to
+hide hops behind (the chunk-count axis of the r7 overlap study).
+
+Backward also overlaps, via custom VJPs that reuse the same decomposed
+machinery (the transposes of tiled all_gather / psum_scatter are each
+other): ``d(all_gather_matmul)/dx`` is a decomposed
+matmul-reduce-scatter, ``d(matmul_reduce_scatter)/da`` is a decomposed
+all-gather-matmul, and both ``dw`` terms are bidirectional-ring
+accumulations over the rotating activation blocks.
+
+``fake_compute=True`` keeps every ppermute (identical wire schedule) but
+replaces each block matmul with a broadcast stub — the comm-only leg of
+the SPMD A/B decomposition (``models/spmd.py`` variants) that feeds the
+measured overlap-fraction metric (``metrics/stats.overlap_fraction``).
+``fake_comm=True`` is the mirror image: every ppermute becomes the
+identity (each "received" block is the local one again) so the compute
+leg performs the full schedule's FLOPs with zero wire traffic.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlnetbench_tpu.utils.jax_compat import axis_size as _axis_size
+
+_F32 = jnp.float32
+
+
+def _shift(x, axis_name: str, direction: int, fake_comm: bool):
+    """One ring hop: direction +1 sends to the next rank (so this rank
+    then holds the block of rank ``me - 1``), -1 the reverse.  With
+    ``fake_comm`` the hop is the identity (compute-only A/B leg)."""
+    if fake_comm:
+        return x
+    n = _axis_size(axis_name)
+    perm = [(i, (i + direction) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def comm_stub(shape, dtype, *deps):
+    """Shape-correct stand-in whose value depends (cheaply) on every
+    ``dep`` — keeps the dataflow edges of the real compute so the comm
+    variant's collectives schedule exactly like the full program's."""
+    s = sum(d.reshape(-1)[0].astype(_F32) for d in deps)
+    return jnp.broadcast_to(s, shape).astype(dtype)
+
+
+def _block_mm(xblk, w, chunks: int, row_axis: int, pet, fake: bool):
+    """Local matmul of one ring block, optionally split into ``chunks``
+    row slices so each slice's MXU work can interleave with in-flight
+    permutes at finer grain."""
+    if fake:
+        return comm_stub(xblk.shape[:-1] + (w.shape[-1],),
+                         pet or jnp.result_type(xblk.dtype, w.dtype),
+                         xblk, w)
+
+    def dot(a):
+        return lax.dot_general(a, w, (((a.ndim - 1,), (0,)), ((), ())),
+                               preferred_element_type=pet)
+
+    size = xblk.shape[row_axis]
+    if chunks <= 1 or size < 2:
+        return dot(xblk)
+    bounds = [round(i * size / chunks) for i in range(chunks + 1)]
+    parts = [dot(lax.slice_in_dim(xblk, lo, hi, axis=row_axis))
+             for lo, hi in zip(bounds, bounds[1:]) if hi > lo]
+    return jnp.concatenate(parts, axis=row_axis)
+
+
+def _contract_dw(ablk, dblk, fake: bool):
+    """dw contribution of one block pair: contract every dim except the
+    last of each ([..., s, d] x [..., s, k] -> [d, k])."""
+    if fake:
+        return comm_stub((ablk.shape[-1], dblk.shape[-1]),
+                         jnp.result_type(ablk.dtype, dblk.dtype),
+                         ablk, dblk)
+    dims = tuple(range(ablk.ndim - 1))
+    return lax.dot_general(ablk, dblk, ((dims, dims), ((), ())))
+
+
+def _bidir_sources(n: int):
+    """Hop schedule of a bidirectional ring gather: at hop t this rank
+    receives the block of rank ``me - t`` over the +1 direction and (for
+    the first ``floor((n-1)/2)`` hops) rank ``me + t`` over -1."""
+    down = (n - 1 + 1) // 2   # blocks arriving from below (me-1, me-2, ..)
+    up = (n - 1) // 2         # blocks arriving from above (me+1, me+2, ..)
+    return down, up
+
+
+# --------------------------------------------------------------------- #
+# all_gather_matmul
+# --------------------------------------------------------------------- #
+def _ag_matmul_impl(x, w, axis_name, gather_axis, chunks, fk_compute,
+                    fk_comm, pet):
+    n = _axis_size(axis_name)
+    if n == 1:
+        return _block_mm(x, w, chunks, gather_axis, pet, fk_compute)
+    me = lax.axis_index(axis_name)
+    s_loc = x.shape[gather_axis]
+    dt = pet or jnp.result_type(x.dtype, w.dtype)
+    out_shape = (x.shape[:gather_axis] + (n * s_loc,)
+                 + x.shape[gather_axis + 1:-1] + (w.shape[-1],))
+    out = jnp.zeros(out_shape, dt)
+
+    def put(buf, blk, src):
+        return lax.dynamic_update_slice_in_dim(buf, blk, src * s_loc,
+                                               axis=gather_axis)
+
+    # own block first: compute starts before any wire traffic
+    out = put(out, _block_mm(x, w, chunks, gather_axis, pet, fk_compute),
+              me)
+    down, up = _bidir_sources(n)
+    below = above = x
+    for t in range(1, max(down, up) + 1):
+        # issue both hops BEFORE this round's matmuls: the permutes
+        # depend only on the previous hop, so XLA overlaps them with the
+        # block matmuls below
+        if t <= down:
+            below = _shift(below, axis_name, +1, fk_comm)
+        if t <= up:
+            above = _shift(above, axis_name, -1, fk_comm)
+        if t <= down:
+            out = put(out, _block_mm(below, w, chunks, gather_axis, pet,
+                                     fk_compute), (me - t) % n)
+        if t <= up:
+            out = put(out, _block_mm(above, w, chunks, gather_axis, pet,
+                                     fk_compute), (me + t) % n)
+    return out
+
+
+def _ring_dw(x_like, other, axis_name, gather_axis, fk_compute, fk_comm,
+             rotate_first):
+    """Bidirectional-ring dw accumulation.
+
+    ``rotate_first`` rotates ``x_like`` blocks around the ring and
+    contracts each against the matching *local slice* of ``other``
+    (all_gather_matmul's dw: x rotates, dout is full).  With
+    ``rotate_first=False`` the roles flip (matmul_reduce_scatter's dw:
+    dout rotates, a is full)."""
+    n = _axis_size(axis_name)
+    me = lax.axis_index(axis_name) if n > 1 else 0
+    s_loc = x_like.shape[gather_axis]
+
+    def contrib(blk, src):
+        sel = lax.dynamic_slice_in_dim(other, src * s_loc, s_loc,
+                                       gather_axis)
+        return (_contract_dw(blk, sel, fk_compute) if rotate_first
+                else _contract_dw(sel, blk, fk_compute))
+
+    acc = contrib(x_like, me)
+    if n == 1:
+        return acc
+    down, up = _bidir_sources(n)
+    below = above = x_like
+    for t in range(1, max(down, up) + 1):
+        if t <= down:
+            below = _shift(below, axis_name, +1, fk_comm)
+        if t <= up:
+            above = _shift(above, axis_name, -1, fk_comm)
+        if t <= down:
+            acc = acc + contrib(below, (me - t) % n)
+        if t <= up:
+            acc = acc + contrib(above, (me + t) % n)
+    return acc
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _ag_matmul(x, w, axis_name, gather_axis, chunks, fk_compute, fk_comm,
+               pet):
+    return _ag_matmul_impl(x, w, axis_name, gather_axis, chunks,
+                           fk_compute, fk_comm, pet)
+
+
+def _ag_matmul_fwd(x, w, axis_name, gather_axis, chunks, fk_compute,
+                   fk_comm, pet):
+    return (_ag_matmul_impl(x, w, axis_name, gather_axis, chunks,
+                            fk_compute, fk_comm, pet), (x, w))
+
+
+def _ag_matmul_bwd(axis_name, gather_axis, chunks, fk_compute, fk_comm,
+                   pet, res, dout):
+    x, w = res
+    # transpose of tiled all_gather is psum_scatter: dx decomposes into
+    # the sibling op, so the backward overlaps the same way
+    dx = _mm_rs_impl(dout, w.T, axis_name, gather_axis, chunks,
+                     fk_compute, fk_comm, None)
+    dw = _ring_dw(x, dout, axis_name, gather_axis, fk_compute, fk_comm,
+                  rotate_first=True)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_ag_matmul.defvjp(_ag_matmul_fwd, _ag_matmul_bwd)
+
+
+# --------------------------------------------------------------------- #
+# matmul_reduce_scatter
+# --------------------------------------------------------------------- #
+def _mm_rs_impl(a, w, axis_name, scatter_axis, chunks, fk_compute,
+                fk_comm, pet):
+    n = _axis_size(axis_name)
+    if n == 1:
+        return _block_mm(a, w, chunks, scatter_axis, pet, fk_compute)
+    me = lax.axis_index(axis_name)
+    s_loc = a.shape[scatter_axis] // n
+
+    def blk(b, wpart):
+        ab = lax.dynamic_slice_in_dim(a, b * s_loc, s_loc, scatter_axis)
+        return _block_mm(ab, wpart, chunks, scatter_axis, pet, fk_compute)
+
+    kh = w.shape[-1] // 2
+    halves = ([(w, +1)] if kh == 0
+              else [(w[:, :kh], +1), (w[:, kh:], -1)])
+    accs = []
+    for wpart, direction in halves:
+        # ring reduce-scatter: block b starts at rank b+direction and
+        # picks up each rank's partial on the way to rank b; at hop t
+        # this rank's partial is for block me - direction*(1+t)
+        acc = blk((me - direction) % n, wpart)
+        for t in range(1, n):
+            acc = (_shift(acc, axis_name, direction, fk_comm)
+                   + blk((me - direction * (1 + t)) % n, wpart))
+        accs.append(acc)
+    return accs[0] if len(accs) == 1 else jnp.concatenate(accs, axis=-1)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _mm_rs(a, w, axis_name, scatter_axis, chunks, fk_compute, fk_comm,
+           pet):
+    return _mm_rs_impl(a, w, axis_name, scatter_axis, chunks, fk_compute,
+                       fk_comm, pet)
+
+
+def _mm_rs_fwd(a, w, axis_name, scatter_axis, chunks, fk_compute,
+               fk_comm, pet):
+    return (_mm_rs_impl(a, w, axis_name, scatter_axis, chunks, fk_compute,
+                        fk_comm, pet), (a, w))
+
+
+def _mm_rs_bwd(axis_name, scatter_axis, chunks, fk_compute, fk_comm, pet,
+               res, dout):
+    a, w = res
+    # transpose of tiled psum_scatter is all_gather: da decomposes into
+    # the sibling op
+    da = _ag_matmul_impl(dout, w.T, axis_name, scatter_axis, chunks,
+                         fk_compute, fk_comm, None)
+    dw = _ring_dw(dout, a, axis_name, scatter_axis, fk_compute, fk_comm,
+                  rotate_first=False)
+    return da.astype(a.dtype), dw.astype(w.dtype)
+
+
+_mm_rs.defvjp(_mm_rs_fwd, _mm_rs_bwd)
+
+
+# --------------------------------------------------------------------- #
+# Public API
+# --------------------------------------------------------------------- #
+def all_gather_matmul(x, w, axis_name: str, *, gather_axis: int = 1,
+                      chunks: int = 1, fake_compute: bool = False,
+                      fake_comm: bool = False,
+                      preferred_element_type=None):
+    """``dot(all_gather(x, axis, tiled=True), w)`` as a ppermute-pipelined
+    bidirectional-ring chunk loop (call inside ``shard_map``).
+
+    ``x``: this rank's shard, gathered over ``gather_axis``; ``w``: 2-D,
+    contracted with ``x``'s last dim.  ``chunks`` splits each block's
+    matmul into row slices (overlap grain).  Backward overlaps too
+    (custom VJP).
+    """
+    assert w.ndim == 2, f"w must be 2-D, got {w.shape}"
+    pet = (None if preferred_element_type is None
+           else jnp.dtype(preferred_element_type))
+    return _ag_matmul(x, w, axis_name, int(gather_axis), int(chunks),
+                      bool(fake_compute), bool(fake_comm), pet)
+
+
+def matmul_reduce_scatter(a, w, axis_name: str, *, scatter_axis: int = 1,
+                          chunks: int = 1, fake_compute: bool = False,
+                          fake_comm: bool = False,
+                          preferred_element_type=None):
+    """``psum_scatter(dot(a, w), axis, scatter_dimension=scatter_axis,
+    tiled=True)`` as a bidirectional ring reduce-scatter whose hops
+    overlap the next block's partial matmul (call inside ``shard_map``).
+
+    Ring accumulation order differs from the fused psum_scatter's, so
+    equality with the baseline path is to f32 reduction tolerance
+    (tests/test_collective_matmul.py pins it).  Backward overlaps too
+    (custom VJP).
+    """
+    assert w.ndim == 2, f"w must be 2-D, got {w.shape}"
+    pet = (None if preferred_element_type is None
+           else jnp.dtype(preferred_element_type))
+    return _mm_rs(a, w, axis_name, int(scatter_axis), int(chunks),
+                  bool(fake_compute), bool(fake_comm), pet)

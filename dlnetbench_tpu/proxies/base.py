@@ -23,6 +23,11 @@ All are timed whole-program with ``block_until_ready`` fencing.  Then
                    timer: communication not hidden by compute (dp.cpp:191)
   wire comm      = t(comm)                      fenced lower bound of the
                    collective cost without contention from compute
+  overlap        = (t(compute) + t(comm) - t(full)) / min(...)
+                   the measured comm–compute overlap fraction
+                   (metrics/stats.overlap_fraction): 1.0 = the shorter
+                   leg fully hidden, 0.0 = serialized, negative =
+                   interference
 
 Loop mode (reference ``-DPROXY_LOOP`` binaries, dp.cpp:251-256) re-runs the
 full step forever to generate sustained background load for interference
@@ -196,6 +201,17 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
             time_callable(bundle.comm, reps=1)  # warm
             comm_s = [time_chain(bundle.comm, k=k) for k in chains]
         timers["comm_time"] = [t * 1e6 for t in comm_s]
+        if measure_compute:
+            # measured comm–compute overlap per chain (the A/B
+            # decomposition answering SURVEY §7.3 hard-part 1
+            # quantitatively): 1.0 = shorter leg fully hidden, 0.0 =
+            # serialized, negative = interference.  Dimensionless —
+            # rides the record like a timer and surfaces as the
+            # ``overlap`` column in analysis/bandwidth.py summaries.
+            from dlnetbench_tpu.metrics.stats import overlap_fraction
+            timers["overlap_fraction"] = [
+                round(v, 4) for v in overlap_fraction(full_s, comp_s,
+                                                      comm_s)]
 
     if cfg.measure_comm_only and bundle.variants:
         for vname, vfn in bundle.variants.items():

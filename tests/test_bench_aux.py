@@ -92,6 +92,136 @@ def test_band_ms_schema():
     assert got == {"best": 2.0, "band": [2.0, 2.5], "n": 3}
 
 
+def test_overlap_ab_line_schema_locked():
+    """The paired overlap-vs-baseline aux line (ISSUE 4: bench.py +
+    multichip driver, models/overlap_bench.assemble_line) is a BENCH
+    artifact — lock its schema: headline {value, unit, best, band, n}
+    from the OVERLAPPED config, per-config band sub-objects, a paired
+    per-round ratio band, and the measured overlap-fraction band per
+    config."""
+    from dlnetbench_tpu.models.overlap_bench import assemble_line
+
+    walls = {"baseline": [0.2, 0.21, 0.19],
+             "overlapped": [0.1, 0.12, 0.11]}
+    overlaps = {"baseline": [0.05, 0.0, 0.1],
+                "overlapped": [0.8, 0.7, 0.9]}
+    line = assemble_line("spmd overlap A/B (test)", walls, overlaps)
+    assert line["unit"] == "ms"
+    for key in ("value", "best", "band", "n"):
+        assert key in line, key
+    assert line["value"] == 110.0 and line["n"] == 3
+    for name in ("baseline", "overlapped"):
+        sub = line[name]
+        for key in ("value", "best", "band", "n"):
+            assert key in sub, (name, key)
+        assert len(sub["band"]) == 2
+    r = line["ratio_overlapped_vs_baseline"]
+    for key in ("value", "best", "band", "n"):
+        assert key in r, key
+    # per-round pairing: 0.1/0.2, 0.12/0.21, 0.11/0.19 -> median 0.5714
+    assert r["value"] == 0.5714 and r["n"] == 3
+    ov = line["overlap_fraction"]
+    for name in ("baseline", "overlapped"):
+        for key in ("value", "best", "band", "n"):
+            assert key in ov[name], (name, key)
+    assert ov["overlapped"]["value"] == 0.8
+
+
+def test_recommended_step_line_schema_locked():
+    """VERDICT r5 item #1's driver-captured half: the recommended_step
+    line names the fastest recipe passing the stated numerics bar, with
+    the winner's stat band and every candidate's loss + verdict."""
+    import bench
+
+    bf16 = {"value": 0.5375, "best": 0.53, "band": [0.53, 0.55], "n": 3}
+    int8 = {"value": 494.3, "best": 490.0, "band": [490.0, 500.0],
+            "n": 3, "loss": 10.41}
+    sb = {"value": 454.9, "best": 450.0, "band": [450.0, 460.0],
+          "n": 3, "loss": 10.45}
+    line = bench._recommended_step(bf16, 10.42,
+                                   {"int8_master": int8,
+                                    "int8_switchback": sb})
+    assert line["metric"] == "recommended_step"
+    assert line["recipe"] == "int8_switchback"   # fastest, passes 2% bar
+    assert line["unit"] == "ms"
+    for key in ("value", "best", "band", "n", "numerics_bar"):
+        assert key in line, key
+    assert line["value"] == 454.9
+    cands = line["candidates"]
+    assert set(cands) == {"bf16", "int8_master", "int8_switchback"}
+    assert all("loss" in c and "passes" in c for c in cands.values())
+    # a candidate failing the bar cannot win, however fast
+    sb_bad = dict(sb, loss=99.0)
+    line2 = bench._recommended_step(bf16, 10.42,
+                                    {"int8_master": int8,
+                                     "int8_switchback": sb_bad})
+    assert line2["recipe"] == "int8_master"
+    assert line2["candidates"]["int8_switchback"]["passes"] is False
+    # skipped candidates (None) don't compete; bf16 always does
+    line3 = bench._recommended_step(bf16, 10.42, {"int8_master": None})
+    assert line3["recipe"] == "bf16"
+    assert line3["value"] == 537.5
+
+
+def test_overlap_field_record_roundtrip_with_fixture():
+    """Lock the ``overlap_fraction`` field of the record schema against
+    the committed fixture: parser validation accepts it (per-rank timer
+    array + band summary), the DataFrame carries it, metrics.merge
+    round-trips it, and the bandwidth summary surfaces the ``overlap``
+    column."""
+    from pathlib import Path
+
+    from dlnetbench_tpu.analysis.bandwidth import (bandwidth_summary,
+                                                   effective_bandwidth)
+    from dlnetbench_tpu.metrics.merge import merge_records
+    from dlnetbench_tpu.metrics.parser import (load_records,
+                                               records_to_dataframe,
+                                               validate_record)
+
+    path = Path(__file__).parent / "data" / "record_overlap.jsonl"
+    records = load_records(path)
+    assert len(records) == 1
+    rec = records[0]
+    validate_record(rec)
+    # the fixture's overlap values are the formula applied to its timers
+    from dlnetbench_tpu.metrics.stats import overlap_fraction
+    row = rec["ranks"][0]
+    expect = overlap_fraction(row["runtimes"], row["compute_time"],
+                              row["comm_time"])
+    assert row["overlap_fraction"] == [round(v, 4) for v in expect]
+
+    df = records_to_dataframe(records)
+    assert "overlap_fraction" in df.columns
+    assert df["overlap_fraction"].tolist() == [0.5, 0.4318, 0.5, 0.4318]
+
+    merged = merge_records(records)     # single-process merge: identity
+    validate_record(merged)
+    assert merged["ranks"][0]["overlap_fraction"] == [0.5, 0.4318]
+
+    bw = effective_bandwidth([merged])
+    assert "overlap" in bw.columns
+    assert sorted(bw["overlap"].unique().tolist()) == [0.4318, 0.5]
+    summary = bandwidth_summary([merged])
+    assert "overlap" in summary.columns
+    assert summary["overlap"].iloc[0] == (0.5 + 0.4318) / 2
+
+
+def test_bandwidth_overlap_nan_without_decomposition():
+    """Records that never measured the A/B decomposition get NaN in the
+    overlap column — never a fabricated 0."""
+    import math
+
+    from dlnetbench_tpu.analysis.bandwidth import effective_bandwidth
+
+    rec = {"section": "dp", "version": 2,
+           "global": {"comm_model": {"comm_time": [
+               {"kind": "allreduce", "group": 2, "bytes": 1000}]}},
+           "mesh": {"platform": "cpu"},
+           "ranks": [{"rank": 0, "comm_time": [10.0]}]}
+    bw = effective_bandwidth([rec])
+    assert math.isnan(bw["overlap"].iloc[0])
+
+
 def test_aux_deadline_skips_instead_of_running(capsys, monkeypatch):
     """Past the wall-clock deadline the aux fn must not even start —
     the headline line takes precedence over auxiliary coverage."""

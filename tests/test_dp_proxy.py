@@ -35,6 +35,22 @@ def test_dp_runs_and_times(dp_result):
     assert all(t >= 0 for t in result.timers_us["barrier_time"])
 
 
+def test_dp_overlap_fraction_measured(dp_result):
+    """With both A/B legs measured, run_proxy reports the per-chain
+    measured overlap fraction (metrics/stats.overlap_fraction) — one
+    dimensionless sample per run, consistent with the timers it was
+    derived from."""
+    result, _ = dp_result
+    ov = result.timers_us["overlap_fraction"]
+    assert len(ov) == 3
+    from dlnetbench_tpu.metrics.stats import overlap_fraction
+    expect = overlap_fraction(result.timers_us["runtimes"],
+                              result.timers_us["compute_time"],
+                              result.timers_us["comm_time"])
+    for got, want in zip(ov, expect):
+        assert got == pytest.approx(want, abs=1e-3)
+
+
 def test_dp_step_correctness(dp_result):
     """The allreduce must actually sum across the 4 ranks: buffers start at
     zero, so outputs stay zero — then rerun the comm-only step on ones via

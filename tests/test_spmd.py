@@ -89,6 +89,99 @@ def test_spmd_sequence_parallel_modes_match(eight_devices, sp_mode):
     np.testing.assert_allclose(d8, d1, rtol=0.05, atol=2e-4)
 
 
+# ---- r7 overlap paths: decomposed collective matmuls + bucketed sync ----
+# Small shapes (the parity signal is structural, not scale) so the six
+# extra 8-device compiles stay inside the tier-1 wall-time budget.
+_SMALL = dict(embed_dim=32, num_heads=4, num_kv_heads=4, ff_dim=32,
+              num_layers=2, seq_len=16, vocab_size=64, batch=8,
+              capacity_factor=8.0)
+
+
+@pytest.fixture(scope="module")
+def small_baseline(eight_devices):
+    """One blocking-baseline step at lossless EP capacity, shared by
+    every overlap-parity test below (params/tokens included so all
+    variants step the same state)."""
+    cfg = spmd.SpmdConfig(**_SMALL)
+    _, _, step, params, tokens = spmd.build(8, cfg)
+    p0, l0 = step(params, tokens)
+    return params, tokens, p0, l0
+
+
+def _tree_max_diff(pa, pb):
+    diffs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                           - b.astype(jnp.float32)))),
+        pa, pb)
+    return max(jax.tree.leaves(diffs))
+
+
+@pytest.mark.parametrize("sp_mode", ["megatron", "ring", "ulysses"])
+def test_spmd_decomposed_tp_overlap_matches(small_baseline, sp_mode):
+    """tp_overlap=decomposed (ppermute-pipelined collective matmuls,
+    ops/collective_matmul.py) must reproduce the blocking psum path: in
+    megatron mode every TP projection decomposes (tight tolerance — the
+    only reordering is the ring reduce-scatter accumulation); in
+    ring/ulysses only the vocab-parallel head does, compared against the
+    megatron baseline at the established cross-mode tolerance."""
+    params, tokens, p0, l0 = small_baseline
+    cfg = spmd.SpmdConfig(sp_mode=sp_mode, tp_overlap="decomposed",
+                          tp_overlap_chunks=2, **_SMALL)
+    _, _, step, _, _ = spmd.build(8, cfg)
+    px, lx = step(params, tokens)
+    if sp_mode == "megatron":
+        assert float(lx) == pytest.approx(float(l0), rel=1e-5)
+        assert _tree_max_diff(px, p0) <= 1e-4
+    else:
+        assert float(lx) == pytest.approx(float(l0), rel=2e-3)
+        d8 = np.asarray(px["layers"]["wq"], dtype=np.float32)
+        d1 = np.asarray(p0["layers"]["wq"], dtype=np.float32)
+        np.testing.assert_allclose(d8, d1, rtol=0.05, atol=2e-4)
+
+
+def test_spmd_bucketed_grad_sync_matches(small_baseline):
+    """grad_sync=bucketed (reverse-layer-order per-bucket psums chained
+    with collectives.tie) is elementwise-identical math to the
+    monolithic sync — the whole updated param tree must agree leaf-wise
+    (grad-tree equality at fixed lr)."""
+    params, tokens, p0, l0 = small_baseline
+    cfg = spmd.SpmdConfig(grad_sync="bucketed", grad_bucket_layers=1,
+                          **_SMALL)
+    _, _, step, _, _ = spmd.build(8, cfg)
+    px, lx = step(params, tokens)
+    assert float(lx) == pytest.approx(float(l0), rel=1e-6)
+    assert _tree_max_diff(px, p0) <= 1e-6
+
+
+def test_spmd_decomposed_plus_bucketed_matches(small_baseline):
+    """Both overlap paths together (the bench/driver 'overlapped'
+    config), with a multi-layer bucket group."""
+    params, tokens, p0, l0 = small_baseline
+    cfg = spmd.SpmdConfig(tp_overlap="decomposed", tp_overlap_chunks=1,
+                          grad_sync="bucketed", grad_bucket_layers=2,
+                          **_SMALL)
+    _, _, step, _, _ = spmd.build(8, cfg)
+    px, lx = step(params, tokens)
+    assert float(lx) == pytest.approx(float(l0), rel=1e-5)
+    assert _tree_max_diff(px, p0) <= 1e-4
+
+
+def test_spmd_overlap_config_validation():
+    with pytest.raises(ValueError, match="tp_overlap"):
+        spmd.SpmdConfig(tp_overlap="magic").validate(2, 2, 2)
+    with pytest.raises(ValueError, match="grad_sync"):
+        spmd.SpmdConfig(grad_sync="eager").validate(2, 2, 2)
+    with pytest.raises(ValueError, match="chunks"):
+        spmd.SpmdConfig(tp_overlap_chunks=0).validate(2, 2, 2)
+    # A/B variants are defined for the megatron split only
+    mesh, *_ = spmd.build(8, spmd.SpmdConfig())
+    with pytest.raises(ValueError, match="variant"):
+        spmd.make_train_step(mesh, spmd.SpmdConfig(), variant="half")
+    with pytest.raises(ValueError, match="megatron"):
+        spmd.make_train_step(mesh, spmd.SpmdConfig(sp_mode="ring"),
+                             variant="comm")
+
+
 def test_spmd_ring_runs_with_indivisible_heads(eight_devices):
     """ring mode has no heads%tp constraint (all heads stay local)."""
     cfg = spmd.SpmdConfig(num_heads=3, num_kv_heads=3, embed_dim=48,
