@@ -432,6 +432,9 @@ def _run_bench(args, tracer) -> int:
                    card, hw_key, dev, "int8")
     fp8_ab = _aux("fp8 fused-quant A/B", _bench_quant_fused_ab,
                   card, hw_key, dev, "float8")
+    # cheap (tiny dp step, 3 interleaved rounds): the faulted-vs-clean
+    # straggler pairing — measured amplification of an injected delay
+    straggler = _aux("straggler A/B", _bench_straggler_ab)
     # LAST among the aux lines: they are the most expensive (a full
     # train-step compile+measure each) and the only ones with a known
     # backend-poisoning failure mode (the r5 composed-VJP OOM) —
@@ -483,6 +486,7 @@ def _run_bench(args, tracer) -> int:
         **({"int8_matmul": int8} if int8 else {}),
         **({"int8_fused_ab": int8_ab} if int8_ab else {}),
         **({"fp8_fused_ab": fp8_ab} if fp8_ab else {}),
+        **({"straggler_ab": straggler} if straggler else {}),
         **({"spmd_overlap_ab": overlap_ab} if overlap_ab else {}),
         **({"int8_step": int8_step} if int8_step else {}),
         **({"int8_switchback_step": int8_sb} if int8_sb else {}),
@@ -546,6 +550,71 @@ def _recommended_step(bf16_summary_s: dict, bf16_loss: float,
                          f"docs/studies/int8_step_r5)"),
         "candidates": entries,
     }
+
+
+def _bench_straggler_ab() -> dict | None:
+    """Paired faulted-vs-clean straggler A/B (ISSUE 5 satellite): the
+    dp proxy's bucketed-allreduce step at tiny scale, timed clean and
+    with a scripted per-step delay (faults/inject.py) injected INSIDE
+    the timed window, interleaved per round (the r4 pairing protocol —
+    adjacent measurement cancels drift).  The line reports both bands,
+    the injected delay, and the measured amplification
+    (inflation / injected delay): ~1.0 on a single-controller mesh
+    (the delay gates dispatch directly); on a multi-host mesh the same
+    A/B prices collective gating by a straggler host.  Needs >= 2
+    devices — one device has no collective to gate."""
+    from dlnetbench_tpu.core.model_stats import load_model_stats
+    from dlnetbench_tpu.faults.inject import FaultInjector
+    from dlnetbench_tpu.faults.plan import FaultEvent, FaultPlan
+    from dlnetbench_tpu.parallel.mesh import make_flat_mesh
+    from dlnetbench_tpu.proxies import dp as dp_proxy
+    from dlnetbench_tpu.proxies.base import ProxyConfig
+    from dlnetbench_tpu.utils.timing import time_chain
+
+    n = len(jax.devices())
+    if n < 2:
+        _skipped("straggler A/B",
+                 f"needs >= 2 devices, have {n} — no collective for a "
+                 f"straggler to gate")
+        return None
+    cfg = ProxyConfig(size_scale=1e-3, time_scale=1e-3)
+    bundle = dp_proxy.build(load_model_stats("gpt2_l_16_bfloat16"), 2, cfg,
+                            mesh=make_flat_mesh(devices=jax.devices()),
+                            dtype=jnp.float32)
+    k, rounds = 4, 3
+    # calibrate the injected delay against the clean step so the signal
+    # clears the tunnel noise: ~3x a clean step, floored at 2 ms
+    warm_s = time_chain(bundle.full, k=k)
+    delay_us = max(3 * warm_s * 1e6, 2000.0)
+    plan = FaultPlan(events=[FaultEvent(kind="delay", ranks=[1],
+                                        magnitude_us=delay_us)]).validate()
+    injector = FaultInjector(plan)
+
+    def faulted_step():
+        injector.before_step()
+        return bundle.full()
+
+    clean_s, faulted_s = [], []
+    for _ in range(rounds):  # interleaved: adjacent in time per round
+        clean_s.append(time_chain(bundle.full, k=k))
+        faulted_s.append(time_chain(faulted_step, k=k))
+    clean = stats_mod.summarize(clean_s)
+    faulted = stats_mod.summarize(faulted_s)
+    amp = (faulted["value"] - clean["value"]) / (delay_us / 1e6)
+    line = {
+        "metric": "straggler A/B (dp step, faulted vs clean)",
+        "value": round(amp, 3),
+        "unit": "x (step inflation / injected delay)",
+        "injected_ms": round(delay_us / 1e3, 3),
+        "clean_ms": {"value": round(clean["value"] * 1e3, 3),
+                     **_band_ms(clean)},
+        "faulted_ms": {"value": round(faulted["value"] * 1e3, 3),
+                       **_band_ms(faulted)},
+        "n": rounds,
+        "world": n,
+    }
+    print(json.dumps(line))
+    return line
 
 
 def _bench_overlap_ab() -> dict | None:

@@ -55,6 +55,102 @@ def transport_of(rec: dict) -> str:
     return "unknown"
 
 
+def _plan_of(rec: dict):
+    """The record's fault plan as a ``faults.plan.FaultPlan`` — ONE
+    implementation of the window/delay arithmetic for both the harness
+    and this analysis layer.  None when absent or unparseable (a
+    malformed plan must degrade to 'no fault columns', never crash an
+    unrelated bandwidth report)."""
+    raw = rec.get("global", {}).get("fault_plan")
+    if not raw or not raw.get("events"):
+        return None
+    from dlnetbench_tpu.faults.plan import FaultPlan
+    try:
+        return FaultPlan.from_dict(raw)
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+def _fault_run_window(rec: dict):
+    """``(start_step, end_step, steps_per_sample)`` for the record's
+    fault plan (``global.fault_plan``, faults/plan.py schema): the
+    MEASURED-step window [start, end) with any live event (``end``
+    None = open) plus how many harness steps each timer sample spans
+    (``reps_per_fence`` — one fence chain contributes one sample for K
+    steps on the python tier; native records are always 1).  Plan
+    triggers count every step INCLUDING warmup, so the warmup length
+    (``warmup_times``; the first process's entry on merged records)
+    rebases step units onto the measured region.  None = no plan."""
+    plan = _plan_of(rec)
+    window = plan.fault_window() if plan is not None else None
+    if window is None:
+        return None
+    start, end = window
+    warm = rec.get("warmup_times")
+    if warm is None:
+        by_proc = rec.get("warmup_times_by_process") or {}
+        warm = next(iter(by_proc.values()), [])
+    w = len(warm)
+    k = max(int(rec.get("global", {}).get("reps_per_fence", 1) or 1), 1)
+    return (max(0, start - w), None if end is None else max(0, end - w), k)
+
+
+def _run_faulted(window, run: int) -> bool:
+    """Sample ``run`` covers measured steps [run*k, (run+1)*k); it is
+    faulted when that range intersects the window — a chain with ANY
+    faulted step carries injected latency and must not pass as clean."""
+    if window is None:
+        return False
+    s, e, k = window
+    lo, hi = run * k, (run + 1) * k
+    return hi > s and (e is None or lo < e)
+
+
+def straggler_amplification(rec: dict) -> float:
+    """How much ONE straggler's injected delay cost the whole step:
+
+        (median faulted runtime - median clean runtime) / injected delay
+
+    ~1.0 means the collective gated exactly on the straggler (the delay
+    passed straight through); > 1 means amplification (the delay also
+    broke overlap/pipelining); < 1 means partial hiding.  Computed
+    entirely in-record: the runs before the fault window are the clean
+    baseline, the plan's declared per-step delay (delay magnitude +
+    jitter/2, max over target ranks, step-scoped events) is the
+    denominator.  NaN when the record has no delay fault, no clean
+    runs, or a crash (a shrunk world has no comparable baseline)."""
+    plan = _plan_of(rec)
+    if plan is None:
+        return float("nan")
+    kinds = {e.kind for e in plan.events}
+    if not kinds & {"delay", "jitter"} or kinds & {"crash", "partition"}:
+        return float("nan")
+    # per-step injected delay (faults/plan.py: max over target ranks —
+    # parallel sleeps gate on the slowest rank, never on the sum)
+    injected = plan.delay_per_step_us()
+    window = _fault_run_window(rec)
+    clean, faulted, measured_inj = [], [], []
+    for row in rec.get("ranks", []):
+        fd = row.get("fault_delay_us")
+        for i, v in enumerate(row.get("runtimes", [])):
+            if _run_faulted(window, i):
+                faulted.append(v)
+                if fd is not None and i < len(fd):
+                    measured_inj.append(fd[i])
+            else:
+                clean.append(v)
+    import statistics
+    # prefer the MEASURED per-sample injected delay (the python tier's
+    # fault_delay_us timer — already per-iteration, correct even when a
+    # fence chain mixes clean and faulted steps) over the plan-declared
+    # figure (exact on the native tier, where one sample = one step)
+    if measured_inj and max(measured_inj) > 0:
+        injected = statistics.median(measured_inj)
+    if injected <= 0 or not clean or not faulted:
+        return float("nan")
+    return (statistics.median(faulted) - statistics.median(clean)) / injected
+
+
 def bus_factor(kind: str, n: int) -> float:
     n = max(int(n), 1)
     if kind == "allreduce":
@@ -80,6 +176,17 @@ def effective_bandwidth(records: list[dict]):
         if not model:
             continue
         transport = transport_of(rec)
+        # fault provenance (faults/, native fault_plan.hpp): runs inside
+        # the plan's live window get busbw REFUSED (bound "faulted",
+        # like the fullmesh refusal — a step serialized behind an
+        # injected sleep, or running on a shrunken group the declared
+        # comm_model no longer describes, prices recovery, not fabric
+        # bandwidth); the recovery-cost and straggler-amplification
+        # figures ride every row so the summary can state them
+        fault_window = _fault_run_window(rec)
+        detection_ms = float(g.get("detection_ms", float("nan")))
+        recovery_ms = float(g.get("recovery_ms", float("nan")))
+        straggler_amp = straggler_amplification(rec)
         for rank_row in rec.get("ranks", []):
             # measured comm–compute overlap fraction (schema v2+,
             # proxies/base.py): one dimensionless sample per run, riding
@@ -150,6 +257,9 @@ def effective_bandwidth(records: list[dict]):
                 for run, t_us in enumerate(times):
                     if not t_us > 0:
                         continue
+                    run_bound = ("faulted"
+                                 if _run_faulted(fault_window, run)
+                                 else bound)
                     rows.append({
                         "section": rec.get("section"),
                         "model": g.get("model"),
@@ -162,15 +272,19 @@ def effective_bandwidth(records: list[dict]):
                         "time_us": float(t_us),
                         "algbw_GBps": total / (t_us * 1e-6) / 1e9,
                         "busbw_GBps": (float("nan")
-                                       if bound in ("fullmesh",
-                                                    "hierarchical")
+                                       if run_bound in ("fullmesh",
+                                                        "hierarchical",
+                                                        "faulted")
                                        else bus_total / (t_us * 1e-6)
                                        / 1e9),
-                        "bound": bound,
+                        "bound": run_bound,
                         "transport": transport,
                         "overlap": (float(ov[run])
                                     if ov is not None and run < len(ov)
                                     else float("nan")),
+                        "detection_ms": detection_ms,
+                        "recovery_ms": recovery_ms,
+                        "straggler_amp": straggler_amp,
                     })
     return pd.DataFrame(rows)
 
@@ -179,15 +293,19 @@ def bandwidth_summary(records: list[dict]):
     """Mean per (section, model, collective): the north-star table.
     Carries the ``bound`` marker so lower-bound rows stay labeled, the
     ``transport`` provenance so a loopback/virtual-mesh mean can never
-    be averaged into (or mistaken for) a fabric figure, and the mean
+    be averaged into (or mistaken for) a fabric figure, the mean
     measured ``overlap`` fraction (NaN where the record's run didn't
     measure the A/B decomposition) so every bandwidth figure says how
-    much of that traffic compute actually hid."""
+    much of that traffic compute actually hid, and the fault columns —
+    ``straggler_amp`` (observed inflation / injected delay),
+    ``detection_ms`` / ``recovery_ms`` (the priced crash-recovery path)
+    — NaN on clean records.  Faulted runs group under bound="faulted"
+    with busbw refused, keeping the clean runs' mean uncontaminated."""
     bw = effective_bandwidth(records)
     if bw.empty:
         return bw
     return (bw.groupby(["section", "model", "collective", "group_size",
                         "bound", "transport"])
             [["time_us", "msg_bytes", "algbw_GBps", "busbw_GBps",
-              "overlap"]]
+              "overlap", "straggler_amp", "detection_ms", "recovery_ms"]]
             .mean().reset_index())

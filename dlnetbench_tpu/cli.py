@@ -83,6 +83,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "the sweep driver tags each grid point this way — "
                         "the role of sbatchman job.variables in the "
                         "reference, plots/parser.py:238)")
+    p.add_argument("--fault", default=None, metavar="PLAN",
+                   help="JSON fault plan (inline or @path; "
+                        "dlnetbench_tpu/faults/plan.py schema, shared "
+                        "with the native binaries): delay/jitter/crash "
+                        "events injected at step boundaries with "
+                        "deterministic triggers; the record stamps the "
+                        "plan + recovery columns (docs/RESILIENCE.md)")
+    p.add_argument("--fault_policy", default=None,
+                   choices=["fail_fast", "retry", "shrink"],
+                   help="degradation policy on a scripted failure: "
+                        "fail_fast (crash propagates), retry (bounded "
+                        "backoff, same world), shrink (rebuild on the "
+                        "survivor devices and finish degraded); "
+                        "default: the plan's own policy")
 
 
 def _cfg(args) -> ProxyConfig:
@@ -256,7 +270,40 @@ def _run_measured(args, parser, stats, cfg, devices, dtype, dtype_name,
     bundle.global_meta["buffer_dtype"] = dtype_name
     if variables:
         bundle.global_meta["variables"] = variables
-    result = run_proxy(args.proxy, bundle, cfg)
+    if args.fault:
+        from dlnetbench_tpu.faults.plan import FaultPlan
+        from dlnetbench_tpu.faults.policy import run_faulted
+        # usage errors (malformed/invalid plan, unreadable @file,
+        # plan/config conflicts) report as CLI errors; failures INSIDE
+        # the measured run must keep their tracebacks — masking a JAX
+        # error as 'bad --fault flag' would bury the real cause
+        try:
+            plan = FaultPlan.loads(args.fault)
+            if args.fault_policy:
+                plan.policy = args.fault_policy
+            plan.validate()
+        except (ValueError, OSError, KeyError) as e:
+            parser.error(f"--fault: {e}")
+        try:
+            plan.check_config(cfg)
+        except ValueError as e:
+            parser.error(str(e))
+
+        def rebuild(survivors):
+            # shrink: the proxy rebuilds over the survivor devices
+            # (recompile cost lands in recovery_ms, where it belongs);
+            # rank ids keep their original numbering via the record's
+            # degraded_world
+            devs = _devices(args, parser)
+            return _build_bundle(args, parser, stats, cfg,
+                                 [devs[i] for i in survivors], dtype)
+
+        with spans.span("faulted_run", proxy=args.proxy,
+                        policy=plan.policy):
+            result = run_faulted(args.proxy, bundle, cfg, plan,
+                                 rebuild=rebuild, world=len(devices))
+    else:
+        result = run_proxy(args.proxy, bundle, cfg)
 
     # the profile/trace channels are AUXILIARY to the record: the timed
     # runs above are already measured, and no trace failure may cost

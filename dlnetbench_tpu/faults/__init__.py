@@ -1,0 +1,33 @@
+"""Fault-injection & elastic degradation — provoking the failures the
+detection layer (utils/watchdog.py, the native per-peer death tracking)
+can only observe.
+
+One JSON fault-plan schema serves both tiers (``plan.py`` here; the
+native ``fault_plan.hpp`` parses the same shape, and ``--fault`` on
+every native binary / the python CLI takes it verbatim):
+
+    {"policy": "fail_fast" | "retry" | "shrink",
+     "events": [{"kind": "delay|jitter|drop|crash|partition",
+                 "ranks": [..], "iteration": K, "until": -1,
+                 "magnitude_us": .., "rate": .., "seed": ..}, ...]}
+
+* ``plan``   — the serializable schedule (validation, round-trip,
+               window arithmetic for the analysis layer).
+* ``inject`` — the python-tier injector: step-boundary delay/jitter
+               sleeps and scripted ``RankFailure`` crashes
+               (``ProxyConfig.fault_injector``), plus the eager
+               per-collective hook ``parallel.collectives`` exposes.
+* ``policy`` — the degradation harness around ``run_proxy``:
+               fail_fast / retry / shrink with measured ``detection_ms``
+               / ``recovery_ms`` and ``degraded_world`` stamped into the
+               record (schema-v2 compatible; ``metrics.merge`` accepts
+               the shrunken rank set through its degraded pathway).
+
+See docs/RESILIENCE.md for how to read the recovery columns.
+"""
+from dlnetbench_tpu.faults.inject import FaultInjector, RankFailure
+from dlnetbench_tpu.faults.plan import FaultEvent, FaultPlan
+from dlnetbench_tpu.faults.policy import run_faulted
+
+__all__ = ["FaultEvent", "FaultPlan", "FaultInjector", "RankFailure",
+           "run_faulted"]

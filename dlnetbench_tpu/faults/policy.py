@@ -1,0 +1,164 @@
+"""Degradation policies around ``run_proxy`` — the python-tier harness
+that survives a scripted fault and prices the recovery.
+
+``run_faulted`` drives a proxy bundle under a FaultPlan:
+
+  * fail_fast — delay/jitter inflate the measured steps (the straggler
+    signal rides the ordinary runtime samples + ``fault_delay_us``
+    timer); a crash propagates as RankFailure, like today.
+  * retry     — the scripted failure is treated as transient: after a
+    bounded exponential backoff the run resumes on the SAME world and
+    finishes; ``fault_retries`` counts the re-issues.
+  * shrink    — the run is segmented around the scripted death: the
+    pre-crash steps run on the full world, the RankFailure is caught,
+    the caller's ``rebuild(survivors)`` callback produces a bundle over
+    the survivor devices (the FSDP/DP proxies rebuild their mesh), and
+    the remaining steps finish degraded.  ``detection_ms`` (crash raise
+    -> policy catch; ~instant on a single controller, measured not
+    assumed), ``recovery_ms`` (rebuild + recompile + first successful
+    survivor step), and ``degraded_world`` are stamped into the
+    record's globals — schema-v2 compatible, merged by
+    ``metrics.merge``'s degraded pathway, surfaced as recovery-cost
+    columns by ``analysis.bandwidth``.
+
+The plan's step counter covers warmup too (native parity), so crash
+triggers must land in the measured region for the segmented policies:
+``iteration >= warmup`` (validated here, not silently misread).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from dlnetbench_tpu.faults.inject import FaultInjector, RankFailure
+from dlnetbench_tpu.faults.plan import FaultPlan
+from dlnetbench_tpu.proxies.base import ProxyConfig, ProxyResult, run_proxy
+
+# bounded backoff for the retry policy (base doubles per attempt)
+RETRY_BACKOFF_S = 0.05
+MAX_RETRIES = 3
+
+
+def _concat_results(name: str, segments: list[ProxyResult]) -> ProxyResult:
+    """Concatenate per-iteration timers across run segments (keys that
+    every segment recorded — a timer one segment never fired would
+    desync the per-run validation)."""
+    live = [s for s in segments if s.num_runs > 0] or segments[:1]
+    keys = set(live[0].timers_us)
+    for s in live[1:]:
+        keys &= set(s.timers_us)
+    timers = {k: [v for s in segments for v in s.timers_us.get(k, [])]
+              for k in sorted(keys)}
+    return ProxyResult(
+        name=name,
+        global_meta=segments[-1].global_meta,
+        timers_us=timers,
+        warmup_times_us=segments[0].warmup_times_us,
+        num_runs=sum(s.num_runs for s in segments),
+    )
+
+
+def run_faulted(name: str, bundle, cfg: ProxyConfig, plan: FaultPlan, *,
+                rebuild=None, world: int | None = None) -> ProxyResult:
+    """Run ``bundle`` under ``plan`` with the plan's policy; returns a
+    ProxyResult whose global_meta carries the fault provenance.
+
+    ``rebuild(survivor_ranks) -> StepBundle`` is required for the
+    shrink policy (the proxy rebuilds over the survivor devices);
+    ``world`` defaults to the bundle's ``world_size`` global.
+    """
+    plan.validate()
+    world = world or int(bundle.global_meta.get("world_size", 0))
+    injector = FaultInjector(plan, world=world or None)
+    cfg_i = dataclasses.replace(cfg, fault_injector=injector)
+
+    def stamp(result: ProxyResult, **extra) -> ProxyResult:
+        result.global_meta["fault_plan"] = plan.to_dict()
+        result.global_meta["fault_policy"] = plan.policy
+        result.global_meta["fault_injected_delay_us"] = round(
+            injector.injected_delay_us, 1)
+        result.global_meta.update(extra)
+        return result
+
+    crash_at = plan.first_crash_iteration()
+    if crash_at is None or plan.policy == "fail_fast":
+        # nothing to survive: delays ride the samples, crashes propagate
+        return stamp(run_proxy(name, bundle, cfg_i))
+
+    warm = max(cfg.warmup, 1)
+    plan.check_config(cfg)  # reps_per_fence/min_exectime/warmup guards
+
+    pre = min(cfg.runs, crash_at - warm)
+    if pre >= cfg.runs:  # trigger beyond the run: nothing ever fires
+        return stamp(run_proxy(name, bundle, cfg_i))
+
+    seg1 = run_proxy(name, bundle,
+                     dataclasses.replace(cfg_i, runs=pre, min_exectime_s=0))
+
+    # the scripted death, caught at the policy layer
+    try:
+        injector.before_step()
+        raise RuntimeError("fault plan: crash trigger did not fire at "
+                           f"iteration {crash_at}")
+    except RankFailure as e:
+        failure = e  # survive the except-block name cleanup
+        detection_ms = (time.monotonic() - injector.crash_raised_at) * 1e3
+
+    remaining = cfg.runs - pre
+    if plan.policy == "retry":
+        # transient-failure semantics: bounded backoff, same world
+        retries = 0
+        t0 = time.monotonic()
+        while True:
+            retries += 1
+            time.sleep(RETRY_BACKOFF_S * (2 ** (retries - 1)))
+            try:
+                seg2 = run_proxy(name, bundle,
+                                 dataclasses.replace(cfg_i, runs=remaining,
+                                                     warmup=1,
+                                                     min_exectime_s=0))
+                break
+            except RankFailure:
+                if retries >= MAX_RETRIES:
+                    raise
+        recovery_ms = (time.monotonic() - t0) * 1e3
+        return stamp(_concat_results(name, [seg1, seg2]),
+                     detection_ms=round(detection_ms, 3),
+                     recovery_ms=round(recovery_ms, 3),
+                     fault_retries=retries,
+                     fault_iteration=failure.iteration)
+
+    # shrink: rebuild over the survivors and finish degraded
+    if rebuild is None:
+        raise ValueError("fault plan: the shrink policy needs a "
+                         "rebuild(survivor_ranks) callback")
+    if not world:
+        raise ValueError("fault plan: shrink needs the world size "
+                         "(bundle.global_meta['world_size'] or world=)")
+    survivors = plan.survivors(world)
+    t0 = time.monotonic()
+    bundle2 = rebuild(survivors)
+    rebuild_ms = (time.monotonic() - t0) * 1e3
+    seg2 = run_proxy(name, bundle2,
+                     dataclasses.replace(cfg_i, runs=remaining, warmup=1,
+                                         min_exectime_s=0))
+    # recovery ends at the first successful survivor-group step: the
+    # rebuild (mesh + recompile) plus the first warmup execution
+    recovery_ms = rebuild_ms + (seg2.warmup_times_us[0] / 1e3
+                                if seg2.warmup_times_us else 0.0)
+    merged = _concat_results(name, [seg1, seg2])
+    # seg2's globals describe the survivor mesh (its device rows ARE the
+    # survivor rows); the record still declares the ORIGINAL world, with
+    # degraded_world naming who is left (emit relabels rank ids).  Keys
+    # stamped onto the ORIGINAL bundle after build (buffer_dtype, sweep
+    # variables, ...) are carried over — the rebuilt bundle never saw
+    # them, and a degraded record losing its sweep tags would fall out
+    # of the study's grid grouping.
+    for k, v in bundle.global_meta.items():
+        merged.global_meta.setdefault(k, v)
+    merged.global_meta["world_size"] = world
+    return stamp(merged,
+                 detection_ms=round(detection_ms, 3),
+                 recovery_ms=round(recovery_ms, 3),
+                 degraded_world=survivors,
+                 fault_iteration=failure.iteration)

@@ -104,10 +104,20 @@ def result_to_record(result: ProxyResult) -> dict:
     # shared across the per-device rows like the arrays themselves.
     summary = {name: summarize(vals, ndigits=3)
                for name, vals in result.timers_us.items()}
+    # degraded (shrunk) runs: the surviving devices keep their ORIGINAL
+    # rank ids — row i of the survivor mesh is global rank
+    # degraded_world[i], so a merged/analyzed record never renumbers
+    # the survivors into a fake dense world (faults/policy.py)
+    rank_ids = result.global_meta.get("degraded_world")
+    if rank_ids is not None and len(rank_ids) != len(devices):
+        raise ValueError(
+            f"degraded_world names {len(rank_ids)} survivors but the "
+            f"mesh has {len(devices)} devices — the shrink rebuild and "
+            f"the plan disagree")
     ranks = []
     for i, dev in enumerate(devices):
         row = {
-            "rank": i,
+            "rank": int(rank_ids[i]) if rank_ids is not None else i,
             "device_id": dev.get("id", i),
             "process_index": dev.get("process", 0),
             "hostname": hostname,

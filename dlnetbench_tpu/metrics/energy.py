@@ -32,6 +32,101 @@ import time
 POWER_SAMPLING_RATE_MS = 5   # reference dp.cpp:67
 
 
+class TpuChipSampler:
+    """Best-effort TPU *chip* energy counter (VERDICT r5 #7).
+
+    TPU chips expose no public per-chip energy counter through JAX/PJRT
+    today, but that is a probed fact, not an assumption — this sampler
+    attempts every plausible channel and reports what it found:
+
+      1. PJRT/libtpu device attributes via jax: any attribute whose
+         name mentions energy/power on a TPU device (none exist in
+         current libtpu releases; ``probe_notes`` records the attribute
+         names actually seen so a future libtpu that adds one is
+         noticed, not silently missed).
+      2. sysfs hwmon: a device whose ``name`` mentions tpu, with
+         cumulative ``energy*_input`` channels (microjoules).
+      3. the accel class: ``/sys/class/accel/accel*/device/energy_uj``.
+
+    When a counter exists the record's ``energy_source`` reads ``tpu``
+    and the figures are chip-side.  When every probe misses (the
+    current state on Cloud TPU images — docs/PERF.md documents the dead
+    end), the chain falls through to the HOST samplers below, whose
+    axes are host-side by construction and labeled so."""
+
+    def __init__(self, hwmon_root: str = "/sys/class/hwmon",
+                 accel_root: str = "/sys/class/accel"):
+        self.probe_notes: list[str] = []
+        self._files: list[str] = []
+        # 1. PJRT device attributes (only meaningful on a TPU backend;
+        # cheap and exception-safe elsewhere)
+        try:
+            import jax
+            devs = jax.devices()
+            if devs and devs[0].platform == "tpu":
+                attrs = [a for a in dir(devs[0]) if not a.startswith("_")]
+                hits = [a for a in attrs
+                        if "energy" in a.lower() or "power" in a.lower()]
+                if hits:
+                    self.probe_notes.append(
+                        f"pjrt device attributes matched: {hits}")
+                else:
+                    self.probe_notes.append(
+                        f"pjrt tpu device exposes no energy/power "
+                        f"attribute ({len(attrs)} attributes probed)")
+        except Exception:
+            self.probe_notes.append("jax/pjrt probe unavailable")
+        # 2. tpu-named hwmon with cumulative energy channels
+        for path in sorted(glob.glob(f"{hwmon_root}/hwmon*")):
+            try:
+                with open(f"{path}/name") as f:
+                    name = f.read().strip()
+            except OSError:
+                continue
+            if "tpu" not in name.lower():
+                continue
+            chans = sorted(glob.glob(f"{path}/energy*_input"))
+            if chans:
+                self._files.extend(chans)
+                self.probe_notes.append(
+                    f"hwmon {name}: {len(chans)} energy channel(s)")
+            else:
+                self.probe_notes.append(
+                    f"hwmon {name}: present but no energy*_input")
+        # 3. accel-class cumulative counters
+        for path in sorted(glob.glob(f"{accel_root}/accel*/device/energy_uj")):
+            self._files.append(path)
+            self.probe_notes.append(f"accel counter: {path}")
+        if not self._files:
+            self.probe_notes.append("no TPU chip energy counter found")
+        self.source = "tpu"
+        self._last: list[float] = []
+        self._acc = 0.0
+        if self._files:
+            self._last = [self._read_raw(i)
+                          for i in range(len(self._files))]
+
+    @property
+    def available(self) -> bool:
+        return bool(self._files)
+
+    def _read_raw(self, i: int) -> float:
+        with open(self._files[i]) as f:
+            return float(f.read())
+
+    def read_joules(self) -> float:
+        """Monotonic cumulative joules summed over chip counters
+        (counters are cumulative uJ; a wrapped/reset counter drops that
+        sample rather than going backwards)."""
+        for i in range(len(self._files)):
+            cur = self._read_raw(i)
+            delta = cur - self._last[i]
+            if delta > 0:
+                self._acc += delta
+            self._last[i] = cur
+        return self._acc / 1e6
+
+
 class RaplSampler:
     """Cumulative joules from Linux RAPL package domains."""
 
@@ -189,11 +284,20 @@ _PROBED = False
 
 
 def detect_sampler():
-    """Best available host energy source, or None (cached per process)."""
+    """Best available energy source, or None (cached per process).
+    Chip-side beats host-side: a real TPU chip counter (energy_source
+    ``tpu``) wins over host RAPL/hwmon — on current images the TPU
+    probe is a documented dead end (docs/PERF.md) and the chain falls
+    through to the host counters, whose records say ``rapl``/``hwmon:*``
+    so their figures are never read as chip energy."""
     global _CACHED, _PROBED
     if _PROBED:
         return _CACHED
     _PROBED = True
+    tpu = TpuChipSampler()
+    if tpu.available:
+        _CACHED = tpu
+        return _CACHED
     rapl = RaplSampler()
     if rapl.available:
         rapl.source = "rapl"

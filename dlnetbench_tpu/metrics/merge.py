@@ -37,7 +37,17 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      # per-PROCESS share of an uneven-locals hier run
                      # (world % procs != 0): differs by construction;
                      # the process-invariant layout rides "local_worlds"
-                     "local_world"}
+                     "local_world",
+                     # per-process fault measurements (faults/,
+                     # fault_plan.hpp): each process detects/recovers
+                     # on its own clock and counts its own injected
+                     # drops/retries/sleeps; the PLAN ITSELF
+                     # (fault_plan/fault_policy/degraded_world) must
+                     # still match — different plans ARE different runs
+                     "detection_ms", "recovery_ms", "fault_drops",
+                     "fault_retries", "fault_injected_delay_us",
+                     "fault_iteration", "watchdog_heartbeat_age_s",
+                     "watchdog_stalls"}
 
 # scheduler-stamped variables that identify the PROCESS, not the run
 # (metrics.emit.scheduler_variables): they legitimately differ between
@@ -78,7 +88,15 @@ def merge_records(records: list[dict]) -> dict:
 
     base = by_process.get(0)
     if base is None:
-        raise ValueError("merge_records: no record from process 0")
+        # degraded pathway (fault-plan shrink runs): rank 0's process
+        # may BE the scripted victim — record-less by design.  Accept
+        # the lowest surviving process as the base iff the survivors
+        # themselves declare the degradation; anything else is still a
+        # missing host.
+        first = by_process[min(by_process)]
+        if first["global"].get("degraded_world") is None:
+            raise ValueError("merge_records: no record from process 0")
+        base = first
     want = _comparable_global(base["global"])
     for proc, rec in sorted(by_process.items()):
         if rec.get("section") != base.get("section"):
@@ -106,10 +124,20 @@ def merge_records(records: list[dict]) -> dict:
                 f"harness builds")
 
     declared = base["global"].get("num_processes")
+    degraded = base["global"].get("degraded_world")
     if declared is not None and sorted(by_process) != list(range(declared)):
-        raise ValueError(
-            f"merge_records: have records from processes {sorted(by_process)}"
-            f", expected range({declared}) — a host's output is missing")
+        if degraded is None:
+            raise ValueError(
+                f"merge_records: have records from processes "
+                f"{sorted(by_process)}, expected range({declared}) — a "
+                f"host's output is missing")
+        # shrink run: dead ranks' processes emit nothing.  The survivor
+        # records must still jointly cover degraded_world exactly (the
+        # final validate_record), so a missing SURVIVOR is still caught.
+        if any(p < 0 or p >= declared for p in by_process):
+            raise ValueError(
+                f"merge_records: process ids {sorted(by_process)} outside "
+                f"range({declared})")
 
     ranks = []
     for proc, rec in sorted(by_process.items()):

@@ -42,11 +42,30 @@ def validate_record(rec: dict) -> None:
     """Every device rank must have reported (reference
     plots/parser.py:102-136 'did every rank report'), every declared
     process must be represented, and the host set must be plausible for
-    the process count (the reference's hostname-vs-node-count check)."""
+    the process count (the reference's hostname-vs-node-count check).
+
+    Degraded pathway: a record whose globals declare ``degraded_world``
+    (a fault-plan ``shrink`` run — faults/, native fault_plan.hpp) must
+    cover exactly the SURVIVOR rank set instead of range(world), and
+    processes owned entirely by dead ranks may legitimately be absent.
+    Only an explicit declaration relaxes the checks — a record missing
+    ranks without saying why still fails."""
     world = rec["global"].get("world_size")
     rows = rec.get("ranks", [])
     ranks = [r["rank"] for r in rows]
-    if world is not None and sorted(ranks) != list(range(world)):
+    degraded = rec["global"].get("degraded_world")
+    if degraded is not None:
+        degraded = sorted(int(r) for r in degraded)
+        if world is not None and not all(0 <= r < world for r in degraded):
+            raise ValueError(
+                f"record for {rec.get('section')}: degraded_world "
+                f"{degraded} outside range({world})")
+        if sorted(ranks) != degraded:
+            raise ValueError(
+                f"record for {rec.get('section')}/"
+                f"{rec['global'].get('model')}: rank set {sorted(ranks)} "
+                f"!= declared degraded_world {degraded}")
+    elif world is not None and sorted(ranks) != list(range(world)):
         raise ValueError(
             f"record for {rec.get('section')}/{rec['global'].get('model')}: "
             f"rank set {sorted(ranks)} != range({world})")
@@ -68,7 +87,15 @@ def validate_record(rec: dict) -> None:
     num_procs = rec["global"].get("num_processes")
     if num_procs is not None:
         procs = sorted({row.get("process_index", 0) for row in rows})
-        if procs != list(range(num_procs)):
+        if degraded is not None:
+            # a dead rank's process (tcp: one rank per process) emits
+            # nothing; the surviving processes must still be a sane
+            # subset of the declared set
+            if not procs or not all(0 <= p < num_procs for p in procs):
+                raise ValueError(
+                    f"record for {rec.get('section')}: degraded process "
+                    f"coverage {procs} outside range({num_procs})")
+        elif procs != list(range(num_procs)):
             raise ValueError(
                 f"record for {rec.get('section')}: process coverage "
                 f"{procs} != range({num_procs}) — a host did not report")

@@ -22,6 +22,28 @@ from jax import lax
 
 from dlnetbench_tpu.utils.jax_compat import axis_size as _axis_size
 
+# --- fault-injection hook (faults/inject.py) --------------------------- #
+# A module-level hook called at every collective wrapper invocation with
+# (op_name, axis).  For EAGER callers it injects per-collective faults
+# (delay sleeps, scripted failures); inside a jit/shard_map trace the
+# wrapper runs at TRACE time only, so compiled steps see nothing — the
+# per-iteration channel (ProxyConfig.fault_injector) is the measurable
+# injection point on this tier (docs/RESILIENCE.md).  The native tier's
+# equivalent hook (fault_plan.hpp on_collective) fires per EXECUTION.
+_FAULT_HOOK = None
+
+
+def set_fault_hook(fn) -> None:
+    """Install ``fn(op_name, axis)`` as the pre-collective fault hook
+    (None clears it)."""
+    global _FAULT_HOOK
+    _FAULT_HOOK = fn
+
+
+def _maybe_fault(op: str, axis: str) -> None:
+    if _FAULT_HOOK is not None:
+        _FAULT_HOOK(op, axis)
+
 
 def tie(value, dep):
     """Return ``value`` with a scheduling dependency on ``dep`` (both must
@@ -41,12 +63,14 @@ def fence(*values):
 def allreduce(x, axis: str):
     """Sum-allreduce over a mesh axis (reference Allreduce,
     proxy_classes.hpp:36-37; MPI_SUM hardcoded at :67)."""
+    _maybe_fault("allreduce", axis)
     return lax.psum(x, axis)
 
 
 def allgather(x, axis: str, tiled: bool = True):
     """Concatenating allgather (reference Allgather/Iallgather,
     proxy_classes.hpp:38-39; used for FSDP unit gathers fsdp.cpp:86-100)."""
+    _maybe_fault("allgather", axis)
     return lax.all_gather(x, axis, tiled=tiled)
 
 
@@ -54,6 +78,7 @@ def reduce_scatter(x, axis: str):
     """Block reduce-scatter (reference Reduce_Scatter_block,
     proxy_classes.hpp:40; FSDP gradient shard fsdp.cpp:123-127).
     Input length must divide evenly by the axis size."""
+    _maybe_fault("reduce_scatter", axis)
     return lax.psum_scatter(x, axis, tiled=True)
 
 
@@ -61,6 +86,7 @@ def alltoall(x, axis: str, split_axis: int = 0, concat_axis: int = 0):
     """All-to-all (reference Alltoall, proxy_classes.hpp:41; MoE token
     dispatch/combine hybrid_3d_moe.cpp:161-165).  ``x``'s ``split_axis``
     dim must be divisible by the axis size."""
+    _maybe_fault("alltoall", axis)
     return lax.all_to_all(x, axis, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
 
@@ -70,6 +96,7 @@ def ring_shift(x, axis: str, shift: int = 1):
     (the p2p idiom on TPU: there is no send/recv primitive, so pipeline
     hops (reference hybrid_2d.cpp:109-132) and ring-attention KV rotation
     are ``ppermute`` steps over the axis)."""
+    _maybe_fault("ring_shift", axis)
     n = _axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
@@ -104,4 +131,5 @@ def axis_index(axis: str):
 def barrier(axis: str):
     """Full-axis rendezvous: a 1-element psum nothing depends on for math,
     used where the reference calls MPI_Barrier (dp.cpp:234)."""
+    _maybe_fault("barrier", axis)
     return lax.psum(jnp.ones((), jnp.float32), axis)

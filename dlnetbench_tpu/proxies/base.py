@@ -64,6 +64,20 @@ class ProxyConfig:
     # RTT amortize over K iterations instead of biasing every sample
     # (utils/timing.py time_chain); 1 = the reference's fence-per-rep
     reps_per_fence: int = 1
+    # faults.inject.FaultInjector (or None): step-boundary fault
+    # injection — the FULL step callable is wrapped so delay/jitter
+    # sleeps land INSIDE the timed window (a straggler must inflate the
+    # runtime sample, exactly as the native tier's in-step injection
+    # does) and scripted RankFailures fire at their trigger iteration.
+    # The compute/comm A/B legs stay unwrapped: they are the CLEAN
+    # decomposition baseline, and only full-step invocations advance
+    # the plan's iteration counter (native step-count parity).
+    fault_injector: object | None = None
+    # utils.watchdog.StepWatchdog (or None): arms around every fenced
+    # chain and beats a per-phase heartbeat, stamped into the record
+    # (watchdog_heartbeat_age_s) so post-mortems of hung runs show
+    # where progress stopped.
+    watchdog: object | None = None
 
 
 @dataclasses.dataclass
@@ -117,12 +131,28 @@ def _chain_sizes(runs: int, k: int) -> list[int]:
 
 def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
               energy_sampler=None) -> ProxyResult:
+    # fault injection (faults/inject.py): wrap the FULL step so the
+    # injected sleeps land inside every timed window and crash triggers
+    # count warmup + measured invocations, matching the native tier
+    injector = cfg.fault_injector
+    if injector is not None:
+        base_full = bundle.full
+
+        def full_step():
+            injector.before_step()
+            return base_full()
+    else:
+        full_step = bundle.full
+    wd = cfg.watchdog
+
     # warmup; reference dp.cpp:234-244.  Bundles are AOT-compiled at
     # build time (core/executor.py), so these samples measure EXECUTION
     # only — compile time can no longer pollute estimate_runs through
     # the warmup mean the way a first-call jit compile did.
     with spans.span("warmup", proxy=name, reps=max(cfg.warmup, 1)):
-        warmup_s = time_callable(bundle.full, reps=max(cfg.warmup, 1))
+        warmup_s = time_callable(full_step, reps=max(cfg.warmup, 1))
+    if wd is not None:
+        wd.beat("warmup")
 
     runs = cfg.runs
     if cfg.min_exectime_s > 0:
@@ -130,7 +160,7 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
 
     if cfg.loop:  # reference PROXY_LOOP, dp.cpp:251-256
         while True:
-            bundle.full()
+            full_step()
 
     if energy_sampler is None and cfg.measure_energy:
         with spans.span("calibrate", what="energy_sampler"):
@@ -166,9 +196,10 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
     full_s: list[float] = []
     comp_s: list[float] = []
     energy_j: list[float] = []
+    fault_us: list[float] = []
     with spans.span("timed", proxy=name, variant="full+compute",
                     runs=runs, chains=len(chains)):
-        for k in chains:
+        for ci, k in enumerate(chains):
             # Energy brackets ONLY the fenced full chain (reference
             # per-rank energy_consumed arrays, plots/parser.py:172),
             # reported per iteration.  The RTT-aware transfer fence
@@ -177,7 +208,19 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
             # per-chain offset that cancels across configs.
             if energy_sampler is not None:
                 e0 = energy_sampler.read_joules()
-            t_full = time_chain(bundle.full, k=k)
+            inj0 = injector.injected_delay_us if injector is not None else 0.0
+            if wd is not None:
+                with wd:
+                    t_full = time_chain(full_step, k=k)
+                wd.beat(f"chain_{ci}")
+            else:
+                t_full = time_chain(full_step, k=k)
+            if injector is not None:
+                # injected latency attributable to this chain, per
+                # iteration — lets analyses subtract the scripted delay
+                # from the observed inflation (straggler amplification)
+                fault_us.append(
+                    (injector.injected_delay_us - inj0) / k)
             if energy_sampler is not None:
                 energy_j.append(max(0.0,
                                     energy_sampler.read_joules() - e0) / k)
@@ -185,6 +228,8 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
             if measure_compute:
                 comp_s.append(time_chain(bundle.compute, k=k))
     timers["runtimes"] = [t * 1e6 for t in full_s]
+    if injector is not None:
+        timers["fault_delay_us"] = [round(v, 1) for v in fault_us]
     if energy_sampler is not None:
         timers["energy_consumed"] = energy_j
         # stop any background polling now that the measured phase is over
@@ -220,6 +265,11 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
                 v_s = [time_chain(vfn, k=k) for k in chains]
             timers[f"{vname}_time"] = [t * 1e6 for t in v_s]
 
+    if wd is not None:
+        # last-progress heartbeat ages at emission time: a completed
+        # run shows tiny ages everywhere; a post-mortem of a hung run
+        # (record emitted by a supervisor) shows WHERE progress stopped
+        wd.stamp(bundle.global_meta)
     return ProxyResult(
         name=name,
         global_meta=bundle.global_meta,

@@ -39,11 +39,53 @@ class StepWatchdog:
         # watchdog across threads each disarm exactly their own timer
         self._local = threading.local()
         self._stall_lock = threading.Lock()
+        # last-progress heartbeats: key -> monotonic stamp of the most
+        # recent beat.  Post-mortems of hung runs read the AGES — the
+        # key with a stale age is where progress stopped.
+        self._beats: dict[str, float] = {}
+        self._beats_lock = threading.Lock()
 
     def _default_on_stall(self, name: str, elapsed_s: float) -> None:
+        ages = self.heartbeat_ages()
+        where = ""
+        if ages:
+            # the MOST RECENT beat (min age) is the last progress made;
+            # the hang sits just past it.  (The max-age key would be the
+            # FIRST phase to complete for one-shot phase beats — the
+            # opposite of where the run is stuck.)
+            last = min(ages, key=ages.get)
+            where = (f"; last progress: {last!r} {ages[last]:.1f}s ago "
+                     f"(heartbeats: "
+                     + ", ".join(f"{k}={v:.1f}s" for k, v in
+                                 sorted(ages.items())) + ")")
         print(f"[watchdog] section {name!r} exceeded its {self.deadline_s:.1f}s "
               f"deadline ({elapsed_s:.1f}s elapsed) — likely a hung "
-              f"collective or device stall", file=sys.stderr, flush=True)
+              f"collective or device stall{where}",
+              file=sys.stderr, flush=True)
+
+    # ---- heartbeats: where did progress stop? ------------------------
+    def beat(self, key: str = "step") -> None:
+        """Record progress for ``key`` (a rank, a phase, a chain — any
+        unit whose LAST progress time a post-mortem should see)."""
+        with self._beats_lock:
+            self._beats[key] = time.monotonic()
+
+    def heartbeat_ages(self) -> dict:
+        """Seconds since each key's last beat, at call time."""
+        now = time.monotonic()
+        with self._beats_lock:
+            return {k: now - t for k, t in self._beats.items()}
+
+    def stamp(self, meta: dict,
+              key: str = "watchdog_heartbeat_age_s") -> dict:
+        """Write the current heartbeat ages (rounded) into a record's
+        global metadata so the emitted artifact says where progress
+        stopped — the post-mortem channel for hung runs (stall count
+        rides along)."""
+        meta[key] = {k: round(v, 3)
+                     for k, v in sorted(self.heartbeat_ages().items())}
+        meta["watchdog_stalls"] = self.stalls
+        return meta
 
     def _fire(self, armed_at: float) -> None:
         with self._stall_lock:  # Timer threads may fire concurrently

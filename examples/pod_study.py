@@ -29,6 +29,7 @@ combined records file remains one flat, parseable study.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -207,6 +208,166 @@ def _run_hier_point(argv: list[str], world, records: Path, env,
     return 0
 
 
+# ---------------------------------------------------------------------
+# --fault mode: the fault-injection & elastic-degradation study
+# (docs/RESILIENCE.md).  Three native points into ONE records.jsonl:
+#   1. straggler  — fsdp/shm, a 30 ms delay on rank 2 from step 4 on:
+#                   the clean window is the in-record baseline, the
+#                   summary reports straggler_amp and refuses busbw on
+#                   the faulted runs;
+#   2. crash      — dp over 3 TCP processes, rank 1 dies at step 4
+#                   under policy `shrink`: the victim exits nonzero and
+#                   emits nothing (dead is dead), survivors finish on
+#                   the pre-split survivor group and their records
+#                   merge through the degraded pathway with
+#                   detection_ms/recovery_ms/degraded_world;
+#   3. drop       — dp over 2 TCP processes at 20 % injected frame
+#                   loss under policy `retry`: the run completes,
+#                   backoff counts ride the record.
+
+FAULT_MODEL = "gpt2_l_16_bfloat16"
+
+
+def _fault_base(repo: str, runs: int = 6) -> list[str]:
+    return ["--model", FAULT_MODEL, "--time_scale", "0.001",
+            "--size_scale", "0.0001", "--runs", str(runs),
+            "--warmup", "1", "--no_topology", "--base_path", repo]
+
+
+def run_fault_plan(args, records: Path) -> int:
+    from dlnetbench_tpu.metrics.merge import merge_files
+    from dlnetbench_tpu.utils.native_build import native_bin as _locate
+
+    repo = str(Path(__file__).resolve().parent.parent)
+    try:
+        native = _locate(repo)
+    except Exception as e:
+        raise SystemExit(f"--fault needs the native tier: {e}")
+    failed = 0
+
+    # 1. straggler (shm; fsdp declares a comm_model, so the faulted
+    # busbw refusal + straggler_amp surface in the bandwidth table)
+    plan = json.dumps({"events": [{"kind": "delay", "ranks": [2],
+                                   "iteration": 4,
+                                   "magnitude_us": 30000}]})
+    print("[fault 1/3] straggler: fsdp/shm world 4, 30 ms delay on "
+          "rank 2 from step 4", flush=True)
+    rc = subprocess.run(
+        [str(native / "fsdp"), "--world", "4", "--num_units", "4",
+         "--sharding_factor", "2", "--fault", plan,
+         "--out", str(records)] + _fault_base(repo),
+        stdout=subprocess.DEVNULL).returncode
+    if rc != 0:
+        print("  FAILED", file=sys.stderr)
+        failed += 1
+
+    # 2. rank crash + shrink (tcp, 3 processes; rank 1 is the victim)
+    plan = json.dumps({"events": [{"kind": "crash", "ranks": [1],
+                                   "iteration": 4}]})
+    print("[fault 2/3] crash+shrink: dp/tcp world 3, rank 1 dies at "
+          "step 4, survivors regroup", flush=True)
+    port = free_port()
+    parts = [records.parent / f".fault_p{r}.jsonl" for r in range(3)]
+    for p in parts:
+        p.unlink(missing_ok=True)
+    procs = [subprocess.Popen(
+        [str(native / "dp"), "--world", "3", "--backend", "tcp",
+         "--rank", str(r), "--coordinator", f"127.0.0.1:{port}",
+         "--num_buckets", "2", "--fault", plan,
+         "--fault_policy", "shrink", "--out", str(parts[r])]
+        + _fault_base(repo),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for r in range(3)]
+    rcs = [p.wait(timeout=300) for p in procs]
+    # the victim MUST die (nonzero, record-less); the survivors finish
+    if rcs[1] == 0 or rcs[0] != 0 or rcs[2] != 0:
+        print(f"  FAILED rcs={rcs}", file=sys.stderr)
+        failed += 1
+    else:
+        try:
+            merge_files(records, [parts[0], parts[2]])
+        except ValueError as e:
+            print(f"  merge failed: {e}", file=sys.stderr)
+            failed += 1
+    for p in parts:
+        p.unlink(missing_ok=True)
+
+    # 3. drop + retry (tcp, 2 processes, 20 % loss with backoff)
+    plan = json.dumps({"events": [{"kind": "drop", "ranks": [0],
+                                   "iteration": 0, "rate": 0.2,
+                                   "magnitude_us": 200, "seed": 42}]})
+    print("[fault 3/3] drop+retry: dp/tcp world 2, 20 % injected frame "
+          "loss, exponential backoff", flush=True)
+    port = free_port()
+    parts = [records.parent / f".fault_d{r}.jsonl" for r in range(2)]
+    for p in parts:
+        p.unlink(missing_ok=True)
+    procs = [subprocess.Popen(
+        [str(native / "dp"), "--world", "2", "--backend", "tcp",
+         "--rank", str(r), "--coordinator", f"127.0.0.1:{port}",
+         "--num_buckets", "2", "--fault", plan,
+         "--fault_policy", "retry", "--out", str(parts[r])]
+        + _fault_base(repo),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for r in range(2)]
+    rcs = [p.wait(timeout=300) for p in procs]
+    if any(rcs):
+        print(f"  FAILED rcs={rcs}", file=sys.stderr)
+        failed += 1
+    else:
+        try:
+            merge_files(records, parts)
+        except ValueError as e:
+            print(f"  merge failed: {e}", file=sys.stderr)
+            failed += 1
+    for p in parts:
+        p.unlink(missing_ok=True)
+    return failed
+
+
+def fault_report(args, records: Path) -> None:
+    from dlnetbench_tpu.analysis.bandwidth import bandwidth_summary, \
+        straggler_amplification
+    from dlnetbench_tpu.metrics.parser import load_records
+
+    recs = load_records(records)
+    print("\n=== fault study: one row per record "
+          "(docs/RESILIENCE.md columns) ===")
+    header = (f"{'section':<8} {'fault':<18} {'policy':<10} "
+              f"{'straggler_amp':>13} {'detection_ms':>12} "
+              f"{'recovery_ms':>11} {'drops':>6} {'retries':>8} "
+              f"degraded_world")
+    print(header)
+    for rec in recs:
+        g = rec.get("global", {})
+        plan = g.get("fault_plan") or {}
+        kinds = "+".join(sorted({e.get("kind", "?")
+                                 for e in plan.get("events", [])})) or "-"
+        amp = straggler_amplification(rec)
+        det, rcv = g.get("detection_ms"), g.get("recovery_ms")
+        print(f"{rec.get('section', '?'):<8} {kinds:<18} "
+              f"{g.get('fault_policy', '-'):<10} "
+              f"{amp if amp == amp else float('nan'):>13.3f} "
+              f"{det if det is not None else float('nan'):>12.3f} "
+              f"{rcv if rcv is not None else float('nan'):>11.3f} "
+              f"{g.get('fault_drops', 0):>6} "
+              f"{g.get('fault_retries', 0):>8} "
+              f"{g.get('degraded_world', '-')}")
+
+    bw = bandwidth_summary(recs)
+    if not bw.empty:
+        print("\n=== bandwidth under fault: faulted runs busbw-refused, "
+              "clean runs keep their figures ===")
+        cols = ["section", "collective", "bound", "time_us",
+                "algbw_GBps", "busbw_GBps", "straggler_amp"]
+        print(bw[cols].to_string(
+            index=False, float_format=lambda v: f"{v:10.3f}"))
+        bw.to_csv(args.out_dir / "fault_bandwidth_summary.csv",
+                  index=False)
+    print(f"\nwrote {records} and "
+          f"{args.out_dir}/fault_bandwidth_summary.csv")
+
+
 def report(args, records: Path) -> None:
     import pandas as pd
 
@@ -322,6 +483,14 @@ def main() -> int:
                          "DCN mesh; worlds that do not divide evenly get "
                          "the balanced uneven layout (first world%%procs "
                          "processes host one extra rank)")
+    ap.add_argument("--fault", action="store_true",
+                    help="run the fault-injection study instead of the "
+                         "proxy grid: a straggler point (fsdp/shm, "
+                         "measured amplification), a rank-crash point "
+                         "(dp/tcp, shrink policy, detection/recovery + "
+                         "degraded merge), and a drop point (dp/tcp, "
+                         "retry policy with backoff counts) — one "
+                         "records.jsonl artifact; docs/RESILIENCE.md")
     ap.add_argument("--congest", action="store_true",
                     help="run a dp_loop congestor pair (native TCP fabric) "
                          "for the duration of the sweep — sustained "
@@ -355,6 +524,15 @@ def main() -> int:
     args.out_dir.mkdir(parents=True, exist_ok=True)
     records = args.out_dir / "records.jsonl"
     failed = 0
+    if args.fault:
+        if not args.report_only:
+            records.unlink(missing_ok=True)
+            failed = run_fault_plan(args, records)
+        fault_report(args, records)
+        if failed:
+            print(f"\n{failed} fault study point(s) failed",
+                  file=sys.stderr)
+        return 1 if failed else 0
     if not args.report_only:
         records.unlink(missing_ok=True)
         # a stale marker from an earlier --congest sweep into the same

@@ -46,6 +46,14 @@ class Fabric {
   // (Cross-process fabrics run body once, for this process's rank.)
   virtual void launch(const std::function<void(int)>& body) = 0;
 
+  // Rank `world_rank` is dying mid-run (fault_plan.hpp crash events, or
+  // any rank-body exception): make the death OBSERVABLE to the others —
+  // in-process fabrics abort the dead rank's groups so blocked
+  // rendezvous throw instead of hanging forever; cross-process fabrics
+  // suppress the clean-departure goodbye so peers read the EOF as a
+  // death (tcp_backend.hpp `dying_`).  Default: nothing to do.
+  virtual void mark_rank_dead(int world_rank) { (void)world_rank; }
+
   // Ranks measured BY THIS PROCESS (record rows to emit); in-process
   // fabrics own the whole world, cross-process fabrics their one rank.
   virtual std::vector<int> local_ranks() const {

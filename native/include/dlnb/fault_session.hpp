@@ -1,0 +1,157 @@
+// Per-rank fault session: the degradation-policy harness around a
+// proxy's step (fault_plan.hpp has the plan/injection layer; this file
+// is the part that needs the Fabric).
+//
+// Usage (see src/proxies/dp.cc):
+//
+//   fault::Session fses(fab, rank);              // pre-splits survivors
+//   run = run_measured(cfg, *comm, ts, [&](TimerSet& t) {
+//     fses.step(t, *comm, [&](ProxyCommunicator& c) { ...schedule...(c) });
+//   });
+//
+// Behavior per policy when the plan scripts a crash:
+//   * The victim rank throws RankFailure at its trigger iteration,
+//     AFTER marking the fabric (shm: abort the victim's groups so
+//     blocked survivors throw; tcp/hier: suppress the Bye so the EOF
+//     reads as a death).  The throw propagates — a crashed rank emits
+//     nothing, exactly like a real death.
+//   * fail_fast (default): survivors' next collective on a group
+//     containing the victim throws (the existing detection paths,
+//     provoked deterministically for the first time) and the run dies.
+//   * shrink: the constructor pre-split a survivor communicator while
+//     everyone was alive (the plan is deterministic — every rank knows
+//     the victims up front, so no runtime agreement protocol is
+//     needed).  A survivor catches the failed step, rolls its timers
+//     back to the pre-attempt snapshot, stamps detection wall time
+//     (step start -> failure surfaced), re-runs the step on the
+//     survivor group, stamps recovery wall time, and continues the
+//     remaining iterations degraded.  The failed attempt's cost stays
+//     visible: that iteration's recorded runtime includes detection +
+//     recovery + the re-run.
+//
+// Delay/jitter sleeps and the step-boundary crash trigger ride
+// Plan::on_step_begin; drop/partition events live in the transport
+// hooks and need nothing from this layer.
+#pragma once
+
+#include <algorithm>
+#include <memory>
+#include <vector>
+
+#include "dlnb/fabric.hpp"
+#include "dlnb/fault_plan.hpp"
+#include "dlnb/timers.hpp"
+
+namespace dlnb {
+namespace fault {
+
+// Step-boundary injection WITHOUT the shrink machinery: delay/jitter
+// sleeps + the crash trigger, with the fabric marked before a scripted
+// death propagates (so survivors fail fast instead of hanging).  For
+// proxies whose communicator grid cannot shrink (fsdp's
+// sharding_factor x replicas, the pipelines) — they support
+// fail_fast/retry plans and must REFUSE a crash+shrink plan loudly
+// (guard below) rather than half-apply it.
+inline void step_guard(Fabric& fab, int rank) {
+  auto& plan = Plan::instance();
+  if (!plan.active()) return;
+  try {
+    plan.on_step_begin(rank);
+  } catch (const RankFailure&) {
+    fab.mark_rank_dead(rank);
+    throw;
+  }
+}
+
+inline void require_no_shrink(const char* proxy) {
+  auto& plan = Plan::instance();
+  if (plan.active() && plan.policy() == "shrink" &&
+      !plan.crash_victims().empty())
+    throw std::runtime_error(
+        std::string(proxy) +
+        ": the shrink policy needs a survivor regrouping this proxy's "
+        "communicator grid does not support — use the dp proxy (or the "
+        "python tier's rebuild path), or policy fail_fast/retry");
+}
+
+// For proxies with NO step-boundary fault driver at all: refuse plans
+// whose events could only fire at step boundaries.  Without this, the
+// record would stamp the plan (run_proxy_main describes it for every
+// proxy) while the faults silently never fired — and the analysis
+// layer would refuse busbw on runs that were actually clean.
+inline void require_collective_scope_only(const char* proxy) {
+  auto& plan = Plan::instance();
+  if (plan.active() && plan.has_step_events())
+    throw std::runtime_error(
+        std::string(proxy) +
+        ": this proxy has no step-boundary fault driver — only "
+        "collective-scoped delay/jitter (where == \"collective\") and "
+        "drop events apply here; step-scoped delay/jitter, crash and "
+        "partition plans are wired for dp (full policies) and fsdp "
+        "(injection + fail-fast)");
+}
+
+class Session {
+ public:
+  Session(Fabric& fab, int world_rank)
+      : fab_(fab), rank_(world_rank), plan_(Plan::instance()) {
+    if (!plan_.active()) return;
+    auto victims = plan_.crash_victims();
+    victim_ = std::find(victims.begin(), victims.end(), rank_) !=
+              victims.end();
+    if (plan_.policy() == "shrink" && !victims.empty())
+      // collective split while everyone is still alive: survivors get
+      // color 0, victims color 1 (their group is never used) — a new
+      // comm id everywhere, so stale frames of a failed world-comm
+      // step can never match the survivor group's traffic
+      surv_ = fab.split(world_rank, victim_ ? 1 : 0, "fault_survivors");
+  }
+
+  template <typename Body>
+  void step(TimerSet& t, ProxyCommunicator& world, Body&& body) {
+    if (!plan_.active()) {
+      body(world);
+      return;
+    }
+    try {
+      plan_.on_step_begin(rank_);
+    } catch (const RankFailure&) {
+      fab_.mark_rank_dead(rank_);
+      throw;
+    }
+    ProxyCommunicator& c = (shrunk_ && surv_) ? *surv_ : world;
+    auto snapshot = t.sizes();
+    auto t0 = Clock::now();
+    try {
+      body(c);
+    } catch (const RankFailure&) {
+      throw;  // scripted deaths never degrade into a shrink
+    } catch (const std::exception&) {
+      if (victim_ || shrunk_ || !surv_ || plan_.policy() != "shrink")
+        throw;
+      double detection = us_since(t0);
+      t.truncate(snapshot);  // drop the failed attempt's partial timers
+      shrunk_ = true;
+      auto r0 = Clock::now();
+      body(*surv_);
+      auto& rep = plan_.report(rank_);
+      rep.detection_us.store(detection);
+      rep.recovery_us.store(us_since(r0));
+      rep.shrunk.store(true);
+    }
+  }
+
+  bool shrunk() const { return shrunk_; }
+  bool victim() const { return victim_; }
+
+ private:
+  Fabric& fab_;
+  int rank_;
+  Plan& plan_;
+  bool victim_ = false;
+  bool shrunk_ = false;
+  std::unique_ptr<ProxyCommunicator> surv_;
+};
+
+}  // namespace fault
+}  // namespace dlnb

@@ -81,6 +81,7 @@
 
 #include "dlnb/communicator.hpp"
 #include "dlnb/fabric.hpp"
+#include "dlnb/fault_plan.hpp"
 #include "dlnb/pjrt_fabric.hpp"
 #include "dlnb/schedule.hpp"  // balanced_local/start: the rank layout
 #include "dlnb/tcp_backend.hpp"
@@ -503,6 +504,11 @@ class HierCommunicator : public ProxyCommunicator {
 
   void run_collective(int slot, pjrtfab::Op op, std::int64_t count,
                       std::int64_t extra, const void* src, void* dst) {
+    // per-rank injected latency (fault_plan.hpp collective-scoped
+    // events) — fires per global rank thread, before the local phase,
+    // so a straggler rank delays its whole hierarchical collective;
+    // drop injection rides the TCP mesh's send_frame hook underneath
+    fault::Plan::instance().on_collective(grk_);
     const std::int64_t G = size();
     const std::size_t esz = dtype_bytes(dtype_);
     const std::size_t m = lg_->local_members.size();
@@ -1013,6 +1019,11 @@ class HierFabric : public Fabric {
     return out;
   }
   int process_index() const override { return proc_rank_; }
+
+  // Fault-plan crash of a local rank thread: the whole process is
+  // going down (the local fabric's launch rethrows), so suppress the
+  // DCN goodbye — peers must read this process's EOF as a death.
+  void mark_rank_dead(int /*world_rank*/) override { tcp_.mark_dying(); }
 
   void burn(int rank, double us, double time_scale) override {
     local_.burn(rank - base_, us, time_scale);

@@ -34,6 +34,7 @@
 
 #include "dlnb/communicator.hpp"
 #include "dlnb/fabric.hpp"
+#include "dlnb/fault_plan.hpp"
 #include "dlnb/tensor.hpp"
 
 namespace dlnb {
@@ -57,6 +58,7 @@ class Rendezvous {
       const std::function<void(int, const std::vector<const void*>&,
                                const std::vector<void*>&)>& fn) {
     std::unique_lock<std::mutex> lk(m_);
+    if (aborted_) throw std::runtime_error(abort_why_);
     std::uint64_t my_gen = gen_;
     srcs_[grank] = src;
     dsts_[grank] = dst;
@@ -67,7 +69,14 @@ class Rendezvous {
       mismatch_ = true;
     }
     if (++arrived_ == n_) cv_.notify_all();
-    cv_.wait(lk, [&] { return gen_ == my_gen && arrived_ == n_; });
+    cv_.wait(lk, [&] {
+      return aborted_ || (gen_ == my_gen && arrived_ == n_);
+    });
+    // abort fails ONLY a round that cannot complete (a member died
+    // before arriving); a fully-arrived round still runs — otherwise
+    // survivors could abandon different rounds and desync
+    if (!(gen_ == my_gen && arrived_ == n_))
+      throw std::runtime_error(abort_why_);
     bool bad = mismatch_;
     lk.unlock();
     // on mismatch still complete the round (skip the math) so the
@@ -81,12 +90,27 @@ class Rendezvous {
       ++gen_;
       cv_.notify_all();
     } else {
-      cv_.wait(lk, [&] { return gen_ != my_gen; });
+      cv_.wait(lk, [&] { return aborted_ || gen_ != my_gen; });
+      // the round completed (every member, including a subsequently
+      // dead one, already departed it) — only a stuck reset aborts
+      if (gen_ == my_gen) throw std::runtime_error(abort_why_);
     }
     lk.unlock();
     if (bad)
       throw std::runtime_error(
           "shm collective mismatch: ranks disagree on op/count");
+  }
+
+  // Permanently poison the rendezvous: every blocked and future wait
+  // throws instead of waiting for a rank that will never arrive (the
+  // fail-fast a dead in-process rank needs — a rendezvous group is
+  // never reused after a member dies).
+  void abort(const std::string& why) {
+    std::lock_guard<std::mutex> lk(m_);
+    if (aborted_) return;
+    aborted_ = true;
+    abort_why_ = why;
+    cv_.notify_all();
   }
 
  private:
@@ -98,6 +122,8 @@ class Rendezvous {
   int arrived_ = 0;
   int departed_ = 0;
   bool mismatch_ = false;
+  bool aborted_ = false;
+  std::string abort_why_;
   OpKind op_ = OpKind::Barrier;
   std::int64_t count_ = 0;
   std::uint64_t gen_ = 0;
@@ -115,12 +141,17 @@ class Mailboxes {
  public:
   void send(int from, int to, int tag, const void* data, std::size_t bytes) {
     std::unique_lock<std::mutex> lk(m_);
+    if (aborted_) throw std::runtime_error(abort_why_);
     Key k{from, to, tag};
     auto& box = boxes_[k];
     box.push_back(Msg{data, bytes, false});
     auto mine = std::prev(box.end());
     cv_.notify_all();
-    cv_.wait(lk, [&] { return mine->consumed; });
+    cv_.wait(lk, [&] { return aborted_ || mine->consumed; });
+    if (aborted_ && !mine->consumed) {
+      box.erase(mine);
+      throw std::runtime_error(abort_why_);
+    }
     box.erase(mine);
   }
 
@@ -128,18 +159,34 @@ class Mailboxes {
     std::unique_lock<std::mutex> lk(m_);
     Key k{from, to, tag};
     std::list<Msg>::iterator it;
+    bool found = false;
     cv_.wait(lk, [&] {
       auto& box = boxes_[k];
       for (it = box.begin(); it != box.end(); ++it)
-        if (!it->consumed) return true;
-      return false;
+        if (!it->consumed) {
+          found = true;
+          return true;
+        }
+      return aborted_;
     });
+    // an already-delivered message still completes (the sender made it
+    // before dying); only an empty box aborts
+    if (!found) throw std::runtime_error(abort_why_);
     if (it->bytes != bytes)
       throw std::runtime_error("shm p2p size mismatch: send " +
                                std::to_string(it->bytes) + "B vs recv " +
                                std::to_string(bytes) + "B");
     std::memcpy(out, it->data, bytes);
     it->consumed = true;
+    cv_.notify_all();
+  }
+
+  // Poison the mailbox (dead member): blocked and future p2p throws.
+  void abort(const std::string& why) {
+    std::lock_guard<std::mutex> lk(m_);
+    if (aborted_) return;
+    aborted_ = true;
+    abort_why_ = why;
     cv_.notify_all();
   }
 
@@ -160,6 +207,8 @@ class Mailboxes {
   std::mutex m_;
   std::condition_variable cv_;
   std::map<Key, std::list<Msg>> boxes_;
+  bool aborted_ = false;
+  std::string abort_why_;
 };
 
 // Shared state of one communicator group (all member ranks).
@@ -174,6 +223,19 @@ struct Group {
   std::vector<int> members;  // world ranks, ascending == group rank order
   std::vector<std::unique_ptr<Rendezvous>> rendezvous;
   Mailboxes mailboxes;
+
+  bool contains(int world_rank) const {
+    for (int m : members)
+      if (m == world_rank) return true;
+    return false;
+  }
+
+  // A member died: poison every synchronization point so survivors
+  // fail fast instead of waiting for a rank that will never arrive.
+  void abort_all(const std::string& why) {
+    for (auto& r : rendezvous) r->abort(why);
+    mailboxes.abort(why);
+  }
 };
 
 // Single-thread ordered task queue — one per (rank, slot); the analogue of
@@ -396,6 +458,9 @@ class ShmCommunicator : public ProxyCommunicator {
 
   void run_collective(int slot, shm::OpKind op, std::int64_t count,
                       const void* src, void* dst) {
+    // per-rank injected latency (fault_plan.hpp delay/jitter events
+    // scoped where == "collective"); no-op without an active plan
+    fault::Plan::instance().on_collective(group_->members[grank_]);
     int n = size();
     DType dt = dtype_;
     auto& rz = *group_->rendezvous[slot];
@@ -525,6 +590,25 @@ class ShmFabric : public Fabric {
     mesh["device_kind"] = "thread-rank";
   }
 
+  // A rank thread died mid-run: poison every group containing it so
+  // survivors blocked in a rendezvous/mailbox THROW instead of hanging
+  // forever — the in-process analogue of the TCP fabric's per-peer
+  // death tracking (fail-fast on the threaded fabric).  Groups without
+  // the dead rank (e.g. a fault plan's pre-split survivor group) keep
+  // working, which is what lets the `shrink` policy continue the run.
+  void mark_rank_dead(int world_rank) override {
+    std::string why = "rank " + std::to_string(world_rank) +
+                      " died during a collective (shm fail-fast)";
+    std::vector<std::shared_ptr<shm::Group>> groups;
+    groups.push_back(world_group_);
+    {
+      std::lock_guard<std::mutex> lk(split_m_);
+      for (auto& [key, g] : split_groups_) groups.push_back(g);
+    }
+    for (auto& g : groups)
+      if (g && g->contains(world_rank)) g->abort_all(why);
+  }
+
   // Run body(rank) on world_size threads; rethrows the first rank failure.
   void launch(const std::function<void(int)>& body) override {
     std::vector<std::thread> threads;
@@ -536,6 +620,10 @@ class ShmFabric : public Fabric {
         try {
           body(r);
         } catch (...) {
+          // fail-fast: the sibling rank threads must observe the death
+          // (abort shared groups) rather than wait forever on a rank
+          // that will never arrive
+          mark_rank_dead(r);
           std::lock_guard<std::mutex> lk(err_m);
           if (!first_error) first_error = std::current_exception();
         }

@@ -49,6 +49,7 @@
 
 #include "dlnb/communicator.hpp"
 #include "dlnb/fabric.hpp"
+#include "dlnb/fault_plan.hpp"
 #include "dlnb/shm_backend.hpp"  // SlotWorker (stream-per-slot discipline)
 #include "dlnb/tensor.hpp"
 
@@ -514,6 +515,11 @@ class TcpFabric : public Fabric {
   // the destructor later runs on a thread with no in-flight exception.
   void mark_dying() { dying_.store(true, std::memory_order_release); }
 
+  // Fault-plan crash path (one process = one rank): a scripted death
+  // must look exactly like a real one — no Bye, so every peer reads the
+  // EOF as a mid-run death and the transitive fail-fast fires.
+  void mark_rank_dead(int /*world_rank*/) override { mark_dying(); }
+
   // One process = one rank: body runs once, in this thread.
   void launch(const std::function<void(int)>& body) override {
     try {
@@ -579,6 +585,13 @@ class TcpFabric : public Fabric {
       inbox_.push(std::move(f));
       return;
     }
+    // fault injection at the transmission point (fault_plan.hpp): drop
+    // events model loss + sender-side retransmission — backoff sleeps
+    // under policy `retry` (counted into the record), an abort under
+    // `fail_fast`; partition events fail sends across the boundary.
+    // Applies to every frame this process writes, including the DCN
+    // legs a HierFabric routes through this mesh.
+    fault::Plan::instance().on_send(rank_, dst);
     std::lock_guard<std::mutex> lk(send_m_[dst]);
     tcp::send_all(fds_[dst], &h, sizeof h);
     if (h.bytes) tcp::send_all(fds_[dst], payload, h.bytes);
@@ -815,6 +828,8 @@ inline void TcpCommunicator::Recv(void* dst, std::int64_t count,
 inline void TcpCommunicator::collective(int slot, shm::OpKind op,
                                         std::int64_t count, const void* src,
                                         void* dst) {
+  // per-rank injected latency (fault_plan.hpp collective-scoped events)
+  fault::Plan::instance().on_collective(wrank_);
   const int n = size();
   const std::size_t esz = dtype_bytes(dtype_);
   // Large allreduces ride the bandwidth-optimal ring: the full mesh

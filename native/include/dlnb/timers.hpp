@@ -60,6 +60,23 @@ class TimerSet {
   }
   void clear() { vals_.clear(); }
 
+  // Snapshot/rollback for retried step attempts (fault_session.hpp):
+  // a step abandoned mid-flight leaves partial scoped-timer entries
+  // behind; rolling back to the pre-attempt snapshot keeps every timer
+  // array per-iteration aligned when the step is re-run.
+  std::map<std::string, std::size_t> sizes() const {
+    std::map<std::string, std::size_t> out;
+    for (const auto& [name, v] : vals_) out[name] = v.size();
+    return out;
+  }
+  void truncate(const std::map<std::string, std::size_t>& snapshot) {
+    for (auto& [name, v] : vals_) {
+      auto it = snapshot.find(name);
+      std::size_t keep = it == snapshot.end() ? 0 : it->second;
+      if (v.size() > keep) v.resize(keep);
+    }
+  }
+
   // Merge raw per-hop entries into per-iteration totals of `group` entries
   // each — the reference's middle-stage PP timer merge
   // (hybrid_2d.cpp:416-439 collapses recv+send entries per microbatch).

@@ -45,6 +45,10 @@ int main(int argc, char** argv) {
         "dp", env, meta,
         [&](int r, Fabric& fab, TimerSet& ts, RankRun& run) {
           auto comm = fab.world_comm(r);
+          // fault harness (no-op without --fault): step-boundary
+          // delay/jitter/crash injection + the shrink policy's
+          // pre-split survivor group (fault_session.hpp)
+          fault::Session fses(fab, r);
           // every rank holds full buckets (allreduce semantics,
           // dp.cpp:227-232); grads zero-init like the reference Tensor
           std::vector<Tensor> grads, sums;
@@ -59,14 +63,16 @@ int main(int argc, char** argv) {
           // device-backed fabrics burn real device cycles, others sleep
           auto burn = [&](double us) { fab.burn(r, us, env.cfg.time_scale); };
           run = run_measured(env.cfg, *comm, ts, [&](TimerSet& t) {
-            burn(sched.fwd_us);
-            for (i64 b = 0; b < sched.num_buckets; ++b) {
-              burn(sched.bwd_us_per_bucket);
-              comm->Iallreduce(grads[b].data(), sums[b].data(), counts[b],
-                               static_cast<int>(b));
-            }
-            auto sc = t.scoped("barrier_time");
-            comm->WaitAll(static_cast<int>(sched.num_buckets));
+            fses.step(t, *comm, [&](ProxyCommunicator& c) {
+              burn(sched.fwd_us);
+              for (i64 b = 0; b < sched.num_buckets; ++b) {
+                burn(sched.bwd_us_per_bucket);
+                c.Iallreduce(grads[b].data(), sums[b].data(), counts[b],
+                             static_cast<int>(b));
+              }
+              auto sc = t.scoped("barrier_time");
+              c.WaitAll(static_cast<int>(sched.num_buckets));
+            });
           });
           return Json::object();
         });

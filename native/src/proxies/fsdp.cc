@@ -62,6 +62,11 @@ int main(int argc, char** argv) {
       meta["comm_model"] = cm;
     }
 
+    // fault plans apply (step/collective delay, drop, crash fail-fast),
+    // but the ZeRO grid cannot regroup around a dead rank: refuse a
+    // crash+shrink plan instead of half-applying it (dp supports it)
+    fault::require_no_shrink("fsdp");
+
     return run_proxy_main(
         "fsdp", env, meta,
         [&](int r, Fabric& fab, TimerSet& ts, RankRun& run) {
@@ -88,6 +93,9 @@ int main(int argc, char** argv) {
 
           auto burn = [&](double us) { fab.burn(r, us, env.cfg.time_scale); };
           run = run_measured(env.cfg, *world, ts, [&](TimerSet& t) {
+            // step-boundary fault injection (delay/jitter sleeps,
+            // crash fail-fast); no-op without an active plan
+            fault::step_guard(fab, r);
             // initial blocking allgather of unit 0 (fsdp.cpp:86-91)
             {
               auto sc = t.scoped("allgather");

@@ -14,6 +14,12 @@ int main(int argc, char** argv) {
 
   try {
     ProxyEnv env = make_env(args);
+    // no step-boundary fault driver here: refuse plans whose
+    // events could only fire at step boundaries, so a record
+    // never stamps fault provenance onto an actually-clean run
+    // (collective-scoped and drop plans still apply via the
+    // fabric hooks; fault_session.hpp)
+    fault::require_collective_scope_only("hybrid_2d");
     ModelCard card = load_card_for(env);
     i64 stages = args.integer("num_stages");
     i64 mbs = args.integer("num_microbatches");

@@ -13,6 +13,7 @@
 // the same.
 #pragma once
 
+#include <algorithm>
 #include <cctype>
 #include <fstream>
 #include <functional>
@@ -24,6 +25,7 @@
 
 #include "dlnb/args.hpp"
 #include "dlnb/fabric.hpp"
+#include "dlnb/fault_session.hpp"
 #include "dlnb/harness.hpp"
 #include "dlnb/hier_fabric.hpp"
 #include "dlnb/model_data.hpp"
@@ -127,6 +129,17 @@ inline void add_common_args(Args& args) {
                     "pjrt backend: number of OS processes; >1 composes "
                     "per-process devices (ICI) with a TCP mesh (DCN) — "
                     "the reference's multi-node NCCL mode, dp.cpp:166-189")
+      .optional_str("fault", "",
+                    "JSON fault plan (fault_plan.hpp schema: delay/"
+                    "jitter/drop/crash/partition events with rank "
+                    "targets and iteration triggers); also honored "
+                    "from $DLNB_FAULT_PLAN")
+      .optional_str("fault_policy", "",
+                    "degradation policy on a detected failure: "
+                    "fail_fast (default — every survivor raises), "
+                    "retry (dropped frames re-sent with exponential "
+                    "backoff), shrink (survivors regroup without the "
+                    "dead rank(s) and finish the run degraded)")
       .flag("loop", "run the schedule forever (congestor mode)")
       .flag("no_topology", "skip the startup fabric-topology graph");
 }
@@ -181,6 +194,20 @@ inline ProxyEnv make_env(const Args& args) {
           "--procs > 1 needs --coordinator host:port and --rank");
     if (env.proc_rank < 0 || env.proc_rank >= env.procs)
       throw std::runtime_error("--rank must be in [0, --procs)");
+  }
+  // fault plan: --fault wins over the env channel; either way the plan
+  // (and its policy) must be IDENTICAL on every process of a run —
+  // it is part of the protocol, like the ring threshold
+  {
+    std::string plan_text = args.str("fault");
+    std::string policy = args.str("fault_policy");
+    if (plan_text.empty())
+      if (const char* e = std::getenv("DLNB_FAULT_PLAN"); e && *e)
+        plan_text = e;
+    if (policy.empty())
+      if (const char* e = std::getenv("DLNB_FAULT_POLICY"); e && *e)
+        policy = e;
+    fault::Plan::instance().load(plan_text, policy, env.world);
   }
   // with multiple processes, each process drives its balanced share of
   // the world (uneven when world does not divide procs)
@@ -289,12 +316,51 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
   std::vector<TimerSet> timers(env.world);
   std::vector<RankRun> runs(env.world);
   std::vector<Json> extras(env.world);
-  fab.launch([&](int r) { extras[r] = body(r, fab, timers[r], runs[r]); });
+  auto& plan = fault::Plan::instance();
+  bool degraded = false;
+  try {
+    fab.launch([&](int r) { extras[r] = body(r, fab, timers[r], runs[r]); });
+  } catch (const fault::RankFailure& e) {
+    // A scripted crash surfaced from launch.  Under `shrink` the
+    // in-process survivors finished the run degraded (their threads
+    // completed on the survivor group); the victim's death is DATA —
+    // emit the survivors' record with degraded_world instead of dying.
+    // Any other policy, or a process owning no survivor (the tcp
+    // victim process), dies like a real crash: record-less, nonzero.
+    if (plan.policy() != "shrink") throw;
+    auto surv = plan.survivors();
+    bool any_local_survivor = false;
+    for (int r : fab.local_ranks())
+      if (std::find(surv.begin(), surv.end(), r) != surv.end())
+        any_local_survivor = true;
+    if (!any_local_survivor) throw;
+    (void)e;
+    degraded = true;
+  }
 
   // emit only the ranks THIS process measured (cross-process fabrics own
   // one rank each; dlnetbench_tpu.metrics.merge reassembles the run)
   std::vector<int> local = fab.local_ranks();
+  if (plan.active() && plan.policy() == "shrink" &&
+      !plan.crash_victims().empty()) {
+    // crash victims emit no rows — they died; parser/merge accept the
+    // shrunken rank set through the degraded_world pathway
+    auto surv = plan.survivors();
+    std::vector<int> kept;
+    for (int r : local)
+      if (std::find(surv.begin(), surv.end(), r) != surv.end())
+        kept.push_back(r);
+    local = kept;
+    degraded = true;
+  }
   std::string host = local_hostname();
+  if (plan.active())
+    for (int r : local)
+      // per-rank injected latency as a scalar row field (straggler
+      // post-mortems want WHERE the delay landed), stamped before the
+      // reports copy the extras
+      extras[r]["fault_injected_delay_us"] =
+          plan.report(r).injected_delay_us.load();
   std::vector<RankReport> reports;
   for (int r : local) {
     RankReport rep;
@@ -349,6 +415,29 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
   }
   meta["time_scale"] = env.cfg.time_scale;
   meta["size_scale"] = env.cfg.size_scale;
+  if (plan.active()) {
+    // fault provenance: the plan itself + run-wide drop/retry counters
+    plan.describe(meta);
+    double inj = 0, det = 0, rec = 0;
+    bool shrunk = false;
+    for (int r : local) {
+      auto& rep = plan.report(r);
+      inj += rep.injected_delay_us.load();
+      det = std::max(det, rep.detection_us.load());
+      rec = std::max(rec, rep.recovery_us.load());
+      shrunk = shrunk || rep.shrunk.load();
+    }
+    meta["fault_injected_delay_us"] = inj;
+    if (degraded) {
+      Json dw = Json::array();
+      for (int r : plan.survivors()) dw.push_back(r);
+      meta["degraded_world"] = dw;
+    }
+    if (shrunk) {
+      meta["detection_ms"] = det / 1e3;
+      meta["recovery_ms"] = rec / 1e3;
+    }
+  }
   Json mesh = Json::object();
   fab.describe(meta, mesh);  // backend/platform identity + cache stats
 

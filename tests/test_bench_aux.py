@@ -233,3 +233,45 @@ def test_aux_deadline_skips_instead_of_running(capsys, monkeypatch):
     assert got is None and not ran
     line = json.loads(capsys.readouterr().out.strip())
     assert "deadline" in line["skipped"]
+
+
+def test_straggler_ab_line_schema_locked(monkeypatch):
+    """The faulted-vs-clean straggler A/B is a BENCH artifact: lock the
+    schema — amplification headline {value, unit, n}, both step bands
+    ({value, best, band, n} in ms), and the injected delay — without
+    paying for a real dp build (timing is monkeypatched)."""
+    import itertools
+
+    import bench
+
+    class FakeBundle:
+        full = staticmethod(lambda: None)
+
+    monkeypatch.setattr(
+        "dlnetbench_tpu.proxies.dp.build", lambda *a, **k: FakeBundle())
+    # clean chains 1 ms/step; faulted chains ride the injector's sleep
+    seq = itertools.cycle([0.001])
+
+    def fake_time_chain(fn, k):
+        base = next(seq)
+        import time as _t
+        t0 = _t.monotonic()
+        for _ in range(k):
+            fn()
+        return base + (_t.monotonic() - t0) / k
+
+    monkeypatch.setattr("dlnetbench_tpu.utils.timing.time_chain",
+                        fake_time_chain)
+    line = bench._bench_straggler_ab()
+    assert line is not None
+    assert line["metric"].startswith("straggler A/B")
+    assert line["unit"].startswith("x (")
+    assert line["injected_ms"] >= 2.0
+    for key in ("clean_ms", "faulted_ms"):
+        sub = line[key]
+        assert set(sub) == {"value", "best", "band", "n"}
+        assert sub["band"][0] <= sub["value"] <= sub["band"][1]
+    # the faulted band must sit above the clean band by ~the injection
+    assert line["faulted_ms"]["value"] > line["clean_ms"]["value"]
+    assert 0.5 < line["value"] < 2.0  # measured amplification ~1 here
+    assert line["n"] == 3

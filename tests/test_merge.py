@@ -257,3 +257,98 @@ def test_scheduler_variables_and_merge_tolerance():
     import pytest
     with pytest.raises(ValueError, match="variables"):
         merge_records([a, c])
+
+
+# ---------------------------------------------------------------------
+# Degraded (shrunk-world) merge pathway — fault-plan crash runs
+# (faults/, native fault_plan.hpp): dead ranks emit nothing, and the
+# explicit degraded_world declaration is what relaxes the coverage
+# checks (VERDICT "ragged merge" lineage).
+
+def _degraded_proc_record(proc: int, *, world: int = 4, num_procs: int = 4,
+                          dead: tuple = (1,), runs: int = 2):
+    """A tcp-style survivor record: one rank per process, crash victims
+    declared via degraded_world."""
+    survivors = [r for r in range(world) if r not in dead]
+    return {
+        "section": "dp", "version": 2, "process": proc,
+        "global": {"proxy": "dp", "model": "m", "world_size": world,
+                   "num_processes": num_procs,
+                   "degraded_world": survivors,
+                   "fault_plan": {"policy": "shrink", "events": [
+                       {"kind": "crash", "ranks": list(dead),
+                        "iteration": 3}]},
+                   "fault_policy": "shrink",
+                   "detection_ms": 2.0 + proc, "recovery_ms": 3.0 + proc},
+        "mesh": {"platform": "tcp", "device_kind": "process-rank"},
+        "num_runs": runs,
+        "warmup_times": [10.0 + proc],
+        "ranks": [{"rank": proc, "device_id": proc, "process_index": proc,
+                   "hostname": f"host{proc}",
+                   "runtimes": [100.0 + proc] * runs}],
+    }
+
+
+def test_merge_degraded_world_accepts_missing_dead_ranks():
+    recs = [_degraded_proc_record(p) for p in (0, 2, 3)]  # rank 1 dead
+    merged = merge_records(recs)
+    assert [r["rank"] for r in merged["ranks"]] == [0, 2, 3]
+    assert merged["global"]["degraded_world"] == [0, 2, 3]
+    validate_record(merged)
+    df = records_to_dataframe([merged])
+    assert len(df) == 3 * 2
+    # per-process fault measurements are volatile, never a run mismatch
+    assert merged["global"]["detection_ms"] == 2.0
+
+
+def test_merge_degraded_world_tolerates_dead_process_zero():
+    """rank 0's process can BE the victim: the lowest surviving record
+    anchors the merge iff it declares the degradation."""
+    recs = [_degraded_proc_record(p, dead=(0,)) for p in (1, 2, 3)]
+    merged = merge_records(recs)
+    assert [r["rank"] for r in merged["ranks"]] == [1, 2, 3]
+    validate_record(merged)
+
+
+def test_merge_without_declaration_still_requires_full_coverage():
+    """Missing ranks WITHOUT degraded_world stay an error — only the
+    explicit declaration relaxes the checks."""
+    recs = [_degraded_proc_record(p) for p in (0, 2, 3)]
+    for rec in recs:
+        del rec["global"]["degraded_world"]
+    with pytest.raises(ValueError, match="missing|rank set"):
+        validate_record(merge_records(recs))
+
+
+def test_merge_degraded_missing_survivor_still_caught():
+    """The degraded pathway relaxes DEAD ranks only: a missing SURVIVOR
+    record still fails the final rank-coverage validation."""
+    recs = [_degraded_proc_record(p) for p in (0, 2)]  # rank 3 missing
+    with pytest.raises(ValueError, match="degraded_world"):
+        merge_records(recs)
+
+
+def test_faulted_fixture_roundtrip():
+    """Committed degraded artifact (a REAL merged dp-over-tcp shrink
+    run: crash of rank 1 at iteration 4, survivors finished): parses,
+    validates through the degraded pathway, and the fault columns
+    surface in the DataFrame."""
+    from pathlib import Path
+
+    from dlnetbench_tpu.metrics.parser import load_records
+
+    fixture = Path(__file__).parent / "data" / "record_faulted.jsonl"
+    recs = load_records(fixture)
+    assert len(recs) == 1
+    rec = recs[0]
+    validate_record(rec)
+    g = rec["global"]
+    assert g["degraded_world"] == [0, 2]
+    assert g["fault_policy"] == "shrink"
+    assert g["fault_plan"]["events"][0]["kind"] == "crash"
+    assert g["detection_ms"] > 0 and g["recovery_ms"] > 0
+    assert [r["rank"] for r in rec["ranks"]] == [0, 2]
+    df = records_to_dataframe(recs)
+    assert len(df) == 2 * rec["num_runs"]
+    assert (df["fault_policy"] == "shrink").all()
+    assert (df["runtime"] > 0).all()

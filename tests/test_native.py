@@ -1042,7 +1042,7 @@ def test_native_tsan_fabrics(tmp_path):
                     "-B", str(build)],
                    check=True, capture_output=True)
     subprocess.run(["ninja", "-C", str(build), "test_comm", "test_pjrt",
-                    "tcp_selftest", "hier_selftest"],
+                    "tcp_selftest", "hier_selftest", "fault_selftest"],
                    check=True, capture_output=True)
     for t in ("test_comm", "test_pjrt"):
         out = subprocess.run([str(build / t)], capture_output=True,
@@ -1083,3 +1083,41 @@ def test_native_tsan_fabrics(tmp_path):
             assert p.returncode == 0, \
                 f"hier proc {r}/{nprocs} w={world} under tsan:\n{out}"
             assert "ThreadSanitizer" not in out, out
+
+    # fault-injection crash paths (ISSUE 5 satellite: injected delays
+    # and scripted deaths are exactly where data races hide).  shm
+    # crash + shrink: the group-abort poisoning races rank threads
+    # blocked in rendezvous/mailboxes against the dying thread's
+    # mark_rank_dead; the survivor regroup then reuses slot workers.
+    crash = '{"events":[{"kind":"crash","ranks":[2],"iteration":3}]}'
+    out = subprocess.run(
+        [str(build / "bin" / "fault_selftest"), "--world", "4",
+         "--iters", "6", "--fault", crash, "--fault_policy", "shrink"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, f"shm shrink under tsan:\n{out.stdout}"
+    assert "ThreadSanitizer" not in out.stdout + out.stderr, out.stdout
+    # shm crash fail-fast: every survivor thread must abort cleanly
+    # (nonzero exit, no race) while the dying thread unwinds
+    out = subprocess.run(
+        [str(build / "bin" / "fault_selftest"), "--world", "4",
+         "--iters", "6", "--fault", crash],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode != 0
+    assert "ThreadSanitizer" not in out.stdout + out.stderr, out.stdout
+    # tcp crash + shrink: reader threads observe the victim's EOF while
+    # rank threads are mid-collective; survivors switch comms live
+    tcp_crash = '{"events":[{"kind":"crash","ranks":[1],"iteration":3}]}'
+    procs, outs = _spawn_ranks_with_port_retry(
+        lambda r, port: ([str(build / "bin" / "fault_selftest"),
+                          "--backend", "tcp", "--world", "3",
+                          "--rank", str(r),
+                          "--coordinator", f"127.0.0.1:{port}",
+                          "--iters", "6", "--fault", tcp_crash,
+                          "--fault_policy", "shrink"], None),
+        3, timeout=300)
+    assert procs[1].returncode != 0  # the scripted victim
+    for r in (0, 2):
+        assert procs[r].returncode == 0, \
+            f"tcp shrink survivor {r} under tsan:\n{outs[r]}"
+    for out_text in outs:
+        assert "ThreadSanitizer" not in out_text, out_text

@@ -38,3 +38,56 @@ def test_wrap_and_default_message(capsys):
     assert wd.stalls == 1
     err = capsys.readouterr().err
     assert "train_step" in err and "deadline" in err
+
+
+def test_heartbeat_ages_and_record_stamp():
+    """Satellite: per-key last-progress heartbeat ages stamped into the
+    emitted record so post-mortems of hung runs show WHERE progress
+    stopped."""
+    wd = StepWatchdog(5.0, name="step")
+    wd.beat("rank0")
+    time.sleep(0.05)
+    wd.beat("rank1")
+    ages = wd.heartbeat_ages()
+    assert set(ages) == {"rank0", "rank1"}
+    # rank0's beat is older: that is where progress stopped first
+    assert ages["rank0"] > ages["rank1"] >= 0.0
+    meta = {}
+    wd.stamp(meta)
+    stamped = meta["watchdog_heartbeat_age_s"]
+    assert stamped["rank0"] >= stamped["rank1"] >= 0.0
+    assert meta["watchdog_stalls"] == 0
+
+
+def test_stall_message_names_the_last_progress(capsys):
+    """The stall diagnostic names the MOST RECENT beat — the last
+    progress made; the hang sits just past it (the oldest beat would be
+    the first phase to complete, the opposite of where it is stuck)."""
+    wd = StepWatchdog(0.05, name="collective")
+    wd.beat("chain_0")
+    time.sleep(0.02)
+    wd.beat("chain_1")
+    with wd:
+        time.sleep(0.12)
+    err = capsys.readouterr().err
+    assert wd.stalls == 1
+    assert "last progress" in err and "'chain_1'" in err
+
+
+def test_run_proxy_stamps_heartbeats():
+    """ProxyConfig.watchdog: the harness beats per phase/chain and the
+    record's globals carry the ages at emission."""
+    from dlnetbench_tpu.proxies.base import ProxyConfig, StepBundle, \
+        run_proxy
+
+    wd = StepWatchdog(30.0, name="dp_step")
+    bundle = StepBundle(full=lambda: None, compute=None, comm=None,
+                        global_meta={})
+    cfg = ProxyConfig(warmup=1, runs=2, measure_energy=False,
+                      measure_comm_only=False, measure_compute_only=False,
+                      watchdog=wd)
+    res = run_proxy("wd_test", bundle, cfg)
+    ages = res.global_meta["watchdog_heartbeat_age_s"]
+    assert "warmup" in ages and "chain_0" in ages and "chain_1" in ages
+    assert all(v >= 0.0 for v in ages.values())
+    assert res.global_meta["watchdog_stalls"] == 0
