@@ -1,0 +1,24 @@
+# Convenience lanes. The python package needs no build step — these are
+# the test/guard entry points CI and humans share.
+
+PYTHON ?= python
+
+.PHONY: test check-bench sentinel-scan
+
+# tier-1: the full default test lane (see ROADMAP.md for the canonical
+# driver invocation with its timeout/log plumbing)
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+# the bench regression sentinel, end to end on a tiny CPU config
+# (tests/test_sentinel.py::test_bench_check_lane): baseline capture, a
+# clean re-run of bench.py --check that must stay quiet, and a
+# deterministically injected +10% slowdown (faults delay injector) that
+# must exit non-zero.  ~30s wall.
+check-bench:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_sentinel.py -q -m sentinel
+
+# stat-band-aware walk over the committed driver artifacts: fails when
+# the LATEST BENCH_r*.json regressed against its predecessor
+sentinel-scan:
+	JAX_PLATFORMS=cpu $(PYTHON) -m dlnetbench_tpu.sentinel .

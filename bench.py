@@ -70,13 +70,20 @@ def _compile_chain(fn, arg):
     return prog
 
 
-def _measure_chain(fn, arg, k: int) -> dict:
+def _measure_chain(fn, arg, k: int, cost_out: dict | None = None) -> dict:
     """Compile+warm via ``_compile_chain``, then the band summary of 3
     K-chained rounds in per-iteration SECONDS ({"value": median,
     "best", "band", "n"} — metrics/stats.py).  Shared by every
-    auxiliary bench line so fence/timing fixes happen once."""
+    auxiliary bench line so fence/timing fixes happen once.
+    ``cost_out`` (if a dict) receives the compiled program's own
+    per-ITERATION cost analysis — the XLA-counted flops/bytes the
+    attribution block records as provenance next to the analytic
+    model."""
     from dlnetbench_tpu.utils.timing import time_callable
     prog = _compile_chain(fn, arg)
+    if cost_out is not None and prog.cost_analysis:
+        cost_out.update({name: v / k
+                         for name, v in prog.cost_analysis.items()})
     return stats_mod.summarize([t / k for t in time_callable(prog, reps=3)])
 
 
@@ -146,6 +153,23 @@ def _skipped(metric: str, why: str) -> None:
     print(json.dumps({"metric": metric, "skipped": why}))
 
 
+def _stamp_attr(line: dict, *, time_s: float, flops: float, nbytes: float,
+                hw, dtype_key: str, peak_flops: float | None = None,
+                xla_cost: dict | None = None) -> dict:
+    """Stamp the attribution block onto a bench line (every ms line
+    carries one — the joined {fractions, bound} verdict next to its
+    bands; analysis/attribution.py)."""
+    from dlnetbench_tpu.analysis import attribution
+    block = attribution.attribute_kernel(
+        time_s, flops, nbytes, hw, dtype_key, peak_flops=peak_flops,
+        source="model",
+        extra_inputs=({"xla_cost_per_iter": xla_cost} if xla_cost
+                      else None))
+    if block is not None:
+        line["attribution"] = block
+    return line
+
+
 from dlnetbench_tpu.utils.tpu_probe import env_float  # noqa: E402
 
 _AUX_DEADLINE_S = env_float("DLNB_BENCH_AUX_DEADLINE_S", 900.0)
@@ -212,6 +236,30 @@ def _parse_args(argv=None):
                    help="write a merged host+device Chrome/Perfetto "
                         "trace of this bench run (host harness spans + "
                         "one profiled headline iteration)")
+    p.add_argument("--check", default=None, metavar="BASELINE",
+                   help="regression sentinel (dlnetbench_tpu/sentinel.py):"
+                        " compare this run's headline + aux lines against "
+                        "a baseline bench artifact (BENCH_r*.json driver "
+                        "capture or bench stdout JSONL), write a "
+                        "'sentinel' section into the headline line, and "
+                        "exit non-zero on a regression (median worse by "
+                        "> --check-threshold %% AND stat bands disjoint)")
+    p.add_argument("--check-threshold", "--check_threshold",
+                   dest="check_threshold", type=float, default=5.0,
+                   help="percent slowdown that (with disjoint bands) "
+                        "counts as a regression (default 5)")
+    p.add_argument("--fault", default=None, metavar="PLAN",
+                   help="JSON fault plan (faults/plan.py schema) injected "
+                        "at headline step boundaries INSIDE the timed "
+                        "window — the deterministic-slowdown channel the "
+                        "sentinel lane uses to prove --check trips; the "
+                        "headline is stamped with the plan and its "
+                        "attribution verdict becomes 'faulted'")
+    p.add_argument("--skip-aux", "--skip_aux", dest="skip_aux",
+                   action="store_true",
+                   help="measure only the headline train step (the "
+                        "sentinel lane's tiny-CPU mode; aux lines emit "
+                        "nothing, not even skip markers)")
     return p.parse_args(argv)
 
 
@@ -257,12 +305,32 @@ def _run_bench(args, tracer) -> int:
     if cache_dir:
         print(f"persistent compile cache: {cache_dir}", file=sys.stderr)
 
+    # --fault: parse and validate the plan BEFORE any compile spend.
+    # The bench is a single-process measurement with no degradation
+    # policy: only slowdown kinds make sense here.  A crash/partition
+    # trigger would raise mid-timed-window after minutes of
+    # compile+warmup — refuse up front instead (the same
+    # refuse-what-you-can't-honor convention the unwired native proxies
+    # follow).  The injector itself wraps the timed step further down.
+    fault_plan = None
+    if args.fault:
+        from dlnetbench_tpu.faults.plan import FaultPlan
+        fault_plan = FaultPlan.loads(args.fault).validate()
+        bad = sorted({e.kind for e in fault_plan.events
+                      if e.kind not in ("delay", "jitter")})
+        if bad:
+            print(f"--fault: bench.py only honors delay/jitter events "
+                  f"(got {', '.join(bad)}) — crash/drop/partition need "
+                  f"a multi-rank harness with a degradation policy "
+                  f"(cli.py --fault)", file=sys.stderr)
+            return 2
+
     dev = jax.devices()[0]
-    # "TPU v5 lite" -> tpu_v5e, "TPU v5p"/"TPU v4"/"TPU v6 lite" likewise
-    kind = dev.device_kind.lower().replace(" ", "").replace("lite", "e")
-    hw_key = next((k for k in HARDWARE
-                   if k.startswith("tpu") and k.replace("tpu_", "") in kind),
-                  "tpu_v5e")
+    # "TPU v5 lite" -> tpu_v5e etc (core/hardware.py, shared with the
+    # attribution engine's record pathway); unknown kinds — including
+    # the CPU mesh the sentinel lane runs on — price against v5e
+    from dlnetbench_tpu.core.hardware import hw_key_for_device_kind
+    hw_key = hw_key_for_device_kind(dev.device_kind) or "tpu_v5e"
     # r3 accounting fixes: (1) vs_baseline_causal divides the credited
     # S^2 score FLOPs by 2 (the flash kernel executes only the causal
     # half); (2) the LM-head logits matmul is credited (see below) —
@@ -306,7 +374,13 @@ def _run_bench(args, tracer) -> int:
     # The step itself is built by models/bench_step.py, SHARED with
     # examples/xla_knob_study.py so compiler-knob sweeps tune exactly
     # this program.
-    K = 10  # train steps chained inside ONE program
+    # train steps chained inside ONE program.  Env-overridable with the
+    # same import-frozen discipline as the DLNB_BENCH_* shape knobs: the
+    # sentinel lane raises K on its tiny CPU config so fence/dispatch
+    # jitter amortizes and the 3-round band is tight enough for a 10%
+    # injected slowdown to land outside it (tests/test_sentinel.py).
+    from dlnetbench_tpu.utils.tpu_probe import env_int
+    K = env_int("DLNB_BENCH_K", 10)
     with spans.span("build", what="headline train_k"):
         train_k_fn, params, tokens, card, cfg = bench_step.build(K)
 
@@ -331,12 +405,28 @@ def _run_bench(args, tracer) -> int:
         losses[-1].item()   # true fence (block_until_ready only acks
                             # dispatch on the tunnel) so rep 1 starts clean
 
+    # --fault: scripted step-boundary injection INSIDE the timed window
+    # (faults/inject.py — the same injector the proxies use), so a
+    # deterministic slowdown inflates the measured headline exactly like
+    # a real straggler would.  The warm run stays clean; the plan rides
+    # the headline line so a faulted artifact can never pass as a clean
+    # measurement.  (Plan already parsed+validated up top, before the
+    # compile spend.)
+    timed_step = train_k
+    if fault_plan is not None:
+        from dlnetbench_tpu.faults.inject import FaultInjector
+        injector = FaultInjector(fault_plan)
+
+        def timed_step():
+            injector.before_chain(K)  # K in-program steps per dispatch
+            return train_k()
+
     # three rounds of K in-program steps (each fences once); median guards
     # against a slow round from tunnel or host jitter — and the band of
     # the three rounds ships on the line (metrics/stats.py)
     with spans.span("timed", what="headline", reps=3, k=K):
         step_summary = stats_mod.summarize(
-            [t / K for t in time_callable(train_k, reps=3)])
+            [t / K for t in time_callable(timed_step, reps=3)])
     step_s = step_summary["value"]
     # materialize EVERY device value the headline will print BEFORE any
     # auxiliary line runs: an aux failure that poisons the backend (the
@@ -424,32 +514,37 @@ def _run_bench(args, tracer) -> int:
     # on stdout (tail parsers take the final JSON line); results also
     # ride inside the headline object for first-line parsers; failures
     # degrade to skipped markers (_aux) rather than losing the headline
-    fp8 = _aux("fp8 mlp matmul", _bench_fp8_mlp, card, hw_key, dev)
-    fp8_chain = _aux("fp8 swiglu chain", _bench_fp8_swiglu_chain,
-                     card, hw_key, dev)
-    int8 = _aux("int8 matmul", _bench_int8_matmul, card, hw_key, dev)
-    int8_ab = _aux("int8 fused-quant A/B", _bench_quant_fused_ab,
-                   card, hw_key, dev, "int8")
-    fp8_ab = _aux("fp8 fused-quant A/B", _bench_quant_fused_ab,
-                  card, hw_key, dev, "float8")
-    # cheap (tiny dp step, 3 interleaved rounds): the faulted-vs-clean
-    # straggler pairing — measured amplification of an injected delay
-    straggler = _aux("straggler A/B", _bench_straggler_ab)
-    # LAST among the aux lines: they are the most expensive (a full
-    # train-step compile+measure each) and the only ones with a known
-    # backend-poisoning failure mode (the r5 composed-VJP OOM) —
-    # running them after the cheap lines means a blowup costs only
-    # itself; switchback last (it is the opt-in recipe, int8_step the
-    # default one)
-    int8_step = _aux("int8 train step", _bench_int8_step, card, hw_key,
-                     dev, step_s, opts)
-    int8_sb = _aux("int8 switchback train step", _bench_int8_step, card,
-                   hw_key, dev, step_s, opts, "switchback")
-    # LAST of all: six train-step compiles of its own (2 configs x 3
-    # A/B variants) — it must not spend the shared aux deadline before
-    # the int8 step lines the recommended_step comparison depends on;
-    # single-chip sessions skip it outright
-    overlap_ab = _aux("spmd overlap A/B", _bench_overlap_ab)
+    if args.skip_aux:
+        fp8 = fp8_chain = int8 = int8_ab = fp8_ab = None
+        straggler = int8_step = int8_sb = overlap_ab = None
+    else:
+        fp8 = _aux("fp8 mlp matmul", _bench_fp8_mlp, card, hw_key, dev)
+        fp8_chain = _aux("fp8 swiglu chain", _bench_fp8_swiglu_chain,
+                         card, hw_key, dev)
+        int8 = _aux("int8 matmul", _bench_int8_matmul, card, hw_key, dev)
+        int8_ab = _aux("int8 fused-quant A/B", _bench_quant_fused_ab,
+                       card, hw_key, dev, "int8")
+        fp8_ab = _aux("fp8 fused-quant A/B", _bench_quant_fused_ab,
+                      card, hw_key, dev, "float8")
+        # cheap (tiny dp step, 3 interleaved rounds): the
+        # faulted-vs-clean straggler pairing — measured amplification
+        # of an injected delay
+        straggler = _aux("straggler A/B", _bench_straggler_ab)
+        # LAST among the aux lines: they are the most expensive (a full
+        # train-step compile+measure each) and the only ones with a
+        # known backend-poisoning failure mode (the r5 composed-VJP
+        # OOM) — running them after the cheap lines means a blowup
+        # costs only itself; switchback last (it is the opt-in recipe,
+        # int8_step the default one)
+        int8_step = _aux("int8 train step", _bench_int8_step, card,
+                         hw_key, dev, step_s, opts)
+        int8_sb = _aux("int8 switchback train step", _bench_int8_step,
+                       card, hw_key, dev, step_s, opts, "switchback")
+        # LAST of all: six train-step compiles of its own (2 configs x
+        # 3 A/B variants) — it must not spend the shared aux deadline
+        # before the int8 step lines the recommended_step comparison
+        # depends on; single-chip sessions skip it outright
+        overlap_ab = _aux("spmd overlap A/B", _bench_overlap_ab)
 
     # the driver-captured recommendation (VERDICT r5 item #1): the
     # fastest recipe among the A/B variants this run actually measured
@@ -491,18 +586,72 @@ def _run_bench(args, tracer) -> int:
         **({"int8_step": int8_step} if int8_step else {}),
         **({"int8_switchback_step": int8_sb} if int8_sb else {}),
         "recommended_step": recommended,
+        **({"fault_plan": fault_plan.to_dict()} if fault_plan else {}),
     })
+    # bottleneck attribution (analysis/attribution.py): the headline's
+    # measured time against its own credited FLOPs and backward-aware
+    # step traffic — {fractions, bound} rides the line like the bands do
+    from dlnetbench_tpu.analysis import attribution
+    headline_attr = attribution.attribute_kernel(
+        step_s, total_flops, step_bytes_bwd, HARDWARE[hw_key],
+        "bfloat16", faulted=fault_plan is not None, source="model",
+        extra_inputs=({"xla_cost_per_step": {
+            k: v / K for k, v in aot_stats["cost_analysis"].items()}}
+            if "cost_analysis" in aot_stats else None))
+    if headline_attr is not None:
+        headline["attribution"] = headline_attr
+
+    # regression sentinel (--check): stat-band-aware comparison against
+    # a committed baseline artifact; the verdict ships INSIDE the
+    # headline (the artifact records its own check) and the exit code
+    # carries it to CI
+    sentinel_section = None
+    check_rc = 0
+    if args.check:
+        from dlnetbench_tpu import sentinel as sentinel_mod
+        try:
+            base_lines = sentinel_mod.bench_lines(args.check)
+        except (OSError, ValueError) as e:
+            # ValueError covers UnicodeDecodeError on a binary/mangled
+            # baseline — the measurement above must survive either way
+            print(f"--check: cannot read baseline ({e})", file=sys.stderr)
+            base_lines = {}
+        if not base_lines.get("headline"):
+            # a tripwire that silently disarms is worse than no tripwire:
+            # an unreadable/headline-less baseline is a misconfiguration
+            # and must FAIL the run, not let every future regression ship
+            # green.  The measurement above still prints in full.
+            print(f"--check: baseline {args.check} has no comparable "
+                  f"headline — sentinel cannot arm", file=sys.stderr)
+            check_rc = 2
+        cur_lines = {"headline": headline,
+                     **{k: v for k, v in headline.items()
+                        if sentinel_mod.is_ms_line(v)}}
+        sentinel_section = sentinel_mod.check(
+            base_lines, cur_lines, args.check_threshold,
+            baseline_label=str(args.check))
+        headline["sentinel"] = sentinel_section
+
     print(json.dumps(headline))
     if tracer is not None:
         spans.disable()
         try:
-            spans.write_chrome_trace(args.trace_out, tracer, device_events)
+            spans.write_chrome_trace(
+                args.trace_out, tracer, device_events,
+                extra_events=spans.attribution_counter_events(
+                    headline_attr or {}, dur_us=step_s * 1e6))
             print(f"merged host+device trace -> {args.trace_out}",
                   file=sys.stderr)
         except OSError as e:  # the headline already printed — keep rc 0
             print(f"trace-out write failed ({e}); headline unaffected",
                   file=sys.stderr)
-    return 0
+    if sentinel_section and sentinel_section.get("verdict") == "regression":
+        from dlnetbench_tpu.sentinel import RC_REGRESSION
+        print(f"sentinel: REGRESSION vs {args.check}: "
+              f"{', '.join(sentinel_section['regressions'])}",
+              file=sys.stderr)
+        return RC_REGRESSION
+    return check_rc
 
 
 # numerics bar for the recommended-step recipe: single-step loss within
@@ -613,6 +762,11 @@ def _bench_straggler_ab() -> dict | None:
         "n": rounds,
         "world": n,
     }
+    from dlnetbench_tpu.analysis.attribution import straggler_block
+    attr = straggler_block(clean["value"] * 1e3, faulted["value"] * 1e3,
+                           delay_us / 1e3)
+    if attr is not None:
+        line["attribution"] = attr
     print(json.dumps(line))
     return line
 
@@ -740,7 +894,13 @@ def _bench_int8_step(card, hw_key: str, dev, bf16_step_s: float,
         "tflops_achieved": round(total_flops / step_s / 1e12, 2),
         "loss": round(loss, 4),
     }
-    line = stats_mod.flag_low_mode(line)
+    # attribution against the same split-peak roofline the line's
+    # vs_baseline prices (int8 dots at the int8 peak, rest at bf16):
+    # the effective peak is total_flops / roofline_split_s
+    line = _stamp_attr(
+        stats_mod.flag_low_mode(line), time_s=step_s, flops=total_flops,
+        nbytes=roofline.train_step_bytes(card, BATCH, "bfloat16"), hw=hw,
+        dtype_key="bfloat16", peak_flops=total_flops / roofline_split_s)
     print(json.dumps(line))
     return line
 
@@ -787,7 +947,8 @@ def _bench_fp8_mlp(card, hw_key: str, dev) -> dict | None:
             return fp8_dot(xc, w).astype(xc.dtype), ()
         return jax.lax.scan(body, x0, None, length=K)[0]
 
-    summary = _measure_chain(chain, x, K)
+    xla_cost: dict = {}
+    summary = _measure_chain(chain, x, K, cost_out=xla_cost)
     t_s = summary["value"]
 
     flops = 2 * tokens * d * d
@@ -805,7 +966,9 @@ def _bench_fp8_mlp(card, hw_key: str, dev) -> dict | None:
         "vs_baseline": round(roofline_s / t_s, 4),
         "tflops_achieved": round(flops / t_s / 1e12, 2),
     }
-    line = stats_mod.flag_low_mode(_flag_above_peak(line))
+    line = _stamp_attr(stats_mod.flag_low_mode(_flag_above_peak(line)),
+                       time_s=t_s, flops=flops, nbytes=nbytes, hw=hw,
+                       dtype_key="float8", xla_cost=xla_cost)
     print(json.dumps(line))
     return line
 
@@ -861,9 +1024,15 @@ def _bench_fp8_swiglu_chain(card, hw_key: str, dev) -> dict | None:
 
     # chain total: gate + up (two identical stages) + down — each stage
     # measured independently, bands added linearly
-    summary = _combine_linear([(2, _measure_chain(up_chain, x, K)),
-                               (1, _measure_chain(down_chain, h0, K))])
+    up_cost: dict = {}
+    down_cost: dict = {}
+    summary = _combine_linear(
+        [(2, _measure_chain(up_chain, x, K, cost_out=up_cost)),
+         (1, _measure_chain(down_chain, h0, K, cost_out=down_cost))])
     t_s = summary["value"]
+    xla_cost = ({k: 2 * up_cost.get(k, 0) + down_cost.get(k, 0)
+                 for k in set(up_cost) | set(down_cost)}
+                if up_cost or down_cost else {})
 
     flops = 6 * tokens * d * f  # three T*D*F matmuls
     nbytes = int(BYTES_PER_ELEMENT["float8"]
@@ -883,7 +1052,9 @@ def _bench_fp8_swiglu_chain(card, hw_key: str, dev) -> dict | None:
                              / t_s, 4),
         "tflops_achieved": round(flops / t_s / 1e12, 2),
     }
-    line = stats_mod.flag_low_mode(_flag_above_peak(line))
+    line = _stamp_attr(stats_mod.flag_low_mode(_flag_above_peak(line)),
+                       time_s=t_s, flops=flops, nbytes=nbytes, hw=hw,
+                       dtype_key="float8", xla_cost=xla_cost)
     print(json.dumps(line))
     return line
 
@@ -926,7 +1097,8 @@ def _bench_int8_matmul(card, hw_key: str, dev) -> dict | None:
             return (y >> 8).astype(jnp.int8), ()
         return jax.lax.scan(body, x0, None, length=K)[0]
 
-    summary = _measure_chain(chain, x, K)
+    xla_cost: dict = {}
+    summary = _measure_chain(chain, x, K, cost_out=xla_cost)
     t_s = summary["value"]
 
     ops = 2 * tokens * d * d
@@ -941,7 +1113,9 @@ def _bench_int8_matmul(card, hw_key: str, dev) -> dict | None:
                              4),
         "tops_achieved": round(ops / t_s / 1e12, 2),
     }
-    line = stats_mod.flag_low_mode(_flag_above_peak(line))
+    line = _stamp_attr(stats_mod.flag_low_mode(_flag_above_peak(line)),
+                       time_s=t_s, flops=ops, nbytes=nbytes, hw=hw,
+                       dtype_key="int8", xla_cost=xla_cost)
     print(json.dumps(line))
     return line
 
@@ -1069,6 +1243,10 @@ def _bench_quant_fused_ab(card, hw_key: str, dev, fmt: str) -> dict | None:
         f"{peak/1e12:.0f} T/s)",
         summaries, round_times, flops,
         _roofline_s(flops, nbytes, hw, peak_key))
+    # attribution of the FUSED path (the line's headline value)
+    line = _stamp_attr(line, time_s=summaries["fused"]["value"],
+                       flops=flops, nbytes=nbytes, hw=hw,
+                       dtype_key=peak_key)
     print(json.dumps(line))
     return line
 

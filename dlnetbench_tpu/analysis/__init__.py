@@ -9,6 +9,7 @@ from dlnetbench_tpu.metrics.parser import get_metrics_dataframe, records_to_data
 from dlnetbench_tpu.analysis.py_utils import format_bytes, parse_bytes
 from dlnetbench_tpu.analysis.plots import (
     pareto_front,
+    plot_attribution_stack,
     plot_barrier_scatter_by_bucket,
     plot_pareto,
     plot_runtime_scaling,
@@ -23,4 +24,5 @@ __all__ = [
     "plot_runtime_scaling",
     "plot_barrier_scatter_by_bucket",
     "plot_pareto",
+    "plot_attribution_stack",
 ]

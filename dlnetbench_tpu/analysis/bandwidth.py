@@ -187,6 +187,19 @@ def effective_bandwidth(records: list[dict]):
         detection_ms = float(g.get("detection_ms", float("nan")))
         recovery_ms = float(g.get("recovery_ms", float("nan")))
         straggler_amp = straggler_amplification(rec)
+        # attribution verdict + fractions (analysis/attribution.py,
+        # stamped by emit/merge): every bandwidth row says what bound
+        # the run it came from; records without a block get NaN/"n/a"
+        attr = g.get("attribution") or {}
+        attr_fr = attr.get("fractions") or {}
+        attr_bound = attr.get("bound") or "n/a"
+        attr_cols = {
+            "attr_bound": attr_bound,
+            "attr_compute": float(attr_fr.get("compute", float("nan"))),
+            "attr_hbm": float(attr_fr.get("hbm", float("nan"))),
+            "attr_comm": float(attr_fr.get("comm_exposed", float("nan"))),
+            "attr_host": float(attr_fr.get("host", float("nan"))),
+        }
         for rank_row in rec.get("ranks", []):
             # measured comm–compute overlap fraction (schema v2+,
             # proxies/base.py): one dimensionless sample per run, riding
@@ -285,6 +298,7 @@ def effective_bandwidth(records: list[dict]):
                         "detection_ms": detection_ms,
                         "recovery_ms": recovery_ms,
                         "straggler_amp": straggler_amp,
+                        **attr_cols,
                     })
     return pd.DataFrame(rows)
 
@@ -305,7 +319,8 @@ def bandwidth_summary(records: list[dict]):
     if bw.empty:
         return bw
     return (bw.groupby(["section", "model", "collective", "group_size",
-                        "bound", "transport"])
+                        "bound", "transport", "attr_bound"])
             [["time_us", "msg_bytes", "algbw_GBps", "busbw_GBps",
-              "overlap", "straggler_amp", "detection_ms", "recovery_ms"]]
+              "overlap", "straggler_amp", "detection_ms", "recovery_ms",
+              "attr_compute", "attr_hbm", "attr_comm", "attr_host"]]
             .mean().reset_index())

@@ -108,6 +108,39 @@ def plot_barrier_scatter_by_bucket(df, *, y="barrier_time",
     return ax
 
 
+def plot_attribution_stack(df, *, group_by=("section", "model"), ax=None):
+    """Stacked horizontal bars of the mean attribution fractions
+    (``attr_compute``/``attr_hbm``/``attr_comm``/``attr_host`` — the
+    columns ``analysis.bandwidth.effective_bandwidth`` carries per row)
+    per group: one glance says which runs are MXU-bound vs comm-exposed
+    vs host-dominated.  Groups whose records carry no attribution block
+    (all-NaN fractions) are dropped."""
+    frac_cols = ["attr_compute", "attr_hbm", "attr_comm", "attr_host"]
+    group_by = list(group_by)
+    _require_cols(df, group_by + frac_cols)
+    sub = df.dropna(subset=frac_cols, how="all")
+    means = sub.groupby(group_by)[frac_cols].mean().dropna(how="all")
+    if means.empty:
+        raise ValueError("no rows carry attribution fractions")
+    ax = _get_ax(ax)
+    labels = [" / ".join(str(v) for v in (k if isinstance(k, tuple)
+                                          else (k,)))
+              for k in means.index]
+    left = [0.0] * len(means)
+    colors = {"attr_compute": "tab:blue", "attr_hbm": "tab:orange",
+              "attr_comm": "tab:red", "attr_host": "tab:gray"}
+    for col in frac_cols:
+        vals = means[col].fillna(0.0).tolist()
+        ax.barh(labels, vals, left=left, label=col.removeprefix("attr_"),
+                color=colors[col])
+        left = [sum(p) for p in zip(left, vals)]
+    ax.set_xlabel("fraction of wall-clock (attribution)")
+    ax.set_xlim(0, 1.05)
+    ax.legend(fontsize=8, loc="lower right")
+    ax.grid(True, axis="x", alpha=0.3)
+    return ax
+
+
 def np_isnum(v) -> bool:
     try:
         float(v)

@@ -330,6 +330,10 @@ def _run_measured(args, parser, stats, cfg, devices, dtype, dtype_name,
             if args.profile:
                 result.global_meta["profile"] = \
                     profiling.collective_stats(device_events)
+                # per-op channel: the attribution block's top_ops
+                # prefers this over the kind-level profile summary
+                result.global_meta["device_top_ops"] = \
+                    profiling.top_device_ops(device_events)
         except Exception as e:
             print(f"profile/trace capture failed "
                   f"({type(e).__name__}: {e}); record unaffected",
@@ -343,7 +347,20 @@ def _run_measured(args, parser, stats, cfg, devices, dtype, dtype_name,
         except OSError as e:
             print(f"trace-out write failed ({e}); record unaffected",
                   file=sys.stderr)
-    emit_result(result, path=args.out)
+    record = emit_result(result, path=args.out)
+    # one-line bottleneck verdict on stderr (stdout stays pure JSON):
+    # the record's attribution block (metrics/emit.py joins cost
+    # analysis + roofline + decomposition timers + transport peak —
+    # analysis/attribution.py), rendered so a terminal run answers
+    # "what bound this?" without an analysis pass
+    attr = record.get("global", {}).get("attribution")
+    if attr:
+        fr = attr.get("fractions", {})
+        print("bottleneck: " + attr.get("bound", "?")
+              + " (" + " ".join(f"{k}={fr.get(k, 0.0):.2f}"
+                                for k in ("compute", "hbm",
+                                          "comm_exposed", "host"))
+              + ")", file=sys.stderr)
     return 0
 
 

@@ -168,6 +168,21 @@ class CompiledProgram:
         """The program's current argument buffers (for re-tracing)."""
         return tuple(self._args)
 
+    # per-program cost stats as first-class attributes (not just the
+    # global_meta channel compile_programs writes): the attribution
+    # engine joins a program's OWN flops/bytes with its OWN timers —
+    # e.g. bench.py's chained microbenches, which never go through
+    # compile_programs
+    @property
+    def cost_analysis(self) -> dict | None:
+        """XLA's {flops, bytes_accessed} for THIS executable, or None
+        when the backend implements no cost analysis."""
+        return self.stats.get("cost_analysis")
+
+    @property
+    def memory_analysis(self) -> dict | None:
+        return self.stats.get("memory_analysis")
+
     def __call__(self):
         outs = self._compiled(*self._args)
         if self._rebind:

@@ -72,5 +72,21 @@ HARDWARE: dict[str, HardwareSpec] = {
 
 DEFAULT_DEVICE = "tpu_v5p"
 
+
+def hw_key_for_device_kind(kind: str | None) -> str | None:
+    """``HARDWARE`` key for a jax ``device_kind`` string ("TPU v5 lite"
+    -> ``tpu_v5e``, "TPU v5p" -> ``tpu_v5p``); None for non-TPU kinds —
+    a cpu/host mesh has no roofline preset and its numbers must never be
+    priced against one.  One definition shared by bench.py's chip
+    detection and the attribution engine's record pathway."""
+    if not kind:
+        return None
+    k = str(kind).lower().replace(" ", "").replace("lite", "e")
+    if "tpu" not in k:
+        return None
+    return next((key for key in HARDWARE
+                 if key.startswith("tpu") and key.replace("tpu_", "") in k),
+                None)
+
 BYTES_PER_ELEMENT = {"bfloat16": 2.0, "float8": 1.0, "float32": 4.0,
                      "int8": 1.0, "nvfp4": 0.5}

@@ -136,7 +136,7 @@ def result_to_record(result: ProxyResult) -> dict:
     g.setdefault("transport", transport_label(mesh_info))
     if num_procs > 1:
         g.setdefault("num_processes", num_procs)
-    return {
+    record = {
         "section": result.name,
         "version": SCHEMA_VERSION,
         # which process measured this record's clocks — metrics.merge
@@ -148,6 +148,20 @@ def result_to_record(result: ProxyResult) -> dict:
         "warmup_times": result.warmup_times_us,
         "ranks": ranks,
     }
+    # bottleneck attribution (schema v2+): join the AOT cost analysis,
+    # the chip roofline, the measured decomposition timers, and the
+    # transport peak into one {fractions, bound} verdict riding the
+    # record — derived data, so a failure here must never cost the
+    # measurement it describes
+    try:
+        from dlnetbench_tpu.analysis.attribution import attribute_record
+        block = attribute_record(record)
+        if block is not None:
+            g["attribution"] = block
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"attribution stamping failed ({type(e).__name__}: {e}); "
+              f"record unaffected", file=sys.stderr)
+    return record
 
 
 def emit_result(result: ProxyResult, stream=None, path: str | None = None) -> dict:

@@ -47,7 +47,12 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      "detection_ms", "recovery_ms", "fault_drops",
                      "fault_retries", "fault_injected_delay_us",
                      "fault_iteration", "watchdog_heartbeat_age_s",
-                     "watchdog_stalls"}
+                     "watchdog_stalls", "watchdog_stall_spans",
+                     # each process times its own fence RTT, profiles
+                     # its own device ops, and attributes its own
+                     # clocks; the merged record gets attribution
+                     # recomputed over the pooled rows below
+                     "host_rtt_us", "attribution", "device_top_ops"}
 
 # scheduler-stamped variables that identify the PROCESS, not the run
 # (metrics.emit.scheduler_variables): they legitimately differ between
@@ -182,6 +187,22 @@ def merge_records(records: list[dict]) -> dict:
         for proc, rec in sorted(by_process.items())
     }
     validate_record(merged)
+    # attribution over the POOLED per-process rows (each input record's
+    # block covered only its own clocks).  This is also where NATIVE
+    # records — whose C++ emitter stamps no attribution — get theirs
+    # mirrored from the timer summaries they do carry.  Derived data: a
+    # failure must never abort a merge of valid measurements.
+    try:
+        from dlnetbench_tpu.analysis.attribution import attribute_record
+        merged["global"] = dict(merged["global"])
+        block = attribute_record(merged)
+        if block is not None:
+            merged["global"]["attribution"] = block
+        else:
+            merged["global"].pop("attribution", None)
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"merge attribution failed ({type(e).__name__}: {e}); "
+              f"merged record keeps its inputs", file=sys.stderr)
     return merged
 
 
@@ -207,13 +228,18 @@ def merge_files(out_path: str | Path, in_paths: list[str | Path],
 def main(argv: list[str] | None = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     usage = ("usage: python -m dlnetbench_tpu.metrics.merge "
-             "[--section NAME] OUT.jsonl IN0.jsonl IN1.jsonl ...")
+             "[--section NAME] [--trace-out TRACE.json] "
+             "OUT.jsonl IN0.jsonl IN1.jsonl ...")
     section = None
-    if args and args[0] == "--section":
+    trace_out = None
+    while args and args[0] in ("--section", "--trace-out", "--trace_out"):
         if len(args) < 2:
             print(usage, file=sys.stderr)
             return 2
-        section = args[1]
+        if args[0] == "--section":
+            section = args[1]
+        else:
+            trace_out = args[1]
         args = args[2:]
     if len(args) < 2:
         print(usage, file=sys.stderr)
@@ -222,6 +248,20 @@ def main(argv: list[str] | None = None) -> int:
     print(f"merged {len(args) - 1} process record(s): "
           f"{merged['section']}, {len(merged['ranks'])} ranks "
           f"-> {args[0]}", file=sys.stderr)
+    if trace_out:
+        # the native tier has no in-process tracer, but its record
+        # carries per-rank timers + band summaries + (post-merge) the
+        # attribution block — rendered as Perfetto counter/duration
+        # tracks so --trace-out serves native runs too
+        from dlnetbench_tpu.metrics import spans
+        try:
+            spans.write_chrome_trace(
+                trace_out, None, align_span=None,
+                extra_events=spans.record_track_events(merged))
+            print(f"record trace -> {trace_out}", file=sys.stderr)
+        except OSError as e:
+            print(f"trace-out write failed ({e}); merged record "
+                  f"unaffected", file=sys.stderr)
     return 0
 
 

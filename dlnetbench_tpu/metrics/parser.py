@@ -142,6 +142,12 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                         row[k] = tuple(v)  # hashable, groupby-safe
                     elif not isinstance(v, dict):
                         row[k] = v
+                # attribution verdict (a dict global, skipped above):
+                # the one-word bound is groupby-grade and rides as its
+                # own column; v1/pre-attribution records simply lack it
+                attr = g.get("attribution")
+                if isinstance(attr, dict) and attr.get("bound"):
+                    row["attr_bound"] = attr["bound"]
                 for tname, tvals in timers.items():
                     if run < len(tvals):
                         # singular column names a la reference ('runtime')

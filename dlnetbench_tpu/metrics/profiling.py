@@ -20,6 +20,7 @@ from __future__ import annotations
 import glob
 import gzip
 import json
+import re
 import tempfile
 from pathlib import Path
 
@@ -58,7 +59,13 @@ def load_trace_events(trace_dir: str | Path) -> list[dict]:
     writes — the newest ``*.trace.json.gz`` under it is read) or a
     single trace file, plain ``.json`` or gzipped — which is how the
     merged host+device timelines ``metrics.spans.write_chrome_trace``
-    emits round-trip through the same loader."""
+    emits round-trip through the same loader.
+
+    Each event is annotated with its lane's thread name (``_thread``,
+    resolved from the trace's metadata events) when the trace carries
+    one: the occupancy functions below use it to keep host-lane events
+    out of the device buckets.  Merged/synthetic traces without thread
+    metadata get no annotation."""
     p = Path(trace_dir)
     if p.is_file():
         opener = gzip.open if p.name.endswith(".gz") else open
@@ -71,17 +78,72 @@ def load_trace_events(trace_dir: str | Path) -> list[dict]:
             raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
         with gzip.open(paths[-1]) as f:
             trace = json.load(f)
-    return [e for e in trace.get("traceEvents", [])
-            if e.get("ph") == "X" and "dur" in e]
+    raw = trace.get("traceEvents", [])
+    threads = {(e.get("pid"), e.get("tid")): (e.get("args") or {}).get("name")
+               for e in raw
+               if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    out = []
+    for e in raw:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        t = threads.get((e.get("pid"), e.get("tid")))
+        out.append({**e, "_thread": t} if t is not None else e)
+    return out
+
+
+# XLA HLO op names are bare identifiers (fusion.12, copy.3,
+# while.1.remat) — no spaces, paths, parens or $-prefixes; this shape
+# test drops runtime bookkeeping ("ThreadpoolListener::StartRegion",
+# "ThunkExecutor::Execute (wait for completion)") and most host python
+# spans ("$profiler.py:226 trace", "PjitFunction(<lambda>)"), which
+# share the raw trace's event stream.
+_XLA_OP_RE = re.compile(r"^[A-Za-z0-9_.\-]+$")
+
+# SOME host events are bare identifiers too — compiler passes ("dce",
+# "algsimp", "backend_compile") whenever a compile lands inside the
+# profiled window, argument bookkeeping ("ParseArguments") — but they
+# all run on the python dispatch thread, while XLA executor ops run on
+# the runtime's own pools (tf_XLAEigen/..., tf_XLATfrtCpuClient/...,
+# device lanes on TPU).  The ``_thread`` annotation from
+# ``load_trace_events`` separates them where the shape test cannot.
+_HOST_THREAD = "python"
+
+# the CPU thunk executor emits a "call" event whose duration encloses
+# the child ops it dispatches on the same lane — counting it would
+# double-count every child
+_WRAPPER_OPS = frozenset({"call"})
+
+
+def _device_op_name(e: dict) -> str | None:
+    """The event's op name when it is device occupancy, else None."""
+    if e.get("_thread") == _HOST_THREAD:
+        return None
+    name = str(e.get("name", ""))
+    if name in _WRAPPER_OPS or not _XLA_OP_RE.match(name):
+        return None
+    return name
 
 
 def collective_stats(events: list[dict]) -> dict[str, dict]:
-    """Per-collective-kind device-occupancy summary (durations in us)."""
+    """Per-collective-kind device-occupancy summary (durations in us).
+
+    Ops ``classify_op`` cannot name — fusions, convolutions, copies,
+    anything XLA renamed — are NOT dropped: device ops
+    (``_device_op_name``) bucket under ``other`` so occupancy
+    *fractions* computed from this summary (the attribution engine
+    divides a kind's total by the sum over all kinds) are conservative.
+    Silently dropping them made every collective look like a larger
+    share of device time than it was.  Host-lane events (python spans,
+    compiler passes when a compile lands in the window), thunk wrapper
+    events, and async completion markers (``end: ...``, which duplicate
+    the op they close) stay excluded."""
     by_kind: dict[str, list[float]] = {}
     for e in events:
-        kind = classify_op(e.get("name", ""))
-        if kind is not None:
-            by_kind.setdefault(kind, []).append(float(e["dur"]))
+        name = _device_op_name(e)
+        if name is None:
+            continue
+        kind = classify_op(name) or "other"
+        by_kind.setdefault(kind, []).append(float(e["dur"]))
     return {
         kind: {
             "count": len(durs),
@@ -91,6 +153,23 @@ def collective_stats(events: list[dict]) -> dict[str, dict]:
         }
         for kind, durs in sorted(by_kind.items())
     }
+
+
+def top_device_ops(events: list[dict], k: int = 5) -> list[dict]:
+    """Top-k device ops by total duration (name-aggregated): the
+    per-op channel ``cli.py --profile`` stamps as ``device_top_ops``
+    and the attribution engine prefers for its ``top_ops`` field.
+    Host-lane events, thunk wrappers, and async completion markers are
+    excluded like in ``collective_stats``."""
+    totals: dict[str, list[float]] = {}
+    for e in events:
+        name = _device_op_name(e)
+        if name is None:
+            continue
+        totals.setdefault(name, []).append(float(e["dur"]))
+    ranked = sorted(totals.items(), key=lambda kv: -sum(kv[1]))[:max(k, 0)]
+    return [{"op": name, "total_us": round(sum(durs), 1),
+             "count": len(durs)} for name, durs in ranked]
 
 
 def profile_collectives(fn, *args, trace_dir: str | Path | None = None,

@@ -68,7 +68,7 @@ class _Span:
         self.attrs = attrs
 
     def __enter__(self):
-        self._depth = self._tracer._push()
+        self._depth = self._tracer._push(self.name)
         self._t0 = time.perf_counter()
         return self
 
@@ -94,15 +94,32 @@ class Tracer:
         self.spans: list[dict] = []
         self._lock = threading.Lock()
         self._local = threading.local()
+        # tid -> stack of OPEN span names, readable from other threads:
+        # the watchdog's stall handler fires on a Timer thread and must
+        # see where the measuring thread currently is (the span stack is
+        # the postmortem breadcrumb the stall message dumps)
+        self._active: dict[int, list[str]] = {}
 
     # -- called by _Span --------------------------------------------
-    def _push(self) -> int:
+    def _push(self, name: str) -> int:
         depth = getattr(self._local, "depth", 0)
         self._local.depth = depth + 1
+        with self._lock:
+            self._active.setdefault(threading.get_ident(), []).append(name)
         return depth
 
     def _pop(self) -> None:
         self._local.depth = getattr(self._local, "depth", 1) - 1
+        with self._lock:
+            stack = self._active.get(threading.get_ident())
+            if stack:
+                stack.pop()
+
+    def active_stacks(self) -> dict[int, list[str]]:
+        """Snapshot of every thread's open-span stack (outermost first)."""
+        with self._lock:
+            return {tid: list(stack)
+                    for tid, stack in self._active.items() if stack}
 
     def _record(self, name: str, t0: float, t1: float, depth: int,
                 attrs: dict | None) -> None:
@@ -159,6 +176,13 @@ def span(name: str, **attrs):
     if t is None:
         return NULL_SPAN
     return t.span(name, **attrs)
+
+
+def active_stacks() -> dict[int, list[str]]:
+    """Every thread's currently-open span stack ({} when tracing is
+    off) — the watchdog's stall-time breadcrumb channel."""
+    t = _TRACER
+    return t.active_stacks() if t is not None else {}
 
 
 # ---------------------------------------------------------------------
@@ -222,6 +246,7 @@ def _colored_device_events(device_events: list[dict],
     out = []
     for e in device_events:
         ev = dict(e)
+        ev.pop("_thread", None)  # loader annotation, not trace data
         ev["ts"] = float(e.get("ts", 0.0)) + shift
         ev["pid"] = int(e.get("pid", 0)) + _DEVICE_PID_BASE
         kind = classify_op(str(e.get("name", "")))
@@ -236,14 +261,17 @@ def _colored_device_events(device_events: list[dict],
 
 def write_chrome_trace(path: str | Path, tracer: Tracer | None,
                        device_events: list[dict] | None = None,
-                       align_span: str | None = "profile") -> dict:
+                       align_span: str | None = "profile",
+                       extra_events: list[dict] | None = None) -> dict:
     """Write ONE merged Chrome trace: host spans + device-op events.
 
     ``align_span`` names the host span whose start the earliest device
     event is pinned to (the span that wrapped the profiled iteration);
-    when absent the device timeline starts at host ts 0.  Returns the
-    trace dict that was written (callers/tests can inspect it without
-    re-reading the file)."""
+    when absent the device timeline starts at host ts 0.
+    ``extra_events`` are appended verbatim — the attribution counter
+    tracks and record-derived per-rank tracks ride this channel.
+    Returns the trace dict that was written (callers/tests can inspect
+    it without re-reading the file)."""
     events: list[dict] = []
     align_to = None
     if tracer is not None:
@@ -255,8 +283,107 @@ def write_chrome_trace(path: str | Path, tracer: Tracer | None,
                     break
     if device_events:
         events.extend(_colored_device_events(device_events, align_to))
+    if extra_events:
+        events.extend(extra_events)
     trace = {"traceEvents": events, "displayTimeUnit": "ms"}
     path = Path(path)
     with open(path, "w") as f:
         json.dump(trace, f)
     return trace
+
+
+# ---------------------------------------------------------------------
+# Record-derived tracks: per-rank timers + attribution counters.
+#
+# The merged host+device timeline above covers the python tier, whose
+# process runs the tracer.  Native-tier runs emit only their JSON
+# record — but that record carries everything a timeline needs:
+# per-rank per-run timer samples, their band summaries, and (post
+# merge) the attribution block.  These exporters turn a record into
+# Chrome/Perfetto counter + duration tracks so ``--trace-out`` (via
+# ``metrics.merge --trace-out``) is useful for native runs too.
+
+ATTRIBUTION_PID = 50       # attribution counter track
+_RECORD_PID_BASE = 100     # per-rank record tracks start here
+
+
+def attribution_counter_events(attr: dict, *, dur_us: float = 1.0,
+                               pid: int = ATTRIBUTION_PID) -> list[dict]:
+    """Counter tracks for an ``attribution`` block's fractions: one
+    Chrome 'C' series per resource over [0, dur_us], so Perfetto shows
+    the compute/hbm/comm/host split next to the timelines it explains.
+    The ``bound`` verdict rides the track name."""
+    fractions = (attr or {}).get("fractions")
+    if not fractions:
+        return []
+    name = f"attribution (bound: {attr.get('bound', '?')})"
+    events: list[dict] = [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": name}},
+        {"ph": "M", "pid": pid, "name": "process_sort_index",
+         "args": {"sort_index": 40}},
+    ]
+    for ts in (0.0, max(dur_us, 1.0)):
+        events.append({"ph": "C", "pid": pid, "name": "fractions",
+                       "ts": ts, "args": {k: round(float(v), 4)
+                                          for k, v in fractions.items()}})
+    return events
+
+
+def record_track_events(record: dict,
+                        pid_base: int = _RECORD_PID_BASE) -> list[dict]:
+    """Per-rank tracks from a run record (either tier): each rank
+    becomes one process track whose 'runtimes' samples lay out runs as
+    duration events end-to-end, every other timer rides as a counter
+    series sampled per run, and the schema-v2 band summaries annotate
+    the track as instant events (args = the {value, best, band, n}
+    dict).  The record's attribution block (stamped by emit, or
+    mirrored at merge time for native records) is appended as a counter
+    track spanning the laid-out run window."""
+    events: list[dict] = []
+    rows = record.get("ranks") or []
+    max_end = 0.0
+    for i, row in enumerate(rows):
+        pid = pid_base + i
+        rank = row.get("rank", i)
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": f"rank {rank} "
+                                        f"({record.get('section', '?')})"}})
+        events.append({"ph": "M", "pid": pid, "name": "process_sort_index",
+                       "args": {"sort_index": 50 + i}})
+        runtimes = [float(v) for v in row.get("runtimes") or []]
+        # runs laid out end-to-end on the rank's own clock: ts of run j
+        # is the sum of runs 0..j-1 (wall-adjacent, gaps unknowable)
+        starts = []
+        t = 0.0
+        for v in runtimes:
+            starts.append(t)
+            t += v
+        max_end = max(max_end, t)
+        for j, (ts, dur) in enumerate(zip(starts, runtimes)):
+            events.append({"ph": "X", "pid": pid, "tid": 0,
+                           "name": f"run {j}", "ts": ts, "dur": dur,
+                           "args": {"us": dur}})
+        for timer, vals in row.items():
+            # skip structural list fields (chip coords are not a timer
+            # series) alongside the runtimes already laid out above
+            if timer in ("runtimes", "coords") or not isinstance(vals,
+                                                                 list):
+                continue
+            for j, v in enumerate(vals):
+                if j >= len(starts):
+                    break
+                try:
+                    fv = float(v)
+                except (TypeError, ValueError):
+                    break
+                events.append({"ph": "C", "pid": pid, "name": timer,
+                               "ts": starts[j], "args": {"value": fv}})
+        for timer, summary in (row.get("summary") or {}).items():
+            events.append({"ph": "i", "pid": pid, "tid": 0, "s": "p",
+                           "name": f"{timer} band", "ts": 0.0,
+                           "args": dict(summary)})
+    attr = (record.get("global") or {}).get("attribution")
+    if attr:
+        events.extend(attribution_counter_events(attr, dur_us=max_end))
+    return events

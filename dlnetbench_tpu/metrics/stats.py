@@ -83,6 +83,22 @@ def overlap_fraction(full, compute, comm) -> list[float]:
     return out
 
 
+def bands_overlap(a, b) -> bool | None:
+    """Do two ``[lo, hi]`` bands overlap?  ``None`` when either side is
+    missing/malformed — the caller (the regression sentinel) treats an
+    unknown overlap as "bands cannot veto", falling back to its
+    %-threshold alone.  Two bands that merely touch DO overlap: with
+    n=3 samples the band edges are observations, and sharing one is
+    exactly the "indistinguishable from noise" case the bands exist to
+    name."""
+    try:
+        alo, ahi = float(a[0]), float(a[1])
+        blo, bhi = float(b[0]), float(b[1])
+    except (TypeError, ValueError, IndexError):
+        return None
+    return blo <= ahi and alo <= bhi
+
+
 def flag_low_mode(line: dict, ratio: float = LOW_MODE_RATIO) -> dict:
     """Annotate a summary-carrying dict whose samples straddle two modes.
 

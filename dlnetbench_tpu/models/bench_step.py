@@ -20,7 +20,26 @@ import dataclasses
 
 import jax
 
-BATCH, SEQ, LAYERS, VOCAB = 2, 6144, 4, 32768
+from dlnetbench_tpu.utils.tpu_probe import env_int
+
+# Shape knobs, frozen at import (the DLNB_FLASH_BWD_BLOCKS discipline):
+# the driver's headline shape by default; DLNB_BENCH_* overrides let the
+# sentinel lane (Makefile `check-bench`, tests/test_sentinel.py) run the
+# EXACT bench.py pipeline — headline compile, stat bands, --check — on a
+# tiny CPU-feasible model.  Every consumer imports these constants, so a
+# run's shape is one coherent choice, never a mix.
+BATCH = env_int("DLNB_BENCH_BATCH", 2)
+SEQ = env_int("DLNB_BENCH_SEQ", 6144)
+LAYERS = env_int("DLNB_BENCH_LAYERS", 4)
+VOCAB = env_int("DLNB_BENCH_VOCAB", 32768)
+# 0 = the llama3_8b card's own dims
+EMBED = env_int("DLNB_BENCH_EMBED", 0)
+FF = env_int("DLNB_BENCH_FF", 0)
+HEADS = env_int("DLNB_BENCH_HEADS", 0)
+# kv heads default to HEADS when that is overridden (a tiny lane model
+# wants kv == q); set this too to keep a GQA ratio under a HEADS
+# override instead of silently converting the card to MHA
+KV_HEADS = env_int("DLNB_BENCH_KV_HEADS", 0)
 
 # which train_k argument the AOT call sites donate: the params /
 # optimizer-state carry (argument 0); tokens are read-only
@@ -30,9 +49,11 @@ DONATE_ARGNUMS = (0,)
 def bench_card():
     from dlnetbench_tpu.core.model_card import ModelCard, load_model_card
     base = load_model_card("llama3_8b")
-    return ModelCard(name="llama3_8b_bench", embed_dim=base.embed_dim,
-                     num_heads=base.num_heads,
-                     num_kv_heads=base.num_kv_heads, ff_dim=base.ff_dim,
+    return ModelCard(name="llama3_8b_bench",
+                     embed_dim=EMBED or base.embed_dim,
+                     num_heads=HEADS or base.num_heads,
+                     num_kv_heads=KV_HEADS or HEADS or base.num_kv_heads,
+                     ff_dim=FF or base.ff_dim,
                      seq_len=SEQ, num_decoder_blocks=LAYERS,
                      vocab_size=VOCAB, gated_mlp=True)
 

@@ -150,4 +150,18 @@ def measure(n_devices: int | None = None, devices=None,
               f"S={cfg.seq_len} B={cfg.batch}, "
               f"overlap_fraction=(Tc+Tm-Tboth)/min(Tc,Tm) from the "
               f"full/compute/comm decomposition")
-    return assemble_line(metric, walls, overlaps)
+    line = assemble_line(metric, walls, overlaps)
+    # attribution from the OVERLAPPED config's measured decomposition
+    # (the line's headline value): exposed comm = full - compute per
+    # matched sample, compute measured, residual host — the one aux
+    # line whose block is built from an A/B measurement, not a FLOP
+    # model (analysis/attribution.py)
+    from dlnetbench_tpu.analysis.attribution import attribute_decomposition
+    on_tpu = getattr(mesh.devices.flat[0], "platform", "") == "tpu"
+    attr = attribute_decomposition(
+        times["overlapped"]["full"], times["overlapped"]["compute"],
+        times["overlapped"]["comm"],
+        transport="ici" if on_tpu else None, on_accelerator=on_tpu)
+    if attr is not None:
+        line["attribution"] = attr
+    return line

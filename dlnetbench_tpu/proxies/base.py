@@ -191,6 +191,12 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
     # chain-vs-chain, still matched in time
     chains = _chain_sizes(runs, max(cfg.reps_per_fence, 1))
     bundle.global_meta["reps_per_fence"] = max(cfg.reps_per_fence, 1)
+    # the calibrated fence round-trip is the HOST-overhead floor every
+    # chain pays once (utils/timing subtracts it from samples): stamped
+    # so the attribution engine's ``host`` fraction can cite a measured
+    # dispatch/fence figure instead of guessing
+    from dlnetbench_tpu.utils.timing import tunnel_rtt_s
+    bundle.global_meta["host_rtt_us"] = round(tunnel_rtt_s() * 1e6, 1)
 
     timers: dict[str, list] = {}
     full_s: list[float] = []

@@ -140,6 +140,44 @@ def run_sweep(proxy: str, axes: dict[str, list[str]],
     return failed
 
 
+def bound_tally(out_path: str, stream=None, *,
+                start_offset: int = 0) -> dict[str, int]:
+    """Count the attribution ``bound`` verdicts across the records a
+    sweep appended to ``out_path`` and say so on ``stream`` — the
+    one-glance answer to "was this grid MXU-bound or comm-exposed?".
+    ``start_offset`` is the file's byte size before the sweep ran:
+    emit_result appends, so records from earlier sweeps sharing the
+    same --out must not pollute this grid's tally.  Records without a
+    block (pre-attribution, failed stamping) tally under ``n/a``.
+    Returns the tally ({} when the file is unreadable — a dry run, or
+    every point failed before emitting)."""
+    import json
+    stream = stream or sys.stderr
+    tally: dict[str, int] = {}
+    try:
+        with open(out_path) as f:
+            if start_offset:
+                f.seek(start_offset)
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    rec = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                attr = (rec.get("global") or {}).get("attribution") or {}
+                bound = attr.get("bound") or "n/a"
+                tally[bound] = tally.get(bound, 0) + 1
+    except OSError:
+        return {}
+    if tally:
+        print("[sweep] bottleneck tally: "
+              + ", ".join(f"{k}={v}" for k, v in sorted(tally.items())),
+              file=stream)
+    return tally
+
+
 def _parse_axis(spec: str) -> tuple[str, list[str]]:
     key, sep, values = spec.partition("=")
     if not sep or not key:
@@ -196,6 +234,10 @@ def main(argv: list[str] | None = None) -> int:
     passthrough = ["--model", args.model, "--out", args.out] + passthrough
     in_process = True if args.in_process else \
         (False if args.subprocess else None)
+    try:
+        out_offset = os.path.getsize(args.out)
+    except OSError:
+        out_offset = 0  # fresh --out file
     tracer = spans.enable() if args.trace_out else None
     try:
         failed = run_sweep(args.proxy, axes, passthrough,
@@ -214,6 +256,11 @@ def main(argv: list[str] | None = None) -> int:
                 # override the sweep's outcome nor mask an in-flight
                 # usage error from the except arm above
                 print(f"sweep trace write failed ({e})", file=sys.stderr)
+    if not args.dry_run:
+        # per-grid bottleneck tally from the records THIS sweep emitted
+        # (every cli/sweep record carries an attribution block,
+        # metrics/emit.py) — failures already reported per point
+        bound_tally(args.out, start_offset=out_offset)
     return 1 if failed else 0
 
 

@@ -60,6 +60,15 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def env_int(name: str, default: int) -> int:
+    """Integer twin of ``env_float``, same defensive contract (the
+    DLNB_BENCH_* shape knobs and DLNB_BENCH_K share this one parser)."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def probe_backend(timeout_s: float = 60.0) -> dict | None:
     """Initialize the default jax backend in a THROWAWAY subprocess
     (inheriting env) and report ``{"n", "kind", "platform"}``; None if

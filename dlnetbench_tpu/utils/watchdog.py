@@ -44,6 +44,12 @@ class StepWatchdog:
         # key with a stale age is where progress stopped.
         self._beats: dict[str, float] = {}
         self._beats_lock = threading.Lock()
+        # span stacks captured at the most recent stall (every thread's
+        # open spans, outermost first): the heartbeat key says which
+        # phase stopped beating, the span stack says exactly WHERE
+        # inside the harness the measuring thread was sitting — the
+        # postmortem breadcrumb stamped into the record
+        self.last_stall_spans: list[str] = []
 
     def _default_on_stall(self, name: str, elapsed_s: float) -> None:
         ages = self.heartbeat_ages()
@@ -58,9 +64,13 @@ class StepWatchdog:
                      f"(heartbeats: "
                      + ", ".join(f"{k}={v:.1f}s" for k, v in
                                  sorted(ages.items())) + ")")
+        stack = ""
+        if self.last_stall_spans:
+            stack = ("; active spans: "
+                     + " | ".join(self.last_stall_spans))
         print(f"[watchdog] section {name!r} exceeded its {self.deadline_s:.1f}s "
               f"deadline ({elapsed_s:.1f}s elapsed) — likely a hung "
-              f"collective or device stall{where}",
+              f"collective or device stall{where}{stack}",
               file=sys.stderr, flush=True)
 
     # ---- heartbeats: where did progress stop? ------------------------
@@ -85,11 +95,20 @@ class StepWatchdog:
         meta[key] = {k: round(v, 3)
                      for k, v in sorted(self.heartbeat_ages().items())}
         meta["watchdog_stalls"] = self.stalls
+        if self.last_stall_spans:
+            meta["watchdog_stall_spans"] = list(self.last_stall_spans)
         return meta
 
     def _fire(self, armed_at: float) -> None:
         with self._stall_lock:  # Timer threads may fire concurrently
             self.stalls += 1
+            # capture where every thread's open spans sit RIGHT NOW —
+            # by the time a postmortem reads the record the stack is
+            # long gone ([] when span tracing is off for this run)
+            from dlnetbench_tpu.metrics import spans
+            self.last_stall_spans = [
+                " > ".join(stack)
+                for _, stack in sorted(spans.active_stacks().items())]
         self._on_stall(self.name, time.monotonic() - armed_at)
 
     def __enter__(self) -> "StepWatchdog":

@@ -1,0 +1,501 @@
+"""Bottleneck attribution engine (analysis/attribution.py): the join of
+compiled cost analysis, roofline peaks, measured decomposition timers and
+device-trace occupancy into one {fractions, bound} verdict per bench
+line / run record — plus the ``explain`` CLI that turns the committed
+fp8 artifact's 0.40-of-peak reading into a named binding resource
+(ROADMAP item 4's evidence gap, measured).
+"""
+from __future__ import annotations
+
+import io
+import json
+from pathlib import Path
+
+import pytest
+
+from dlnetbench_tpu.analysis import attribution as attr_mod
+from dlnetbench_tpu.core.hardware import HARDWARE, hw_key_for_device_kind
+
+DATA = Path(__file__).parent / "data"
+REPO = Path(__file__).parent.parent
+
+V5E = HARDWARE["tpu_v5e"]
+
+
+def _assert_fractions(block):
+    """The acceptance contract: fractions sum to 1 +/- 0.05, every share
+    in [0, 1], and the verdict is one of the published vocabulary."""
+    fr = block["fractions"]
+    assert set(fr) == set(attr_mod.RESOURCES)
+    assert sum(fr.values()) == pytest.approx(1.0, abs=0.05)
+    for v in fr.values():
+        assert 0.0 <= v <= 1.0
+    assert block["bound"] in attr_mod.BOUNDS
+
+
+# ---------------------------------------------------------------------
+# attribute_kernel: the bench-line FLOP/byte-model pathway
+
+
+def test_kernel_near_roofline_is_mxu_bound():
+    flops = 1e12
+    t = flops / V5E.peak("bfloat16") / 0.9   # 0.9 of peak
+    block = attr_mod.attribute_kernel(t, flops, 1e6, V5E, "bfloat16")
+    _assert_fractions(block)
+    assert block["bound"] == "mxu"
+    assert block["fractions"]["compute"] == pytest.approx(0.9, abs=0.01)
+    assert block["achieved"]["mxu"]["frac"] == pytest.approx(0.9, abs=0.01)
+    assert block["inputs"]["compute_basis"] == "roofline"
+
+
+def test_kernel_far_from_roofline_is_host_bound():
+    flops = 1e12
+    t = flops / V5E.peak("bfloat16") / 0.3   # 0.3 of peak, no HBM model
+    block = attr_mod.attribute_kernel(t, flops, 1e6, V5E, "bfloat16")
+    _assert_fractions(block)
+    assert block["bound"] == "host"
+    assert block["fractions"]["host"] == pytest.approx(0.7, abs=0.01)
+
+
+def test_kernel_memory_bound_is_hbm():
+    # byte-heavy, FLOP-light: HBM busy time dominates the MXU time
+    nbytes = 1e9
+    t = nbytes / V5E.hbm_bandwidth / 0.9     # 0.9 of HBM peak
+    block = attr_mod.attribute_kernel(t, 1e6, nbytes, V5E, "bfloat16")
+    _assert_fractions(block)
+    assert block["bound"] == "hbm"
+    assert block["achieved"]["hbm"]["frac"] == pytest.approx(0.9, abs=0.01)
+
+
+def test_kernel_overexplained_model_rescales_not_oversums():
+    # an above-peak short-chain reading: modeled busy time exceeds the
+    # measurement — shares rescale to sum 1, host goes to 0
+    flops = 1e12
+    t = flops / V5E.peak("bfloat16") / 1.3   # "1.3 of peak"
+    block = attr_mod.attribute_kernel(t, flops, 1e6, V5E, "bfloat16")
+    _assert_fractions(block)
+    assert block["fractions"]["host"] == 0.0
+
+
+def test_kernel_faulted_verdict_and_unpriceable_dtype():
+    flops = 1e12
+    t = flops / V5E.peak("bfloat16")
+    block = attr_mod.attribute_kernel(t, flops, 1e6, V5E, "bfloat16",
+                                      faulted=True)
+    assert block["bound"] == "faulted"
+    # no peak for the dtype on this chip -> no block, never a guess
+    assert attr_mod.attribute_kernel(t, flops, 1e6, V5E, "nvfp4") is None
+
+
+# ---------------------------------------------------------------------
+# transport semantics
+
+
+def test_comm_resource_names_the_wire():
+    assert attr_mod.comm_resource("ici") == "ici"
+    assert attr_mod.comm_resource("ici+dcn") == "dcn"  # DCN leg binds
+    assert attr_mod.comm_resource("tcp:ethernet") == "dcn"
+    assert attr_mod.comm_resource("tcp:loopback") == "dcn"
+    assert attr_mod.comm_resource("shm") == "host"
+    assert attr_mod.comm_resource("virtual-host") == "host"
+    assert attr_mod.comm_resource(None) == "host"
+
+
+def test_transport_peak_bytes():
+    assert attr_mod.transport_peak_bytes_s("ici", V5E) == V5E.ici_bandwidth
+    assert attr_mod.transport_peak_bytes_s("tcp:ethernet", V5E) \
+        == attr_mod.DCN_PEAK_BYTES_S
+    # no physical wire -> no peak to compare against
+    assert attr_mod.transport_peak_bytes_s("shm", V5E) is None
+    assert attr_mod.transport_peak_bytes_s("ici", None) is None
+
+
+def test_hw_key_for_device_kind():
+    assert hw_key_for_device_kind("TPU v5 lite") == "tpu_v5e"
+    assert hw_key_for_device_kind("TPU v5p") == "tpu_v5p"
+    assert hw_key_for_device_kind("TPU v4") == "tpu_v4"
+    assert hw_key_for_device_kind("TPU v6 lite") == "tpu_v6e"
+    # a cpu/host mesh has no roofline preset
+    assert hw_key_for_device_kind("cpu") is None
+    assert hw_key_for_device_kind(None) is None
+
+
+# ---------------------------------------------------------------------
+# attribute_decomposition: measured A/B legs, no FLOP model
+
+
+def test_decomposition_compute_is_host_on_virtual_mesh():
+    # loopback compute time must never read as silicon
+    block = attr_mod.attribute_decomposition(
+        [1.0, 1.0, 1.0], [0.9, 0.9, 0.9], [0.2, 0.2, 0.2])
+    _assert_fractions(block)
+    assert block["bound"] == "host"
+    assert block["inputs"]["compute_basis"] == "measured"
+    # exposed comm = median(full - compute), not the wire-only leg
+    assert block["fractions"]["comm_exposed"] == pytest.approx(0.1,
+                                                               abs=0.01)
+
+
+def test_decomposition_on_accelerator_is_mxu():
+    block = attr_mod.attribute_decomposition(
+        [1.0, 1.0], [0.9, 0.9], [0.2, 0.2], transport="ici",
+        on_accelerator=True)
+    assert block["bound"] == "mxu"
+
+
+def test_decomposition_comm_exposed_names_transport():
+    block = attr_mod.attribute_decomposition(
+        [1.0, 1.0], [0.2, 0.2], [0.9, 0.9], transport="ici",
+        on_accelerator=True)
+    _assert_fractions(block)
+    assert block["bound"] == "ici"
+
+
+def test_straggler_block_is_faulted():
+    block = attr_mod.straggler_block(10.0, 13.0, 3.0)
+    _assert_fractions(block)
+    assert block["bound"] == "faulted"
+    assert block["inputs"]["injected_us"] == pytest.approx(3000.0)
+
+
+# ---------------------------------------------------------------------
+# attribute_record: the run-record pathway over committed fixtures
+
+
+def _load_record(name: str) -> dict:
+    return json.loads((DATA / name).read_text().strip().splitlines()[0])
+
+
+def test_committed_attrib_fixture_roundtrip():
+    """The committed real-run fixture: its stamped block satisfies the
+    acceptance contract AND recomputation from its raw timers agrees on
+    the verdict (the block is derived data, not hand-written)."""
+    rec = _load_record("record_attrib.jsonl")
+    stamped = rec["global"]["attribution"]
+    _assert_fractions(stamped)
+    recomputed = attr_mod.attribute_record(rec)
+    _assert_fractions(recomputed)
+    assert recomputed["bound"] == stamped["bound"]
+    # a virtual CPU mesh: loopback bytes are host memory, never fabric
+    assert stamped["bound"] == "host"
+    assert stamped["inputs"]["compute_basis"] == "measured"
+
+
+def test_faulted_record_gets_faulted_verdict():
+    rec = _load_record("record_faulted.jsonl")
+    block = attr_mod.attribute_record(rec)
+    if block is not None:
+        assert block["bound"] == "faulted"
+        _assert_fractions(block)
+    else:  # a fixture without runtime samples can't be attributed
+        assert not any(r.get("runtimes") for r in rec.get("ranks", []))
+
+
+def test_record_without_runtimes_returns_none():
+    assert attr_mod.attribute_record({"global": {}, "ranks": []}) is None
+    assert attr_mod.attribute_record(
+        {"global": {}, "ranks": [{"rank": 0}]}) is None
+
+
+def test_overlap_fixture_record_attributes():
+    rec = _load_record("record_overlap.jsonl")
+    block = attr_mod.attribute_record(rec)
+    assert block is not None
+    _assert_fractions(block)
+
+
+# ---------------------------------------------------------------------
+# attribute_line: the legacy bench-line pathway (pre-stamping artifacts)
+
+
+def test_stamped_block_wins_over_derivation():
+    sentinel_block = {"fractions": {}, "bound": "mxu"}
+    assert attr_mod.attribute_line(
+        {"metric": "m", "unit": "ms", "value": 1.0,
+         "attribution": sentinel_block}) is sentinel_block
+    # a stamped NON-ms line (the straggler amplification ratio) has no
+    # wall-clock for the explain report — never rendered
+    assert attr_mod.attribute_line(
+        {"metric": "m", "unit": "x (ratio)", "value": 1.03,
+         "attribution": sentinel_block}) is None
+
+
+def test_legacy_fp8_line_derives_host_verdict():
+    # the BENCH_r05 shape: 0.40-of-peak with vs_baseline ~= mxu frac
+    # (no HBM exposure priced) -> 60% unexplained -> host
+    line = {"metric": "fp8(e4m3) mlp-projection matmul, 12288 tok "
+                      "D=4096, TPU v5 lite (tpu_v5e, fp8 peak 394 TF/s)",
+            "value": 2.6, "unit": "ms",
+            "tflops_achieved": 159.0, "vs_baseline": 0.4037}
+    block = attr_mod.attribute_line(line)
+    _assert_fractions(block)
+    assert block["bound"] == "host"
+    assert block["fractions"]["host"] > 0.5
+
+
+def test_unparseable_line_returns_none():
+    assert attr_mod.attribute_line({"metric": "no hw key here",
+                                    "value": 1.0, "unit": "ms"}) is None
+    assert attr_mod.attribute_line({"metric": "x (tpu_v5e)",
+                                    "unit": "GB/s", "value": 1.0}) is None
+
+
+# ---------------------------------------------------------------------
+# the explain CLI on the COMMITTED fp8 artifact: ROADMAP item 4's
+# evidence gap as a measured verdict
+
+
+def test_explain_bench_r05_names_the_fp8_binding_resource():
+    out = io.StringIO()
+    rc = attr_mod.explain(REPO / "BENCH_r05.json", out=out)
+    assert rc == 0
+    text = out.getvalue()
+    blocks = text.split("\n- ")
+    chain = [b for b in blocks if b.startswith("fp8(e4m3) swiglu chain")]
+    assert len(chain) == 1, text
+    # the committed 0.40-of-peak diagnosis, with the binding resource
+    # NAMED: host/dispatch overhead, not fp8 silicon
+    assert "bound: HOST" in chain[0]
+    assert "0.38 of roofline" in chain[0]
+    assert "host/dispatch/residency overhead binds this run" in chain[0]
+    # the headline train step is the control: compute-bound
+    headline = [b for b in blocks if b.startswith("llama3_8b-shaped")]
+    assert any("bound: MXU" in b for b in headline)
+
+
+def test_explain_jsonl_and_cli_main(tmp_path):
+    p = tmp_path / "records.jsonl"
+    p.write_text(json.dumps(_load_record("record_attrib.jsonl")) + "\n")
+    out = io.StringIO()
+    assert attr_mod.explain(p, out=out) == 0
+    assert "bound: HOST" in out.getvalue()
+    assert attr_mod.main(["explain", str(p)]) == 0
+
+
+def test_explain_empty_artifact_fails(tmp_path):
+    p = tmp_path / "empty.jsonl"
+    p.write_text(json.dumps({"metric": "x", "unit": "GB/s",
+                             "value": 1.0}) + "\n")
+    assert attr_mod.explain(p, out=io.StringIO()) == 1
+
+
+# ---------------------------------------------------------------------
+# fixture round-trip: parser -> merge -> bandwidth columns
+
+
+def test_attrib_fixture_parser_merge_bandwidth_roundtrip():
+    from dlnetbench_tpu.analysis.bandwidth import (bandwidth_summary,
+                                                   effective_bandwidth)
+    from dlnetbench_tpu.metrics.merge import merge_records
+    from dlnetbench_tpu.metrics.parser import (load_records,
+                                               records_to_dataframe,
+                                               validate_record)
+
+    records = load_records(DATA / "record_attrib.jsonl")
+    assert len(records) == 1
+    rec = records[0]
+    validate_record(rec)
+    bound = rec["global"]["attribution"]["bound"]
+
+    # parser: the verdict is a groupby-grade column
+    df = records_to_dataframe(records)
+    assert (df["attr_bound"] == bound).all()
+
+    # merge (single-process: identity modulo recomputed attribution)
+    merged = merge_records(records)
+    validate_record(merged)
+    _assert_fractions(merged["global"]["attribution"])
+    assert merged["global"]["attribution"]["bound"] == bound
+
+    # bandwidth: verdict + fractions ride every row and the summary
+    bw = effective_bandwidth([merged])
+    for col in ("attr_bound", "attr_compute", "attr_hbm", "attr_comm",
+                "attr_host"):
+        assert col in bw.columns
+    assert (bw["attr_bound"] == bound).all()
+    fr = merged["global"]["attribution"]["fractions"]
+    assert bw["attr_compute"].iloc[0] == pytest.approx(fr["compute"])
+    summary = bandwidth_summary([merged])
+    assert (summary["attr_bound"] == bound).all()
+
+
+def test_records_without_attribution_still_flow():
+    """v1 and pre-attribution v2 records keep parsing and the bandwidth
+    columns degrade to NaN/'n/a', never a KeyError."""
+    import math
+
+    from dlnetbench_tpu.analysis.bandwidth import effective_bandwidth
+    from dlnetbench_tpu.metrics.parser import (load_records,
+                                               records_to_dataframe)
+
+    v1 = load_records(DATA / "record_v1.jsonl")
+    df = records_to_dataframe(v1)
+    assert "attr_bound" not in df.columns  # no column invented
+    bw = effective_bandwidth(v1)
+    assert (bw["attr_bound"] == "n/a").all()
+    assert all(math.isnan(v) for v in bw["attr_compute"])
+
+
+def test_mixed_version_merge_still_refused():
+    from dlnetbench_tpu.metrics.merge import merge_records
+
+    v2 = _load_record("record_attrib.jsonl")
+    v2["global"]["num_processes"] = 2
+    for i, row in enumerate(v2["ranks"]):
+        row["process_index"] = i
+        row["hostname"] = f"host{i}"
+    v1 = json.loads(json.dumps(v2))
+    v1["version"] = 1
+    v1["process"] = 1
+    with pytest.raises(ValueError, match="schema version"):
+        merge_records([v2, v1])
+
+
+def test_merge_recomputes_attribution_over_pooled_rows():
+    """Two processes whose records each attributed only their own
+    clocks: the merged record's block is recomputed over the pooled
+    rows (and differing per-process blocks must not abort the merge as
+    a global conflict)."""
+    from dlnetbench_tpu.metrics.merge import merge_records
+
+    def proc_rec(p):
+        rec = _load_record("record_attrib.jsonl")
+        rec["process"] = p
+        rec["global"]["num_processes"] = 2
+        rec["global"]["attribution"] = dict(
+            rec["global"]["attribution"],
+            inputs={"time_us": 1.0 + p})  # per-process: differs
+        for i, row in enumerate(rec["ranks"]):
+            row["process_index"] = i
+            row["hostname"] = f"host{i}"
+        return rec
+
+    merged = merge_records([proc_rec(0), proc_rec(1)])
+    block = merged["global"]["attribution"]
+    _assert_fractions(block)
+    # recomputed over the pooled rows, not inherited from process 0
+    assert block["inputs"]["time_us"] != 1.0
+
+
+def test_native_style_record_gets_attribution_at_merge():
+    """A record whose emitter stamped NO attribution (the native tier's
+    C++ emitter) gets one mirrored from its timer summaries at merge
+    time."""
+    from dlnetbench_tpu.metrics.merge import merge_records
+
+    rec = _load_record("record_attrib.jsonl")
+    del rec["global"]["attribution"]
+    merged = merge_records([rec])
+    _assert_fractions(merged["global"]["attribution"])
+
+
+# ---------------------------------------------------------------------
+# profiling satellites: the conservative 'other' bucket + top ops
+
+
+def test_collective_stats_buckets_unclassified_as_other():
+    """Regression (satellite 1): ops classify_op can't name — a
+    synthetic unclassified fusion — used to be silently dropped, making
+    every collective look like a LARGER share of device time than it
+    was.  They now bucket under 'other' with a count, so occupancy
+    fractions are conservative."""
+    from dlnetbench_tpu.metrics.profiling import collective_stats
+    events = [
+        {"name": "fusion.12", "dur": 300.0},        # unclassifiable
+        {"name": "all-reduce.1", "dur": 100.0},
+        {"name": "end: all-reduce.1", "dur": 100.0},  # completion marker
+        # host python spans share the raw trace's event stream (on CPU
+        # even device ops ride the /host:CPU lane) — they are NOT
+        # device occupancy and must not land in 'other'
+        {"name": "$profiler.py:226 trace", "dur": 9e9},
+        {"name": "PjitFunction(<lambda>)", "dur": 5e6},
+    ]
+    stats = collective_stats(events)
+    assert set(stats) == {"other", "allreduce"}
+    assert stats["other"] == {"count": 1, "total_us": 300.0,
+                              "mean_us": 300.0, "max_us": 300.0}
+    assert stats["allreduce"]["total_us"] == 100.0
+    # the conservative occupancy: allreduce is 25% of device time, not
+    # the 100% the silent drop used to imply
+    total = sum(s["total_us"] for s in stats.values())
+    assert stats["allreduce"]["total_us"] / total == pytest.approx(0.25)
+
+
+def test_top_device_ops_ranked_and_marker_free():
+    from dlnetbench_tpu.metrics.profiling import top_device_ops
+    events = [
+        {"name": "fusion.1", "dur": 10.0},
+        {"name": "fusion.1", "dur": 20.0},
+        {"name": "all-reduce.2", "dur": 25.0},
+        {"name": "end: all-reduce.2", "dur": 25.0},
+        {"name": "", "dur": 99.0},
+    ]
+    top = top_device_ops(events, k=2)
+    assert top == [{"op": "fusion.1", "total_us": 30.0, "count": 2},
+                   {"op": "all-reduce.2", "total_us": 25.0, "count": 1}]
+    assert top_device_ops(events, k=0) == []
+    # host spans excluded like in collective_stats
+    assert top_device_ops([{"name": "$x.py:1 f", "dur": 9.0}]) == []
+
+
+def test_host_lane_events_excluded_from_device_occupancy():
+    """Bare-identifier HOST events — compiler passes when a compile
+    lands inside the profiled window ('dce', 'algsimp'), argument
+    bookkeeping ('ParseArguments') — pass the op-name shape test, but
+    they run on the python dispatch thread; the ``_thread`` annotation
+    from load_trace_events keeps them out of 'other' and top_device_ops.
+    The CPU thunk executor's 'call' wrapper (whose duration encloses
+    its children on the same lane) is excluded too."""
+    from dlnetbench_tpu.metrics.profiling import (collective_stats,
+                                                  top_device_ops)
+    events = [
+        {"name": "dot.4", "dur": 50.0,
+         "_thread": "tf_XLATfrtCpuClient/-123"},
+        {"name": "all-reduce.1", "dur": 10.0,
+         "_thread": "tf_XLAEigen/-456"},
+        # host-lane bare identifiers: NOT device occupancy
+        {"name": "dce", "dur": 9e4, "_thread": "python"},
+        {"name": "algsimp", "dur": 8e4, "_thread": "python"},
+        {"name": "ParseArguments", "dur": 7e4, "_thread": "python"},
+        # thunk wrapper enclosing dot.4 — counting it double-counts
+        {"name": "call", "dur": 55.0,
+         "_thread": "tf_XLATfrtCpuClient/-123"},
+    ]
+    stats = collective_stats(events)
+    assert set(stats) == {"other", "allreduce"}
+    assert stats["other"] == {"count": 1, "total_us": 50.0,
+                              "mean_us": 50.0, "max_us": 50.0}
+    assert top_device_ops(events) == [
+        {"op": "dot.4", "total_us": 50.0, "count": 1},
+        {"op": "all-reduce.1", "total_us": 10.0, "count": 1}]
+
+
+def test_load_trace_events_annotates_thread_names(tmp_path):
+    """load_trace_events resolves thread_name metadata onto each event;
+    traces without metadata (merged artifacts) stay unannotated."""
+    import gzip
+    from dlnetbench_tpu.metrics.profiling import load_trace_events
+    trace = {"traceEvents": [
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "python"}},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "backend_compile",
+         "ts": 0.0, "dur": 5.0},
+        {"ph": "X", "pid": 1, "tid": 3, "name": "fusion.1",
+         "ts": 1.0, "dur": 2.0},
+    ]}
+    p = tmp_path / "t.trace.json.gz"
+    with gzip.open(p, "wt") as f:
+        json.dump(trace, f)
+    events = load_trace_events(p)
+    by_name = {e["name"]: e for e in events}
+    assert by_name["backend_compile"]["_thread"] == "python"
+    assert "_thread" not in by_name["fusion.1"]  # no metadata for tid 3
+
+
+def test_attribute_record_prefers_stamped_device_top_ops():
+    rec = json.loads((DATA / "record_attrib.jsonl").read_text())
+    rec["global"]["device_top_ops"] = [
+        {"op": "fusion.3", "total_us": 12.0, "count": 4}]
+    block = attr_mod.attribute_record(rec)
+    assert block["top_ops"] == [{"op": "fusion.3", "total_us": 12.0,
+                                 "count": 4}]

@@ -198,3 +198,94 @@ def test_cli_trace_out_end_to_end(eight_devices, tmp_path):
     assert stats.get("allreduce", {}).get("count", 0) >= 1
     assert any(e.get("args", {}).get("kind") == "allreduce"
                for e in events)
+
+
+# ---------------------------------------------------------------------
+# record-derived tracks: native-tier --trace-out (satellite)
+
+
+def _attrib_record():
+    from pathlib import Path
+    return json.loads((Path(__file__).parent / "data"
+                       / "record_attrib.jsonl").read_text())
+
+
+def test_active_stacks_snapshot():
+    spans.enable()
+    try:
+        assert spans.active_stacks() == {}
+        with spans.span("outer"):
+            with spans.span("inner"):
+                stacks = spans.active_stacks()
+                assert list(stacks.values()) == [["outer", "inner"]]
+            assert list(spans.active_stacks().values()) == [["outer"]]
+        assert spans.active_stacks() == {}
+    finally:
+        spans.disable()
+    assert spans.active_stacks() == {}  # tracing off -> {}
+
+
+def test_attribution_counter_events():
+    attr = {"fractions": {"compute": 0.6, "hbm": 0.1,
+                          "comm_exposed": 0.2, "host": 0.1},
+            "bound": "mxu"}
+    events = spans.attribution_counter_events(attr, dur_us=500.0)
+    names = [e["name"] for e in events]
+    assert "process_name" in names
+    counters = [e for e in events if e["ph"] == "C"]
+    # one sample at each end of the run window, all four series in args
+    assert [e["ts"] for e in counters] == [0.0, 500.0]
+    assert counters[0]["args"]["compute"] == 0.6
+    meta = [e for e in events if e["name"] == "process_name"][0]
+    assert "mxu" in meta["args"]["name"]
+    assert spans.attribution_counter_events({}) == []
+    assert spans.attribution_counter_events({"bound": "mxu"}) == []
+
+
+def test_record_track_events_lay_out_runs():
+    """A run record (either tier) becomes per-rank Perfetto tracks:
+    runtimes as end-to-end duration events, sibling timers as counter
+    series, band summaries as annotations, the attribution block as a
+    counter track over the laid-out window."""
+    rec = _attrib_record()
+    events = spans.record_track_events(rec)
+    by_ph = {}
+    for e in events:
+        by_ph.setdefault(e["ph"], []).append(e)
+    runs = [e for e in by_ph["X"] if e["name"].startswith("run ")]
+    n_ranks = len(rec["ranks"])
+    n_runs = len(rec["ranks"][0]["runtimes"])
+    assert len(runs) == n_ranks * n_runs
+    # rank 0's runs are wall-adjacent: run j starts where j-1 ended
+    r0 = [e for e in runs if e["pid"] == spans._RECORD_PID_BASE]
+    assert r0[1]["ts"] == pytest.approx(r0[0]["ts"] + r0[0]["dur"])
+    # band summaries annotate the track
+    bands = [e for e in events if e["ph"] == "i"]
+    assert any(e["name"] == "runtimes band" for e in bands)
+    assert bands[0]["args"]["n"] == n_runs
+    # the record's attribution block rides as a counter track
+    counters = [e for e in by_ph["C"]
+                if e["pid"] == spans.ATTRIBUTION_PID]
+    assert counters and "compute" in counters[0]["args"]
+
+
+def test_merge_trace_out_writes_native_style_trace(tmp_path):
+    """metrics.merge --trace-out: a record-only trace (no in-process
+    tracer, the native tier's situation) that round-trips through the
+    shared loader."""
+    from dlnetbench_tpu.metrics import merge as merge_mod
+
+    rec = _attrib_record()
+    src = tmp_path / "in.jsonl"
+    src.write_text(json.dumps(rec) + "\n")
+    out = tmp_path / "merged.jsonl"
+    trace = tmp_path / "trace.json"
+    rc = merge_mod.main(["--trace-out", str(trace), str(out), str(src)])
+    assert rc == 0
+    written = json.loads(trace.read_text())
+    phs = {e["ph"] for e in written["traceEvents"]}
+    assert {"X", "C", "M"} <= phs
+    # the shared loader reads the complete events back (device-timeline
+    # consumers only ever see X events)
+    loaded = load_trace_events(trace)
+    assert loaded and all(e["ph"] == "X" for e in loaded)

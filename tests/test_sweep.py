@@ -48,6 +48,35 @@ def test_axis_parsing_errors():
     assert key == "env:LIBTPU_INIT_ARGS" and vals == ["--a=1,2", "--b"]
 
 
+def test_bound_tally_skips_records_from_earlier_sweeps(tmp_path, capsys):
+    """emit_result appends, so a reused --out file carries records from
+    earlier sweeps — the per-grid tally must only count records past the
+    pre-sweep byte offset or grid B inherits grid A's verdicts."""
+    import io
+    import json as _json
+    out = tmp_path / "runs.jsonl"
+
+    def rec(bound):
+        return _json.dumps({"global": {"attribution": {"bound": bound}}})
+
+    out.write_text(rec("host") + "\n" + rec("host") + "\n")
+    offset = out.stat().st_size
+    with out.open("a") as f:
+        f.write(rec("mxu") + "\n" + rec("mxu") + "\n" + rec("ici") + "\n")
+
+    stream = io.StringIO()
+    tally = sweep.bound_tally(str(out), stream, start_offset=offset)
+    assert tally == {"mxu": 2, "ici": 1}
+    assert "host" not in stream.getvalue()
+
+    # offset 0 (fresh file) still tallies everything
+    assert sweep.bound_tally(str(out), io.StringIO()) == \
+        {"host": 2, "mxu": 2, "ici": 1}
+    # unreadable file: {} and silence
+    assert sweep.bound_tally(str(tmp_path / "missing.jsonl"),
+                             io.StringIO()) == {}
+
+
 def test_in_process_mode_calls_cli_directly(monkeypatch):
     """Flag-only grids default to in-process execution: cli.main is
     invoked in THIS process (sharing burn calibration, meshes and the

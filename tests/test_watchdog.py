@@ -91,3 +91,42 @@ def test_run_proxy_stamps_heartbeats():
     assert "warmup" in ages and "chain_0" in ages and "chain_1" in ages
     assert all(v >= 0.0 for v in ages.values())
     assert res.global_meta["watchdog_stalls"] == 0
+
+
+def test_stall_dumps_active_span_stack(capsys):
+    """Satellite: on stall the watchdog captures every thread's OPEN
+    span stack (metrics/spans.py) — the heartbeat key says which phase
+    stopped beating, the span stack says where inside the harness the
+    measuring thread was sitting — and stamps it into the record."""
+    from dlnetbench_tpu.metrics import spans
+
+    spans.enable()
+    try:
+        wd = StepWatchdog(0.05, name="timed")
+        wd.beat("chain_0")
+        with spans.span("timed", what="headline"):
+            with spans.span("fence"):
+                with wd:
+                    time.sleep(0.12)
+    finally:
+        spans.disable()
+    err = capsys.readouterr().err
+    assert wd.stalls == 1
+    assert "active spans:" in err and "timed > fence" in err
+    meta = {}
+    wd.stamp(meta)
+    assert meta["watchdog_stall_spans"] == ["timed > fence"]
+
+
+def test_stall_without_tracing_has_no_span_noise(capsys):
+    """Span tracing off (the default run mode): the stall message keeps
+    its shape with no empty 'active spans' suffix and nothing stamped."""
+    wd = StepWatchdog(0.05, name="timed")
+    with wd:
+        time.sleep(0.12)
+    err = capsys.readouterr().err
+    assert wd.stalls == 1
+    assert "active spans:" not in err
+    meta = {}
+    wd.stamp(meta)
+    assert "watchdog_stall_spans" not in meta
