@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test check-bench sentinel-scan
+.PHONY: test check-bench check-resilience sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -17,6 +17,21 @@ test:
 # must exit non-zero.  ~30s wall.
 check-bench:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_sentinel.py -q -m sentinel
+
+# the resilience lane (docs/RESILIENCE.md): fault plans + policies,
+# the preempt->restore->rejoin arc on both tiers (native cases skip
+# without cmake/ninja), checkpoint backends + the in-loop snapshot
+# checkpointer, watchdog integration, the degraded/rejoin merge
+# pathways with their committed fixtures, the Daly-interval validation
+# against the committed elastic study, and the sentinel tiny baseline.
+# ~2 min wall on a dev box.
+check-resilience:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m 'not slow' \
+	    tests/test_faults.py tests/test_native_faults.py \
+	    tests/test_checkpoint.py tests/test_watchdog.py \
+	    tests/test_goodput.py tests/test_merge.py
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_sentinel.py -q \
+	    -m sentinel
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

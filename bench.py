@@ -516,7 +516,7 @@ def _run_bench(args, tracer) -> int:
     # degrade to skipped markers (_aux) rather than losing the headline
     if args.skip_aux:
         fp8 = fp8_chain = int8 = int8_ab = fp8_ab = None
-        straggler = int8_step = int8_sb = overlap_ab = None
+        straggler = ckpt_ab = int8_step = int8_sb = overlap_ab = None
     else:
         fp8 = _aux("fp8 mlp matmul", _bench_fp8_mlp, card, hw_key, dev)
         fp8_chain = _aux("fp8 swiglu chain", _bench_fp8_swiglu_chain,
@@ -530,6 +530,9 @@ def _run_bench(args, tracer) -> int:
         # faulted-vs-clean straggler pairing — measured amplification
         # of an injected delay
         straggler = _aux("straggler A/B", _bench_straggler_ab)
+        # cheap (tiny dp step again): stall-vs-async checkpoint save
+        # cost — the measured input to the Daly interval model
+        ckpt_ab = _aux("checkpoint A/B", _bench_checkpoint_ab)
         # LAST among the aux lines: they are the most expensive (a full
         # train-step compile+measure each) and the only ones with a
         # known backend-poisoning failure mode (the r5 composed-VJP
@@ -582,6 +585,7 @@ def _run_bench(args, tracer) -> int:
         **({"int8_fused_ab": int8_ab} if int8_ab else {}),
         **({"fp8_fused_ab": fp8_ab} if fp8_ab else {}),
         **({"straggler_ab": straggler} if straggler else {}),
+        **({"checkpoint_ab": ckpt_ab} if ckpt_ab else {}),
         **({"spmd_overlap_ab": overlap_ab} if overlap_ab else {}),
         **({"int8_step": int8_step} if int8_step else {}),
         **({"int8_switchback_step": int8_sb} if int8_sb else {}),
@@ -769,6 +773,86 @@ def _bench_straggler_ab() -> dict | None:
         line["attribution"] = attr
     print(json.dumps(line))
     return line
+
+
+def _bench_checkpoint_ab() -> dict | None:
+    """Paired stall-vs-async checkpoint A/B (ISSUE 7 tentpole): the dp
+    proxy's step at tiny scale with a per-step snapshot save
+    (utils/checkpoint.py SnapshotCheckpointer) in both modes, against
+    the save-free baseline, interleaved per round (the r4 pairing
+    protocol).  ``stall`` puts the whole durable write ON the timed
+    critical path; ``async`` keeps only the device sync + host snapshot
+    in-window and drains the writer thread OFF it (between chains).
+    The line's headline value is the fraction of the measured save cost
+    the async mode moved off the critical path — the number that says
+    whether async checkpointing is worth its writer thread at this
+    state size — next to all three step bands and the measured
+    per-save cost.  This is the measured half of the Daly-interval
+    story: analysis/goodput.py prices intervals from exactly this
+    in-window cost."""
+    import itertools
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from dlnetbench_tpu.core.model_stats import load_model_stats
+    from dlnetbench_tpu.parallel.mesh import make_flat_mesh
+    from dlnetbench_tpu.proxies import dp as dp_proxy
+    from dlnetbench_tpu.proxies.base import ProxyConfig
+    from dlnetbench_tpu.utils.checkpoint import SnapshotCheckpointer
+    from dlnetbench_tpu.utils.timing import time_chain
+
+    cfg = ProxyConfig(size_scale=1e-3, time_scale=1e-3)
+    bundle = dp_proxy.build(load_model_stats("gpt2_l_16_bfloat16"), 2, cfg,
+                            mesh=make_flat_mesh(devices=jax.devices()),
+                            dtype=jnp.float32)
+    k, rounds = 4, 3
+    root = tempfile.mkdtemp(prefix="dlnb_ckpt_ab_")
+    try:
+        ckpts = {mode: SnapshotCheckpointer(
+            Path(root) / mode, bundle.state, every=1, mode=mode, keep=2)
+            for mode in ("stall", "async")}
+        counters = {mode: itertools.count() for mode in ckpts}
+
+        def step_with(mode):
+            bundle.full()
+            ckpts[mode].on_step(next(counters[mode]))
+
+        base_s, stall_s, async_s = [], [], []
+        for _ in range(rounds):  # interleaved: adjacent in time per round
+            base_s.append(time_chain(bundle.full, k=k))
+            stall_s.append(time_chain(lambda: step_with("stall"), k=k))
+            async_s.append(time_chain(lambda: step_with("async"), k=k))
+            ckpts["async"].wait()  # drain the writer OFF the timed window
+        base = stats_mod.summarize(base_s)
+        stall = stats_mod.summarize(stall_s)
+        asyn = stats_mod.summarize(async_s)
+        save_cost = stall["value"] - base["value"]
+        hidden = ((stall["value"] - asyn["value"]) / save_cost
+                  if save_cost > 0 else 0.0)
+        line = {
+            "metric": "checkpoint A/B (dp step, stall vs async save)",
+            "value": round(hidden, 3),
+            "unit": "fraction of save cost off the critical path "
+                    "(async vs stall)",
+            "baseline_ms": {"value": round(base["value"] * 1e3, 3),
+                            **_band_ms(base)},
+            "stall_ms": {"value": round(stall["value"] * 1e3, 3),
+                         **_band_ms(stall)},
+            "async_ms": {"value": round(asyn["value"] * 1e3, 3),
+                         **_band_ms(asyn)},
+            # the measured durable-save cost (stall mode: the whole
+            # write; the Daly model's d under mode="stall")
+            "save_ms": stats_mod.summarize(ckpts["stall"].checkpoint_ms,
+                                           ndigits=3),
+            "state_bytes": ckpts["stall"].state_bytes,
+            "backend": ckpts["stall"].backend,
+            "n": rounds,
+        }
+        print(json.dumps(line))
+        return line
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def _bench_overlap_ab() -> dict | None:

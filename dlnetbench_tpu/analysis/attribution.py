@@ -402,7 +402,19 @@ def attribute_record(rec: dict) -> dict | None:
                                              "total_us", 0.0))][:5]
 
     faulted = bool((g.get("fault_plan") or {}).get("events"))
-    inputs = {"source": source, "hw": hw_key,
+    # checkpoint stalls ride INSIDE the timed window (faults/policy.py
+    # wires the save after the step, on purpose) and are neither
+    # compute, HBM, nor fabric time — they land in the host residual by
+    # construction.  Stamp the measured per-save stall so the block
+    # SAYS what part of that host share is checkpointing, instead of
+    # leaving it to read as unexplained dispatch overhead.
+    ckpt_inputs = {}
+    if isinstance(g.get("checkpoint_stall_ms"), (int, float)):
+        ckpt_inputs["checkpoint_stall_us"] = round(
+            float(g["checkpoint_stall_ms"]) * 1e3, 1)
+        if g.get("checkpoint_every"):
+            ckpt_inputs["checkpoint_every"] = int(g["checkpoint_every"])
+    inputs = {"source": source, "hw": hw_key, **ckpt_inputs,
               **({"flops": float(flops)} if flops else {}),
               **({"bytes": float(nbytes)} if nbytes else {}),
               **({"dtype": dtype_key} if hw is not None else {}),
@@ -511,6 +523,11 @@ def _render_block(out, label: str, time_us: float | None, attr: dict) -> None:
     for op in attr.get("top_ops") or []:
         print(f"    op {op['op']}: {op['total_us']} us "
               f"x{op.get('count', '?')}", file=out)
+    ck = (attr.get("inputs") or {}).get("checkpoint_stall_us")
+    if ck:
+        print(f"    checkpoint stall: {ck / 1e3:.3f} ms per save "
+              f"(every {attr['inputs'].get('checkpoint_every', '?')} "
+              f"steps) — inside the host share", file=out)
     bound, host = attr["bound"], fr.get("host", 0.0)
     if bound == "host" and host > 0.3:
         print(f"    -> {host:.0%} of wall-clock unexplained by the "

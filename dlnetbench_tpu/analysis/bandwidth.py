@@ -187,6 +187,17 @@ def effective_bandwidth(records: list[dict]):
         detection_ms = float(g.get("detection_ms", float("nan")))
         recovery_ms = float(g.get("recovery_ms", float("nan")))
         straggler_amp = straggler_amplification(rec)
+        # elastic-recovery columns (faults/policy.py run_faulted with a
+        # CheckpointPolicy): what periodic saves cost, what the
+        # eviction's restore cost, how much work was redone, and the
+        # arc's bottom line — useful steps per wall second.  NaN on
+        # records that never checkpointed.
+        ckpt_cols = {
+            "checkpoint_ms": float(g.get("checkpoint_ms", float("nan"))),
+            "restore_ms": float(g.get("restore_ms", float("nan"))),
+            "lost_steps": float(g.get("lost_steps", float("nan"))),
+            "goodput": float(g.get("goodput", float("nan"))),
+        }
         # attribution verdict + fractions (analysis/attribution.py,
         # stamped by emit/merge): every bandwidth row says what bound
         # the run it came from; records without a block get NaN/"n/a"
@@ -298,6 +309,7 @@ def effective_bandwidth(records: list[dict]):
                         "detection_ms": detection_ms,
                         "recovery_ms": recovery_ms,
                         "straggler_amp": straggler_amp,
+                        **ckpt_cols,
                         **attr_cols,
                     })
     return pd.DataFrame(rows)
@@ -313,8 +325,11 @@ def bandwidth_summary(records: list[dict]):
     much of that traffic compute actually hid, and the fault columns —
     ``straggler_amp`` (observed inflation / injected delay),
     ``detection_ms`` / ``recovery_ms`` (the priced crash-recovery path)
-    — NaN on clean records.  Faulted runs group under bound="faulted"
-    with busbw refused, keeping the clean runs' mean uncontaminated."""
+    and the elastic-recovery set ``checkpoint_ms`` / ``restore_ms`` /
+    ``lost_steps`` / ``goodput`` (analysis/goodput.py reads the same
+    fields) — NaN on clean records.  Faulted runs group under
+    bound="faulted" with busbw refused, keeping the clean runs' mean
+    uncontaminated."""
     bw = effective_bandwidth(records)
     if bw.empty:
         return bw
@@ -322,5 +337,6 @@ def bandwidth_summary(records: list[dict]):
                         "bound", "transport", "attr_bound"])
             [["time_us", "msg_bytes", "algbw_GBps", "busbw_GBps",
               "overlap", "straggler_amp", "detection_ms", "recovery_ms",
+              "checkpoint_ms", "restore_ms", "lost_steps", "goodput",
               "attr_compute", "attr_hbm", "attr_comm", "attr_host"]]
             .mean().reset_index())

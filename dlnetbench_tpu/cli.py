@@ -86,10 +86,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--fault", default=None, metavar="PLAN",
                    help="JSON fault plan (inline or @path; "
                         "dlnetbench_tpu/faults/plan.py schema, shared "
-                        "with the native binaries): delay/jitter/crash "
-                        "events injected at step boundaries with "
-                        "deterministic triggers; the record stamps the "
-                        "plan + recovery columns (docs/RESILIENCE.md)")
+                        "with the native binaries): delay/jitter/crash/"
+                        "preempt/rejoin events injected at step "
+                        "boundaries with deterministic triggers; the "
+                        "record stamps the plan + recovery columns "
+                        "(docs/RESILIENCE.md)")
     p.add_argument("--fault_policy", default=None,
                    choices=["fail_fast", "retry", "shrink"],
                    help="degradation policy on a scripted failure: "
@@ -97,6 +98,26 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "backoff, same world), shrink (rebuild on the "
                         "survivor devices and finish degraded); "
                         "default: the plan's own policy")
+    p.add_argument("--checkpoint_dir", default=None, metavar="DIR",
+                   help="enable periodic snapshot checkpointing of the "
+                        "proxy's state during a --fault run "
+                        "(utils/checkpoint.py SnapshotCheckpointer): "
+                        "saves every --checkpoint_every steps, restore-"
+                        "from-latest priced into recovery on a crash/"
+                        "preempt, lost work and goodput stamped into "
+                        "the record (docs/RESILIENCE.md)")
+    p.add_argument("--checkpoint_every", type=int, default=4,
+                   help="harness steps between saves (plan step units, "
+                        "warmup included; default 4)")
+    p.add_argument("--checkpoint_mode", default="async",
+                   choices=["stall", "async"],
+                   help="stall: the whole durable write rides the timed "
+                        "critical path; async: only the device sync + "
+                        "host snapshot stays in-window (default)")
+    p.add_argument("--checkpoint_backend", default="auto",
+                   choices=["auto", "orbax", "npz"],
+                   help="auto prefers orbax, falls back to the pure-"
+                        "numpy npz backend")
 
 
 def _cfg(args) -> ProxyConfig:
@@ -260,6 +281,15 @@ def main(argv: list[str] | None = None) -> int:
 
 def _run_measured(args, parser, stats, cfg, devices, dtype, dtype_name,
                   variables, tracer) -> int:
+    if args.checkpoint_dir and not args.fault:
+        # knowable from the args alone: refuse BEFORE the mesh build +
+        # AOT compile, not minutes into it
+        parser.error("--checkpoint_dir prices checkpointing inside a "
+                     "faulted run (faults/policy.py run_faulted) — it "
+                     "needs --fault; a clean run has no recovery to "
+                     "measure")
+    if args.checkpoint_dir and args.checkpoint_every < 1:
+        parser.error("--checkpoint_every must be >= 1 step")
     try:
         with spans.span("build", proxy=args.proxy, model=args.model):
             bundle = _build_bundle(args, parser, stats, cfg, devices, dtype)
@@ -272,7 +302,8 @@ def _run_measured(args, parser, stats, cfg, devices, dtype, dtype_name,
         bundle.global_meta["variables"] = variables
     if args.fault:
         from dlnetbench_tpu.faults.plan import FaultPlan
-        from dlnetbench_tpu.faults.policy import run_faulted
+        from dlnetbench_tpu.faults.policy import CheckpointPolicy, \
+            run_faulted
         # usage errors (malformed/invalid plan, unreadable @file,
         # plan/config conflicts) report as CLI errors; failures INSIDE
         # the measured run must keep their tracebacks — masking a JAX
@@ -298,10 +329,17 @@ def _run_measured(args, parser, stats, cfg, devices, dtype, dtype_name,
             return _build_bundle(args, parser, stats, cfg,
                                  [devs[i] for i in survivors], dtype)
 
+        ckpt = None
+        if args.checkpoint_dir:
+            ckpt = CheckpointPolicy(dir=args.checkpoint_dir,
+                                    every=args.checkpoint_every,
+                                    mode=args.checkpoint_mode,
+                                    backend=args.checkpoint_backend)
         with spans.span("faulted_run", proxy=args.proxy,
                         policy=plan.policy):
             result = run_faulted(args.proxy, bundle, cfg, plan,
-                                 rebuild=rebuild, world=len(devices))
+                                 rebuild=rebuild, world=len(devices),
+                                 checkpoint=ckpt)
     else:
         result = run_proxy(args.proxy, bundle, cfg)
 

@@ -41,6 +41,22 @@ class RankFailure(RuntimeError):
         self.iteration = iteration
 
 
+class RankPreempted(RuntimeError):
+    """A fault-plan scripted grace-window eviction (python tier): the
+    SIGTERM-notice shape — unlike RankFailure the departure is
+    announced, and ``grace_us`` is the drain budget the policy layer
+    may spend on a final checkpoint save before the devices are gone
+    (faults/policy.py run_faulted)."""
+
+    def __init__(self, rank: int, iteration: int, grace_us: float = 0.0):
+        super().__init__(f"rank {rank} preempted by fault plan "
+                         f"(iteration {iteration}, grace "
+                         f"{grace_us / 1e3:.1f} ms)")
+        self.rank = rank
+        self.iteration = iteration
+        self.grace_us = grace_us
+
+
 class FaultInjector:
     """Applies a plan's step-boundary events; one per measured run.
 
@@ -82,6 +98,15 @@ class FaultInjector:
                 self._sleep(sleep_us)
                 self.crash_raised_at = time.monotonic()
                 raise RankFailure(min(e.ranks) if e.ranks else 0, it)
+            elif e.kind == "preempt" and it == e.iteration:
+                # announced eviction: the policy layer catches this and
+                # spends the grace window on a drain save; 'rejoin'
+                # events never raise — they only mark the step index at
+                # which the policy layer grows the world back
+                self._sleep(sleep_us)
+                self.crash_raised_at = time.monotonic()
+                raise RankPreempted(min(e.ranks), it,
+                                    grace_us=e.magnitude_us)
             elif e.kind == "partition" and it == e.iteration and e.group:
                 # the side WITHOUT rank 0 is lost to the controller —
                 # surfaces like a crash of those ranks.  When rank 0

@@ -23,6 +23,19 @@ Kinds:
                single-controller tier treats it as crashing whichever
                side excludes rank 0, modeling the controller's side
                surviving).
+  preempt    — grace-window eviction: the target ranks leave the run
+               at ``iteration`` AFTER a ``magnitude_us`` drain window
+               (the SIGTERM-notice shape of a spot/preemptible VM).
+               Unlike crash the departure is announced and plan-known:
+               the python tier's policy layer uses the grace window to
+               attempt a final checkpoint save, the native tier's
+               victim drains and idles (no Bye-less death).  Requires
+               policy ``shrink`` — eviction without elasticity is just
+               a crash; script that instead.
+  rejoin     — the evicted ranks return at ``iteration``: both tiers
+               re-split back to the FULL world on a fresh communicator
+               (grow, the inverse of shrink's pre-split) and the
+               record's ``degraded_world`` is cleared.
 
 Triggers are in STEP units counted from the first step the harness
 runs (warmup included) — deterministic and identical across tiers.
@@ -32,7 +45,8 @@ from __future__ import annotations
 import dataclasses
 import json
 
-KINDS = ("delay", "jitter", "drop", "crash", "partition")
+KINDS = ("delay", "jitter", "drop", "crash", "partition", "preempt",
+         "rejoin")
 POLICIES = ("fail_fast", "retry", "shrink")
 
 
@@ -109,6 +123,36 @@ class FaultPlan:
                 raise ValueError(
                     f"fault plan: where must be step|collective, got "
                     f"{e.where!r}")
+            if e.kind == "preempt" and not e.ranks:
+                raise ValueError(
+                    "fault plan: preempt needs explicit 'ranks' (the "
+                    "evicted ranks must be plan-known on every tier)")
+        kinds = {e.kind for e in self.events}
+        if kinds & {"preempt", "rejoin"}:
+            if self.policy != "shrink":
+                raise ValueError(
+                    "fault plan: preempt/rejoin model elastic eviction "
+                    "and recovery — they need policy 'shrink' (an "
+                    "eviction under fail_fast is just a crash; script "
+                    "that instead)")
+            if "rejoin" in kinds and "preempt" not in kinds:
+                raise ValueError(
+                    "fault plan: rejoin without a preempt — nobody left "
+                    "to return")
+            for r in self.events:
+                if r.kind != "rejoin":
+                    continue
+                back = set(r.ranks) if r.ranks else None
+                for p in self.events:
+                    if p.kind != "preempt":
+                        continue
+                    if back is not None and not back & set(p.ranks):
+                        continue
+                    if r.iteration <= p.iteration:
+                        raise ValueError(
+                            f"fault plan: rejoin at iteration "
+                            f"{r.iteration} does not follow its preempt "
+                            f"at {p.iteration}")
         return self
 
     # ---- serialization (the shared wire format) ----------------------
@@ -144,7 +188,18 @@ class FaultPlan:
         """Reject plan/ProxyConfig combinations the segmented
         retry/shrink policies cannot honor — BEFORE the expensive run,
         so they surface as usage errors, not mid-run failures."""
+        pre_at = self.first_preempt_iteration()
+        rej_at = self.rejoin_iteration()
+        if pre_at is not None and rej_at is not None and \
+                rej_at < pre_at + 2:
+            raise ValueError(
+                f"fault plan: rejoin at iteration {rej_at} leaves no "
+                f"degraded step after the preempt at {pre_at} — the "
+                f"segmented python tier needs rejoin >= preempt + 2")
         crash_at = self.first_crash_iteration()
+        if pre_at is not None:
+            crash_at = pre_at if crash_at is None else min(crash_at,
+                                                           pre_at)
         if crash_at is None or self.policy == "fail_fast":
             return
         if getattr(cfg, "reps_per_fence", 1) > 1:
@@ -199,14 +254,67 @@ class FaultPlan:
                if e.kind in ("crash", "partition")]
         return min(its) if its else None
 
+    # ---- elastic eviction (preempt/rejoin) ---------------------------
+    def preempt_victims(self) -> list[int]:
+        """Ranks TEMPORARILY lost to preempt events (distinct from
+        crash_victims: a preempted rank stays alive and may rejoin)."""
+        out: set[int] = set()
+        for e in self.events:
+            if e.kind == "preempt":
+                out.update(e.ranks)
+        return sorted(out)
+
+    def first_preempt_iteration(self) -> int | None:
+        its = [e.iteration for e in self.events if e.kind == "preempt"]
+        return min(its) if its else None
+
+    def rejoin_iteration(self) -> int | None:
+        """First step index at which evicted ranks return (None: the
+        plan never grows back — preempt degrades to the end, like
+        shrink)."""
+        its = [e.iteration for e in self.events if e.kind == "rejoin"]
+        return min(its) if its else None
+
+    def evicted(self, rank: int, iteration: int) -> bool:
+        """Is ``rank`` out of the run at ``iteration`` — inside a
+        preempt window that no rejoin (or ``until``) has closed yet?"""
+        for e in self.events:
+            if e.kind != "preempt" or rank not in e.ranks:
+                continue
+            end = e.until
+            rej = [r.iteration for r in self.events
+                   if r.kind == "rejoin" and r.targets(rank)
+                   and r.iteration > e.iteration]
+            if rej:
+                end = min(rej) if end < 0 else min(end, min(rej))
+            if iteration >= e.iteration and (end < 0 or iteration < end):
+                return True
+        return False
+
     def fault_window(self) -> tuple[int, int | None] | None:
         """[start, end) step window in which ANY event is live; end is
         None for an open window.  The analysis layer uses this to split
-        a record's runs into clean and faulted samples."""
+        a record's runs into clean and faulted samples.  Elastic
+        events: a preempt's window closes at its rejoin's trigger + 1
+        (the rejoin step itself pays the grow re-split and must not
+        pass as clean); a rejoin event spans exactly its own step."""
         if not self.events:
             return None
-        start = min(e.iteration for e in self.events)
-        ends = [e.until for e in self.events]
+        spans: list[tuple[int, int]] = []  # end -1 = open
+        for e in self.events:
+            if e.kind == "rejoin":
+                spans.append((e.iteration, e.iteration + 1))
+                continue
+            end = e.until
+            if e.kind == "preempt":
+                rej = [r.iteration + 1 for r in self.events
+                       if r.kind == "rejoin" and r.iteration > e.iteration
+                       and (not r.ranks or set(r.ranks) & set(e.ranks))]
+                if rej:
+                    end = min(rej) if end < 0 else min(end, min(rej))
+            spans.append((e.iteration, end))
+        start = min(s for s, _ in spans)
+        ends = [u for _, u in spans]
         end = None if any(u < 0 for u in ends) else max(ends)
         return (start, end)
 

@@ -21,6 +21,29 @@ that survives a scripted fault and prices the recovery.
     ``metrics.merge``'s degraded pathway, surfaced as recovery-cost
     columns by ``analysis.bandwidth``.
 
+``run_faulted`` additionally closes the resilience loop (ISSUE 7):
+
+  * checkpoint — pass ``checkpoint=CheckpointPolicy(dir, every, mode)``
+    and the run snapshots ``bundle.state`` every K harness steps
+    through ``utils.checkpoint.SnapshotCheckpointer``: periodic save
+    cost is MEASURED (``checkpoint_ms`` total, ``checkpoint_stall_ms``
+    in-window — the stall-vs-async A/B ``bench.py checkpoint_ab``
+    prices), and restore-from-latest is priced into ``recovery_ms``.
+  * preempt    — a scripted grace-window eviction (plan kind
+    ``preempt``): the policy layer catches the announced
+    ``RankPreempted``, spends the grace window on a drain save when the
+    measured save cost fits it, restores from the latest completed
+    checkpoint (``restore_ms``), accounts the redone work
+    (``lost_steps`` = completed steps past the last save), rebuilds
+    over the survivors, and continues degraded.
+  * rejoin     — at the plan's ``rejoin`` trigger the run grows BACK:
+    the bundle is rebuilt over the FULL world (recompile priced into
+    ``rejoin_ms``), ``degraded_world`` is cleared, and the record
+    stamps ``fault_rejoin_step``.  The whole arc yields ``goodput`` —
+    useful steps per wall second after checkpoint stalls, lost work and
+    recovery — the figure ``analysis/goodput.py`` fits the Daly
+    optimal-interval model against.
+
 The plan's step counter covers warmup too (native parity), so crash
 triggers must land in the measured region for the segmented policies:
 ``iteration >= warmup`` (validated here, not silently misread).
@@ -29,14 +52,62 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from pathlib import Path
 
-from dlnetbench_tpu.faults.inject import FaultInjector, RankFailure
+from dlnetbench_tpu.faults.inject import (FaultInjector, RankFailure,
+                                          RankPreempted)
 from dlnetbench_tpu.faults.plan import FaultPlan
 from dlnetbench_tpu.proxies.base import ProxyConfig, ProxyResult, run_proxy
 
 # bounded backoff for the retry policy (base doubles per attempt)
 RETRY_BACKOFF_S = 0.05
 MAX_RETRIES = 3
+
+
+@dataclasses.dataclass
+class CheckpointPolicy:
+    """How a faulted run checkpoints (utils/checkpoint.py backends)."""
+    dir: str | Path
+    every: int = 4              # harness steps between saves (plan units)
+    mode: str = "async"         # "stall" | "async" (see SnapshotCheckpointer)
+    backend: str = "auto"       # "orbax" | "npz" | "auto"
+    keep: int = 3
+
+
+def _make_checkpointer(ckpt: CheckpointPolicy, bundle, cfg: ProxyConfig):
+    """SnapshotCheckpointer over the bundle's state.  A bundle without
+    a declared ``state`` cannot honestly price checkpointing — refused,
+    never silently priced at zero bytes."""
+    from dlnetbench_tpu.utils.checkpoint import SnapshotCheckpointer
+    if bundle.state is None:
+        raise ValueError(
+            "checkpoint policy: this proxy bundle declares no "
+            "checkpointable state (StepBundle.state) — the save cost "
+            "would be a lie; wire the proxy's buffers first (see "
+            "proxies/dp.py)")
+    return SnapshotCheckpointer(
+        ckpt.dir, bundle.state, every=ckpt.every, mode=ckpt.mode,
+        backend=ckpt.backend, keep=ckpt.keep,
+        watchdog=getattr(cfg, "watchdog", None))
+
+
+def _with_checkpoint_hook(bundle, ckpt_sc, injector: FaultInjector):
+    """Wrap the bundle's FULL step so every completed invocation may
+    trigger a periodic save — run_proxy wraps the injector around
+    ``full`` afterwards, so the per-invocation order is
+    before_step (plan trigger) -> step -> on_step (save).  Saves land
+    INSIDE the timed window on purpose: a stall-mode save inflates the
+    step it rode, which is exactly the cost the A/B measures."""
+    base_full = bundle.full
+
+    def full_with_save():
+        out = base_full()
+        # the injector already advanced: the step just executed is
+        # iteration - 1 (plan units, warmup included — native parity)
+        ckpt_sc.on_step(injector.iteration - 1)
+        return out
+
+    return dataclasses.replace(bundle, full=full_with_save)
 
 
 def _concat_results(name: str, segments: list[ProxyResult]) -> ProxyResult:
@@ -59,26 +130,46 @@ def _concat_results(name: str, segments: list[ProxyResult]) -> ProxyResult:
 
 
 def run_faulted(name: str, bundle, cfg: ProxyConfig, plan: FaultPlan, *,
-                rebuild=None, world: int | None = None) -> ProxyResult:
+                rebuild=None, world: int | None = None,
+                checkpoint: CheckpointPolicy | None = None) -> ProxyResult:
     """Run ``bundle`` under ``plan`` with the plan's policy; returns a
     ProxyResult whose global_meta carries the fault provenance.
 
     ``rebuild(survivor_ranks) -> StepBundle`` is required for the
-    shrink policy (the proxy rebuilds over the survivor devices);
+    shrink policy (the proxy rebuilds over the survivor devices) and
+    the preempt/rejoin arc (``rebuild(range(world))`` grows back);
     ``world`` defaults to the bundle's ``world_size`` global.
+    ``checkpoint`` enables the periodic-save / restore-from-latest /
+    lost-work pathway (module docstring).
     """
     plan.validate()
     world = world or int(bundle.global_meta.get("world_size", 0))
     injector = FaultInjector(plan, world=world or None)
     cfg_i = dataclasses.replace(cfg, fault_injector=injector)
+    ckpt_sc = None
+    if checkpoint is not None:
+        ckpt_sc = _make_checkpointer(checkpoint, bundle, cfg)
+        bundle = _with_checkpoint_hook(bundle, ckpt_sc, injector)
 
     def stamp(result: ProxyResult, **extra) -> ProxyResult:
         result.global_meta["fault_plan"] = plan.to_dict()
         result.global_meta["fault_policy"] = plan.policy
         result.global_meta["fault_injected_delay_us"] = round(
             injector.injected_delay_us, 1)
+        if ckpt_sc is not None:
+            ckpt_sc.wait()  # async writes must complete before stats
+            result.global_meta.update(ckpt_sc.stats())
+            if ckpt_sc.checkpoint_ms:
+                result.global_meta["checkpoint_ms_samples"] = [
+                    round(v, 3) for v in ckpt_sc.checkpoint_ms]
         result.global_meta.update(extra)
         return result
+
+    preempt_at = plan.first_preempt_iteration()
+    if preempt_at is not None:
+        return _run_preempt(name, bundle, cfg, cfg_i, plan, injector,
+                            stamp, rebuild=rebuild, world=world,
+                            ckpt_sc=ckpt_sc)
 
     crash_at = plan.first_crash_iteration()
     if crash_at is None or plan.policy == "fail_fast":
@@ -137,13 +228,24 @@ def run_faulted(name: str, bundle, cfg: ProxyConfig, plan: FaultPlan, *,
                          "(bundle.global_meta['world_size'] or world=)")
     survivors = plan.survivors(world)
     t0 = time.monotonic()
+    ckpt_extra = {}
+    if ckpt_sc is not None:
+        # restore-from-latest is part of what the crash costs: priced
+        # into recovery_ms, with the redone work accounted
+        restore_ms, lost = _restore_latest(ckpt_sc, bundle,
+                                           failure.iteration, warm)
+        ckpt_extra = {"restore_ms": round(restore_ms, 3),
+                      "lost_steps": lost}
     bundle2 = rebuild(survivors)
+    if ckpt_sc is not None:
+        bundle2 = _with_checkpoint_hook(bundle2, ckpt_sc, injector)
     rebuild_ms = (time.monotonic() - t0) * 1e3
     seg2 = run_proxy(name, bundle2,
                      dataclasses.replace(cfg_i, runs=remaining, warmup=1,
                                          min_exectime_s=0))
     # recovery ends at the first successful survivor-group step: the
-    # rebuild (mesh + recompile) plus the first warmup execution
+    # rebuild (mesh + recompile + any checkpoint restore) plus the
+    # first warmup execution
     recovery_ms = rebuild_ms + (seg2.warmup_times_us[0] / 1e3
                                 if seg2.warmup_times_us else 0.0)
     merged = _concat_results(name, [seg1, seg2])
@@ -161,4 +263,156 @@ def run_faulted(name: str, bundle, cfg: ProxyConfig, plan: FaultPlan, *,
                  detection_ms=round(detection_ms, 3),
                  recovery_ms=round(recovery_ms, 3),
                  degraded_world=survivors,
-                 fault_iteration=failure.iteration)
+                 fault_iteration=failure.iteration,
+                 **ckpt_extra)
+
+
+def _restore_latest(ckpt_sc, bundle, failure_iteration: int,
+                    warmup_steps: int = 0):
+    """Restore-from-latest against the bundle's state template; returns
+    (restore_ms, lost_steps).  Draining any in-flight async write is
+    PART of the measured restore cost — a recovering trainer waits for
+    exactly that.
+
+    ``lost_steps`` is counted in MEASURED-step units (the currency of
+    ``cfg.runs`` and of goodput's useful-step numerator): the redone
+    window [last_save+1, failure) clipped to the timed steps.  Without
+    ``warmup_steps`` clipping, a no-save-completed run would bill the
+    warmup step(s) as lost useful work — plan units, not run units."""
+    from dlnetbench_tpu.utils.checkpoint import restore_checkpoint
+    t0 = time.monotonic()
+    ckpt_sc.wait()
+    last = ckpt_sc.last_saved_step
+    redo_from = warmup_steps if last is None \
+        else max(warmup_steps, last + 1)
+    lost = max(0, failure_iteration - redo_from)
+    if last is not None:
+        restore_checkpoint(ckpt_sc.ckpt_dir, bundle.state, step=last)
+    return (time.monotonic() - t0) * 1e3, lost
+
+
+def _run_preempt(name: str, bundle, cfg: ProxyConfig, cfg_i: ProxyConfig,
+                 plan: FaultPlan, injector: FaultInjector, stamp, *,
+                 rebuild, world: int, ckpt_sc) -> ProxyResult:
+    """The preempt -> (drain save) -> restore -> shrink -> rejoin arc.
+
+    Segment layout in plan step units (P = preempt trigger, R = rejoin
+    trigger, W = warmup):
+
+        seg1  indices 0 .. P-1        full world   (W warmup + pre runs)
+        P     the eviction            RankPreempted caught here
+        seg2  indices P+1 .. R-1      degraded     (1 warmup + runs2)
+        seg3  indices R ..            full world   (1 warmup + runs3;
+                                      the rejoin re-split/recompile
+                                      cost IS that warmup — rejoin_ms)
+
+    The first ``lost_steps`` measured steps of seg2 re-cover ground the
+    eviction destroyed, so useful steps = total measured - lost_steps
+    and goodput = useful / wall — wall includes every stall, rebuild,
+    restore and warmup between seg1's first measured step and seg3's
+    last."""
+    if rebuild is None:
+        raise ValueError("fault plan: preempt/rejoin need a "
+                         "rebuild(ranks) callback (shrink + grow)")
+    if not world:
+        raise ValueError("fault plan: preempt needs the world size "
+                         "(bundle.global_meta['world_size'] or world=)")
+    warm = max(cfg.warmup, 1)
+    plan.check_config(cfg)
+    preempt_at = plan.first_preempt_iteration()
+    rejoin_at = plan.rejoin_iteration()
+
+    pre = min(cfg.runs, preempt_at - warm)
+    if pre >= cfg.runs:  # trigger beyond the run: nothing ever fires
+        return stamp(run_proxy(name, bundle, cfg_i))
+
+    wall0 = time.monotonic()
+    seg1 = run_proxy(name, bundle,
+                     dataclasses.replace(cfg_i, runs=pre, min_exectime_s=0))
+
+    # the announced eviction
+    try:
+        injector.before_step()
+        raise RuntimeError("fault plan: preempt trigger did not fire at "
+                           f"iteration {preempt_at}")
+    except RankPreempted as e:
+        eviction = e
+        detection_ms = (time.monotonic() - injector.crash_raised_at) * 1e3
+
+    # grace-window drain: a final save unless the measured cost says
+    # the budget cannot fit it (save_now documents the refusal rule)
+    drained = False
+    if ckpt_sc is not None:
+        drained = ckpt_sc.save_now(eviction.iteration - 1,
+                                   budget_us=eviction.grace_us)
+
+    ckpt_extra = {}
+    t0 = time.monotonic()
+    if ckpt_sc is not None:
+        restore_ms, lost = _restore_latest(ckpt_sc, bundle,
+                                           eviction.iteration, warm)
+        ckpt_extra = {"restore_ms": round(restore_ms, 3),
+                      "lost_steps": lost,
+                      "checkpoint_drain_saved": drained}
+    else:
+        lost = 0
+    survivors = [r for r in range(world)
+                 if r not in plan.preempt_victims()
+                 and r not in plan.crash_victims(world)]
+    bundle2 = rebuild(survivors)
+    if ckpt_sc is not None:
+        bundle2 = _with_checkpoint_hook(bundle2, ckpt_sc, injector)
+    rebuild_ms = (time.monotonic() - t0) * 1e3
+
+    remaining = cfg.runs - pre
+    # degraded measured steps until the rejoin trigger (indices P+2 ..
+    # R-1 — seg2's single warmup step consumes P+1); a rejoin beyond
+    # the measured budget never fires and the run stays degraded
+    runs2 = remaining if rejoin_at is None \
+        else min(remaining, rejoin_at - preempt_at - 2)
+    rejoins = rejoin_at is not None and runs2 < remaining
+    seg2 = run_proxy(name, bundle2,
+                     dataclasses.replace(cfg_i, runs=runs2, warmup=1,
+                                         min_exectime_s=0))
+    recovery_ms = rebuild_ms + (seg2.warmup_times_us[0] / 1e3
+                                if seg2.warmup_times_us else 0.0)
+
+    segments = [seg1, seg2]
+    extra = {}
+    if rejoins:
+        # grow back: rebuild over the FULL world on fresh devices; the
+        # recompile + first full-world step is the measured rejoin cost
+        t1 = time.monotonic()
+        bundle3 = rebuild(list(range(world)))
+        if ckpt_sc is not None:
+            bundle3 = _with_checkpoint_hook(bundle3, ckpt_sc, injector)
+        regrow_ms = (time.monotonic() - t1) * 1e3
+        seg3 = run_proxy(name, bundle3,
+                         dataclasses.replace(cfg_i, runs=remaining - runs2,
+                                             warmup=1, min_exectime_s=0))
+        segments.append(seg3)
+        extra["rejoin_ms"] = round(
+            regrow_ms + (seg3.warmup_times_us[0] / 1e3
+                         if seg3.warmup_times_us else 0.0), 3)
+        extra["fault_rejoin_step"] = rejoin_at
+    else:
+        extra["degraded_world"] = survivors
+
+    wall_s = time.monotonic() - wall0
+    useful = max(0, cfg.runs - lost)
+    merged = _concat_results(name, segments)
+    # rejoined runs end FULL world (last segment's mesh rows are the
+    # full mesh); degraded-to-the-end runs keep the survivor rows.
+    # Either way the ORIGINAL bundle's post-build globals are carried
+    # (sweep tags etc. — same rationale as the shrink path).
+    for k, v in bundle.global_meta.items():
+        merged.global_meta.setdefault(k, v)
+    merged.global_meta["world_size"] = world
+    return stamp(merged,
+                 detection_ms=round(detection_ms, 3),
+                 recovery_ms=round(recovery_ms, 3),
+                 fault_iteration=eviction.iteration,
+                 goodput=round(useful / wall_s, 4) if wall_s > 0 else 0.0,
+                 goodput_useful_steps=useful,
+                 goodput_wall_s=round(wall_s, 4),
+                 **ckpt_extra, **extra)

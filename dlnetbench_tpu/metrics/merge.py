@@ -42,12 +42,24 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      # fault_plan.hpp): each process detects/recovers
                      # on its own clock and counts its own injected
                      # drops/retries/sleeps; the PLAN ITSELF
-                     # (fault_plan/fault_policy/degraded_world) must
-                     # still match — different plans ARE different runs
+                     # (fault_plan/fault_policy/degraded_world,
+                     # fault_rejoin_step) must still match — different
+                     # plans ARE different runs
                      "detection_ms", "recovery_ms", "fault_drops",
                      "fault_retries", "fault_injected_delay_us",
                      "fault_iteration", "watchdog_heartbeat_age_s",
                      "watchdog_stalls", "watchdog_stall_spans",
+                     # elastic-recovery measurements (ISSUE 7): each
+                     # process times its own grow re-split, saves on
+                     # its own disk, and accounts its own lost work /
+                     # goodput; the rejoin TRIGGER (fault_rejoin_step,
+                     # plan-derived) must still match
+                     "rejoin_ms", "checkpoint_ms", "checkpoint_stall_ms",
+                     "checkpoint_ms_samples", "checkpoint_saves",
+                     "checkpoint_drain_saved", "restore_ms",
+                     "lost_steps", "goodput", "goodput_useful_steps",
+                     "goodput_wall_s", "last_checkpoint_age_s",
+                     "last_checkpoint_step",
                      # each process times its own fence RTT, profiles
                      # its own device ops, and attributes its own
                      # clocks; the merged record gets attribution

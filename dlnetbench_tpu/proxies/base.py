@@ -91,6 +91,14 @@ class StepBundle:
     # per-collective parity channel (reference fsdp.cpp:61-66 allgather/
     # reduce_scatter timers, hybrid_3d.cpp:65-68 pp/dp/tp_comm timers)
     variants: dict | None = None
+    # pytree of the proxy's device buffers for the checkpoint path
+    # (faults/policy.py run_faulted + utils/checkpoint.py
+    # SnapshotCheckpointer).  The executor donates private CLONES, so
+    # these originals stay readable; proxies replay stateless schedules,
+    # which means the save/restore COST is real (the bytes a training
+    # state of this proxy's size moves) while the values never change —
+    # documented in docs/RESILIENCE.md.
+    state: object | None = None
 
 
 def estimate_runs(warmup_times_s: list[float], min_exectime_s: float,

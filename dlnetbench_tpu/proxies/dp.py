@@ -110,4 +110,8 @@ def build(stats: ModelStats, num_buckets: int, cfg: ProxyConfig,
         compute=compiled["compute"],
         comm=compiled["comm"],
         global_meta=meta,
+        # checkpointable state: the gradient buckets + burn carry (the
+        # executor donated private clones, so these stay readable) —
+        # what a dp trainer of this schedule would snapshot
+        state={"grads": grads, "burn_state": state0},
     )

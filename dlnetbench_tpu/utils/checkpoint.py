@@ -3,21 +3,64 @@
 The reference has NO checkpointing (SURVEY.md §5.4 — its proxies are
 stateless replays, runs last seconds).  The rebuild's compute tier runs
 real training, so it gets the subsystem the reference never needed:
-orbax-backed save/restore of the training state (params pytree + step
-counter), sharding-aware — orbax records each array's sharding and lays
-the checkpoint out per-shard, so a dp x pp x tp training state saved from
-one mesh restores onto an equal-shaped mesh without gathering to one host.
+save/restore of the training state (params pytree + step counter) with
+two backends behind one API:
+
+  * ``orbax`` — the preferred backend (``pyproject`` extra):
+    sharding-aware, per-shard layout, so a dp x pp x tp training state
+    saved from one mesh restores onto an equal-shaped mesh without
+    gathering to one host.
+  * ``npz``   — pure numpy fallback (no dependency beyond jax/numpy):
+    the pytree is flattened, gathered to host, and written as one
+    ``<step>.npz`` via an atomic rename (a partial write can never
+    read as a completed checkpoint).  Restoring onto a sharded mesh
+    goes through ``jax.device_put`` with the caller's shardings.
+
+``backend="auto"`` (the default everywhere) prefers orbax when it
+imports and falls back to npz — the crash-resume path runs on machines
+without orbax instead of being skipped.
 
 ``train_with_checkpointing`` is the crash-safe loop: it resumes from the
 latest step if a checkpoint exists, saves every ``save_every`` steps, and
 is idempotent — killing the process anywhere and rerunning continues from
 the last completed save (tests/test_checkpoint.py simulates exactly that).
+
+``SnapshotCheckpointer`` is the in-loop form the fault harness wires
+into faulted runs (faults/policy.py): periodic saves every K steps with
+the disk write either ON the timed critical path (``mode="stall"``) or
+moved to a writer thread (``mode="async"`` — only the device sync +
+host snapshot stays in-window), every cost measured, and a drain-save
+entry point for preemption grace windows.
 """
 from __future__ import annotations
 
+import os
+import queue
+import threading
+import time
 from pathlib import Path
 
 import jax
+
+BACKENDS = ("orbax", "npz")
+
+
+def default_backend() -> str:
+    """'orbax' when it imports, else the pure-python 'npz' fallback."""
+    try:
+        import orbax.checkpoint  # noqa: F401
+        return "orbax"
+    except ImportError:
+        return "npz"
+
+
+def _resolve_backend(backend: str) -> str:
+    if backend == "auto":
+        return default_backend()
+    if backend not in BACKENDS:
+        raise ValueError(f"unknown checkpoint backend {backend!r} "
+                         f"(one of {BACKENDS} or 'auto')")
+    return backend
 
 
 def _manager(ckpt_dir: Path | str, keep: int = 3, create: bool = True):
@@ -27,6 +70,71 @@ def _manager(ckpt_dir: Path | str, keep: int = 3, create: bool = True):
         options=ocp.CheckpointManagerOptions(max_to_keep=keep,
                                              create=create),
     )
+
+
+# ------------------------------------------------------------- npz tier
+def _npz_path(ckpt_dir: Path, step: int) -> Path:
+    return ckpt_dir / f"{step}.npz"
+
+
+def _npz_steps(ckpt_dir: Path) -> list[int]:
+    if not ckpt_dir.exists():
+        return []
+    return sorted(int(p.stem) for p in ckpt_dir.glob("*.npz")
+                  if p.stem.isdigit())
+
+
+def _npz_save(ckpt_dir: Path, step: int, host_leaves: list, keep: int):
+    """Write pre-gathered host arrays as ``<step>.npz`` atomically and
+    prune to the newest ``keep`` steps.  Split out from save_checkpoint
+    so the async checkpointer's writer thread reuses exactly this
+    (tmp + rename: a torn write is never visible as a checkpoint)."""
+    import numpy as np
+    ckpt_dir.mkdir(parents=True, exist_ok=True)
+    final = _npz_path(ckpt_dir, step)
+    tmp = final.with_suffix(".npz.tmp")
+    # dtypes numpy cannot round-trip through npz (bfloat16/fp8 register
+    # as void kinds) are stored as their bit pattern; the template's
+    # dtype restores the view
+    host_leaves = [leaf.view(f"u{leaf.dtype.itemsize}")
+                   if leaf.dtype.kind == "V" else leaf
+                   for leaf in host_leaves]
+    with open(tmp, "wb") as f:
+        np.savez(f, **{f"a{i}": leaf for i, leaf in
+                       enumerate(host_leaves)})
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    for old in _npz_steps(ckpt_dir)[:-keep] if keep > 0 else []:
+        _npz_path(ckpt_dir, old).unlink(missing_ok=True)
+
+
+def _npz_restore(ckpt_dir: Path, step: int, params_template, shardings):
+    import numpy as np
+    with np.load(_npz_path(ckpt_dir, step)) as z:
+        host = [z[f"a{i}"] for i in range(len(z.files))]
+    leaves, treedef = jax.tree.flatten(params_template)
+    if len(host) != len(leaves):
+        raise ValueError(
+            f"checkpoint {_npz_path(ckpt_dir, step)} holds {len(host)} "
+            f"arrays but the template has {len(leaves)} leaves")
+    import numpy as np
+
+    def _cast(h, want):
+        want = np.dtype(want)
+        if h.dtype == want:
+            return h
+        if want.kind == "V" and h.dtype.itemsize == want.itemsize:
+            return h.view(want)  # bit-pattern round-trip (bfloat16/fp8)
+        return h.astype(want, copy=False)
+
+    host = [_cast(h, t.dtype) for h, t in zip(host, leaves)]
+    if shardings is None:
+        out = [jax.numpy.asarray(h) for h in host]
+    else:
+        shard_leaves = jax.tree.leaves(shardings)
+        out = [jax.device_put(h, s) for h, s in zip(host, shard_leaves)]
+    return jax.tree.unflatten(treedef, out)
 
 
 def _template(params_template, shardings):
@@ -41,9 +149,13 @@ def _template(params_template, shardings):
 
 
 def save_checkpoint(ckpt_dir: Path | str, step: int, params,
-                    keep: int = 3) -> None:
+                    keep: int = 3, backend: str = "auto") -> None:
     """Save ``params`` (any pytree of jax.Arrays, sharded or not) as the
     checkpoint for ``step``; blocks until durable."""
+    if _resolve_backend(backend) == "npz":
+        host = [jax.device_get(leaf) for leaf in jax.tree.leaves(params)]
+        _npz_save(Path(ckpt_dir), step, host, keep)
+        return
     import orbax.checkpoint as ocp
     mgr = _manager(ckpt_dir, keep)
     mgr.save(step, args=ocp.args.StandardSave(params))
@@ -53,15 +165,35 @@ def save_checkpoint(ckpt_dir: Path | str, step: int, params,
 
 def latest_step(ckpt_dir: Path | str) -> int | None:
     """Most recent checkpointed step, or None if no checkpoint exists.
-    Read-only: never creates the directory."""
+    Read-only: never creates the directory.  Recognizes both layouts
+    (orbax step directories, npz step files) so a restore never depends
+    on remembering which backend wrote the directory."""
     d = Path(ckpt_dir)
     if not d.exists():
         return None
-    mgr = _manager(d, create=False)
+    npz = _npz_steps(d)
+    if not any(p.is_dir() and p.name.split(".")[0].isdigit()
+               for p in d.iterdir()):
+        return npz[-1] if npz else None
+    # orbax step directories present (possibly ALONGSIDE npz files — a
+    # backend="auto" dir written under changing environments): the
+    # latest step is the max across layouts, never the npz files alone
     try:
-        return mgr.latest_step()
+        mgr = _manager(d, create=False)
+    except ImportError:
+        # step directories we cannot read: "no checkpoint" (or a stale
+        # npz answer) would make a resume silently restart over real
+        # saves — surface the misconfiguration instead
+        raise RuntimeError(
+            f"{d} holds orbax-layout checkpoints but orbax is not "
+            "importable; install the orbax extra (or restore where "
+            "it is available)")
+    try:
+        ob = mgr.latest_step()
     finally:
         mgr.close()
+    steps = npz + ([ob] if ob is not None else [])
+    return max(steps) if steps else None
 
 
 def restore_checkpoint(ckpt_dir: Path | str, params_template,
@@ -71,12 +203,31 @@ def restore_checkpoint(ckpt_dir: Path | str, params_template,
     ``params_template`` — a pytree of arrays (or ShapeDtypeStructs) giving
     shapes/dtypes; ``shardings`` (optional pytree of NamedShardings, e.g.
     ``spmd.param_shardings(mesh)``) lands each restored shard directly on
-    its mesh device — no host gather.  Without it, arrays restore to the
-    default device uncommitted.
+    its mesh device — no host gather on the orbax backend (npz restores
+    go host -> ``jax.device_put``).  Without it, arrays restore to the
+    default device uncommitted.  The backend is detected from the
+    on-disk layout.
     """
-    import orbax.checkpoint as ocp
-    if not Path(ckpt_dir).exists():
+    d = Path(ckpt_dir)
+    if not d.exists():
         raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    npz = _npz_steps(d)
+    # the default step is the latest ACROSS layouts (a backend="auto"
+    # dir written under changing environments can hold both; preferring
+    # the npz files outright could silently resume from a stale step),
+    # then the step routes to whichever layout holds it
+    if step is None:
+        step = latest_step(d)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    if step in npz:
+        return _npz_restore(d, step, params_template, shardings), step
+    if not any(p.is_dir() and p.name.split(".")[0].isdigit()
+               for p in d.iterdir()):
+        raise FileNotFoundError(
+            f"no checkpoint for step {step} under {ckpt_dir} "
+            f"(available: {npz})")
+    import orbax.checkpoint as ocp
     mgr = _manager(ckpt_dir, create=False)
     try:
         step = step if step is not None else mgr.latest_step()
@@ -94,16 +245,36 @@ def restore_checkpoint(ckpt_dir: Path | str, params_template,
 
 def train_with_checkpointing(step_fn, params, batch, *, num_steps: int,
                              ckpt_dir: Path | str, save_every: int = 1,
-                             shardings=None, keep: int = 3, log=None):
+                             shardings=None, keep: int = 3, log=None,
+                             backend: str = "auto"):
     """Crash-safe training loop: resume -> step -> periodic save.
 
     ``step_fn(params, batch) -> (params, loss)``.  Returns (params, losses,
     start_step): ``start_step`` > 0 means a checkpoint was resumed and
     ``losses`` covers only the steps actually executed now.
 
-    One CheckpointManager serves the whole loop (per-save construction
-    would re-scan the checkpoint directory every step).
+    On the orbax backend one CheckpointManager serves the whole loop
+    (per-save construction would re-scan the checkpoint directory every
+    step); the npz backend has no manager state to keep.
     """
+    if _resolve_backend(backend) == "npz":
+        start = 0
+        existing = latest_step(ckpt_dir)
+        if existing is not None:
+            params, _ = restore_checkpoint(ckpt_dir, params,
+                                           step=existing,
+                                           shardings=shardings)
+            start = existing + 1  # the saved step already completed
+            if log:
+                log(f"resumed from step {existing}")
+        losses = []
+        for step in range(start, num_steps):
+            params, loss = step_fn(params, batch)
+            losses.append(loss)
+            if (step + 1) % save_every == 0 or step == num_steps - 1:
+                save_checkpoint(ckpt_dir, step, params, keep=keep,
+                                backend="npz")
+        return params, [float(l) for l in losses], start
     import orbax.checkpoint as ocp
     mgr = _manager(ckpt_dir, keep)
     try:
@@ -126,3 +297,216 @@ def train_with_checkpointing(step_fn, params, batch, *, num_steps: int,
     finally:
         mgr.close()
     return params, [float(l) for l in losses], start
+
+
+class SnapshotCheckpointer:
+    """Periodic in-loop checkpointing with measured cost — the piece
+    the fault harness wires into faulted runs (faults/policy.py).
+
+    ``state`` is the pytree to snapshot; ``every`` the save period in
+    harness steps (plan units: warmup included, matching the fault
+    plan's triggers).  Two modes, the A/B ``bench.py checkpoint_ab``
+    prices:
+
+      * ``stall`` — the whole save (device sync + host copy + durable
+        write) runs inline, ON the timed critical path: every sample
+        lands in ``checkpoint_ms`` AND inflates the step it rode.
+      * ``async`` — only the device sync + host snapshot stays
+        in-window (``stall`` samples); the durable write moves to one
+        writer thread.  ``last_saved_step`` advances only when the
+        write COMPLETES — an in-flight save must never shrink the
+        lost-work accounting.
+
+    ``save_now`` is the preemption drain: given a grace budget it
+    attempts a final synchronous save unless the measured median save
+    cost says the budget cannot fit it (a real SIGTERM handler checks
+    its deadline before starting a write it cannot finish); with no
+    completed save to price from it always attempts.  A write whose
+    realized cost overran the budget is unpublished again — the
+    eviction closed the window mid-write, and atomic publication on
+    both backends means the torn write was never a checkpoint.
+    """
+
+    MODES = ("stall", "async")
+
+    def __init__(self, ckpt_dir: Path | str, state, *, every: int,
+                 mode: str = "async", backend: str = "auto",
+                 keep: int = 3, watchdog=None):
+        if every < 1:
+            raise ValueError("checkpoint interval must be >= 1 step")
+        if mode not in self.MODES:
+            raise ValueError(f"checkpoint mode {mode!r} not in "
+                             f"{self.MODES}")
+        self.ckpt_dir = Path(ckpt_dir)
+        self.every = int(every)
+        self.mode = mode
+        self.backend = _resolve_backend(backend)
+        self.keep = keep
+        self.watchdog = watchdog
+        self._leaves, self._treedef = jax.tree.flatten(state)
+        self.state_bytes = int(sum(
+            leaf.size * leaf.dtype.itemsize for leaf in self._leaves))
+        # measured costs (ms): total per completed save / in-window part
+        self.checkpoint_ms: list[float] = []
+        self.stall_ms: list[float] = []
+        self.saves = 0
+        self._lock = threading.Lock()
+        self._last_saved_step: int | None = None
+        self._q: queue.Queue | None = None
+        self._writer: threading.Thread | None = None
+        self._writer_error: BaseException | None = None
+
+    # ---- loop hooks --------------------------------------------------
+    def on_step(self, step: int) -> None:
+        """Call after harness step ``step`` (plan units) completed;
+        saves when the period elapses."""
+        if (step + 1) % self.every == 0:
+            self._save(step)
+
+    def save_now(self, step: int, budget_us: float | None = None) -> bool:
+        """Drain save for a preemption grace window.  Returns whether
+        the save LANDED.  Refuses up front when the measured median
+        cost says the budget cannot fit it (spending the grace on a
+        write that will be cut off buys nothing); with NO completed
+        save to price from it attempts anyway — that is exactly when a
+        drain rescues the most.  Either way, a write whose REALIZED
+        cost overran the budget is rolled back: the eviction closed the
+        window before the write finished, and atomic publication (tmp +
+        rename / orbax finalize) means the torn write was never visible
+        as a checkpoint.  The attempt's measured cost is kept — the
+        time was really spent, and it is save-cost data."""
+        if budget_us is not None:
+            with self._lock:
+                known = sorted(self.checkpoint_ms)
+            if known and known[len(known) // 2] * 1e3 > budget_us:
+                return False
+        # drain any in-flight async write FIRST: a queued periodic save
+        # completing on the writer thread mid-drain would otherwise be
+        # erased by the rollback below (prev_last captured stale), and
+        # letting it land is part of saving work anyway
+        self.wait()
+        prev_last = self.last_saved_step
+        t0 = time.monotonic()
+        self._save(step, force_sync=True)
+        if budget_us is not None and \
+                (time.monotonic() - t0) * 1e6 > budget_us:
+            self._discard(step, prev_last)
+            return False
+        return True
+
+    def _discard(self, step: int, prev_last: int | None) -> None:
+        """Unpublish the save for ``step`` (a drain the grace window
+        cut off): remove it from disk and restore the last-saved
+        pointer, so restore-from-latest and lost-work accounting treat
+        it as never having happened."""
+        if self.backend == "npz":
+            _npz_path(self.ckpt_dir, step).unlink(missing_ok=True)
+        else:
+            mgr = _manager(self.ckpt_dir, self.keep)
+            try:
+                mgr.delete(step)
+            finally:
+                mgr.close()
+        with self._lock:
+            if self._last_saved_step == step:
+                self._last_saved_step = prev_last
+            self.saves -= 1
+
+    def _save(self, step: int, force_sync: bool = False) -> None:
+        t0 = time.monotonic()
+        host = [jax.device_get(leaf) for leaf in self._leaves]
+        snap_ms = (time.monotonic() - t0) * 1e3
+        if self.mode == "stall" or force_sync:
+            self._write(step, host, t0)
+            self.stall_ms.append((time.monotonic() - t0) * 1e3)
+        else:
+            self.stall_ms.append(snap_ms)
+            self._ensure_writer()
+            self._q.put((step, host, t0))
+
+    def _write(self, step: int, host_leaves: list, t0: float) -> None:
+        if self.backend == "npz":
+            _npz_save(self.ckpt_dir, step, host_leaves, self.keep)
+        else:
+            save_checkpoint(self.ckpt_dir, step,
+                            jax.tree.unflatten(self._treedef, host_leaves),
+                            keep=self.keep, backend="orbax")
+        total_ms = (time.monotonic() - t0) * 1e3
+        with self._lock:
+            self.checkpoint_ms.append(total_ms)
+            self.saves += 1
+            if self._last_saved_step is None or \
+                    step > self._last_saved_step:
+                self._last_saved_step = step
+        if self.watchdog is not None:
+            self.watchdog.checkpoint_saved(step)
+
+    def _ensure_writer(self) -> None:
+        if self._writer is not None:
+            return
+        self._q = queue.Queue()
+
+        def run():
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                try:
+                    self._write(*item)
+                except BaseException as e:  # surfaced by wait()
+                    self._writer_error = e
+                finally:
+                    self._q.task_done()
+
+        self._writer = threading.Thread(target=run, daemon=True,
+                                        name="ckpt-writer")
+        self._writer.start()
+
+    # ---- accounting --------------------------------------------------
+    @property
+    def last_saved_step(self) -> int | None:
+        with self._lock:
+            return self._last_saved_step
+
+    def lost_steps(self, failure_iteration: int) -> int:
+        """Completed steps a restore-from-latest would redo: steps past
+        the last COMPLETED save at the moment step ``failure_iteration``
+        failed to run."""
+        last = self.last_saved_step
+        done = failure_iteration  # steps 0..failure_iteration-1 ran
+        if last is None:
+            return max(0, done)
+        return max(0, done - (last + 1))
+
+    def wait(self) -> None:
+        """Drain the async writer (idempotent); re-raises a writer
+        failure instead of silently reporting fewer saves."""
+        if self._writer is not None:
+            self._q.join()
+            self._q.put(None)
+            self._writer.join()
+            self._writer = None
+            self._q = None
+        if self._writer_error is not None:
+            e, self._writer_error = self._writer_error, None
+            raise e
+
+    def stats(self) -> dict:
+        """Record-ready cost summary (medians; per-sample arrays stay
+        on the object for the A/B line)."""
+        import statistics
+        with self._lock:
+            ck = list(self.checkpoint_ms)
+            st = list(self.stall_ms)
+            out = {
+                "checkpoint_every": self.every,
+                "checkpoint_mode": self.mode,
+                "checkpoint_backend": self.backend,
+                "checkpoint_saves": self.saves,
+                "checkpoint_state_bytes": self.state_bytes,
+            }
+        if ck:
+            out["checkpoint_ms"] = round(statistics.median(ck), 3)
+        if st:
+            out["checkpoint_stall_ms"] = round(statistics.median(st), 3)
+        return out

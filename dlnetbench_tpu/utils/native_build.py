@@ -93,12 +93,19 @@ def _run(cmd: list[str], what: str) -> None:
 def native_bin(repo: Path | str, build: bool = True) -> Path:
     """Path to the ``bin/`` directory holding the proxy binaries.
 
-    Prefers an existing in-tree ``native/build`` (manual builds, any
-    generator — rebuilt incrementally via ``cmake --build``);
-    otherwise configures+builds the Release tree out-of-tree with
-    Ninja.  With ``build=False`` just returns where the binaries would
-    live without building anything.
+    ``DLNB_NATIVE_BIN`` overrides everything (a prebuilt bin dir —
+    hand compiles on boxes without cmake/ninja); otherwise prefers an
+    existing in-tree ``native/build`` (manual builds, any generator —
+    rebuilt incrementally via ``cmake --build``); otherwise
+    configures+builds the Release tree out-of-tree with Ninja.  With
+    ``build=False`` just returns where the binaries would live without
+    building anything.
     """
+    env_bin = os.environ.get("DLNB_NATIVE_BIN")
+    if env_bin:
+        # an explicit prebuilt bin dir (hand compiles, cross builds,
+        # boxes without cmake/ninja) — trusted as-is, never rebuilt
+        return Path(env_bin)
     repo = Path(repo)
     native = repo / "native"
     in_tree = native / "build"

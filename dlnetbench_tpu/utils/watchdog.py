@@ -50,6 +50,12 @@ class StepWatchdog:
         # inside the harness the measuring thread was sitting — the
         # postmortem breadcrumb stamped into the record
         self.last_stall_spans: list[str] = []
+        # last COMPLETED checkpoint (utils/checkpoint.py
+        # SnapshotCheckpointer calls checkpoint_saved): a hang report
+        # should say how much work a kill would lose, so the stall
+        # message and the record carry the checkpoint's step + age
+        self._ckpt_step: int | None = None
+        self._ckpt_at: float | None = None
 
     def _default_on_stall(self, name: str, elapsed_s: float) -> None:
         ages = self.heartbeat_ages()
@@ -68,10 +74,33 @@ class StepWatchdog:
         if self.last_stall_spans:
             stack = ("; active spans: "
                      + " | ".join(self.last_stall_spans))
+        ckpt = ""
+        age = self.last_checkpoint_age_s()
+        if age is not None:
+            ckpt = (f"; last completed checkpoint: step "
+                    f"{self._ckpt_step} {age:.1f}s ago — a kill now "
+                    f"loses the work since")
         print(f"[watchdog] section {name!r} exceeded its {self.deadline_s:.1f}s "
               f"deadline ({elapsed_s:.1f}s elapsed) — likely a hung "
-              f"collective or device stall{where}{stack}",
+              f"collective or device stall{where}{stack}{ckpt}",
               file=sys.stderr, flush=True)
+
+    # ---- checkpoint age: what would a kill lose? ---------------------
+    def checkpoint_saved(self, step: int) -> None:
+        """Record a COMPLETED checkpoint save (wired by
+        utils/checkpoint.SnapshotCheckpointer; an in-flight async save
+        must not call this — it would understate the loss)."""
+        with self._beats_lock:
+            self._ckpt_step = step
+            self._ckpt_at = time.monotonic()
+
+    def last_checkpoint_age_s(self) -> float | None:
+        """Seconds since the last completed checkpoint save, or None
+        when no save completed under this watchdog."""
+        with self._beats_lock:
+            if self._ckpt_at is None:
+                return None
+            return time.monotonic() - self._ckpt_at
 
     # ---- heartbeats: where did progress stop? ------------------------
     def beat(self, key: str = "step") -> None:
@@ -97,6 +126,12 @@ class StepWatchdog:
         meta["watchdog_stalls"] = self.stalls
         if self.last_stall_spans:
             meta["watchdog_stall_spans"] = list(self.last_stall_spans)
+        age = self.last_checkpoint_age_s()
+        if age is not None:
+            # how much work a kill at emission time would lose: the age
+            # of the last completed save + which step it covered
+            meta["last_checkpoint_age_s"] = round(age, 3)
+            meta["last_checkpoint_step"] = self._ckpt_step
         return meta
 
     def _fire(self, armed_at: float) -> None:

@@ -210,7 +210,10 @@ def _run_hier_point(argv: list[str], world, records: Path, env,
 
 # ---------------------------------------------------------------------
 # --fault mode: the fault-injection & elastic-degradation study
-# (docs/RESILIENCE.md).  Three native points into ONE records.jsonl:
+# (docs/RESILIENCE.md).  Five points into ONE records.jsonl — three
+# native (straggler / crash+shrink / drop+retry, the r8 set), one
+# native preempt->rejoin (the grow half), and a python-tier seeded
+# goodput-vs-interval sweep the Daly model is validated against:
 #   1. straggler  — fsdp/shm, a 30 ms delay on rank 2 from step 4 on:
 #                   the clean window is the in-record baseline, the
 #                   summary reports straggler_amp and refuses busbw on
@@ -226,6 +229,26 @@ def _run_hier_point(argv: list[str], world, records: Path, env,
 #                   backoff counts ride the record.
 
 FAULT_MODEL = "gpt2_l_16_bfloat16"
+
+# the seeded goodput sweep (point 5): checkpoint intervals x seeds; each
+# seed draws its own preempt trigger, so the triggers are the "failure
+# arrivals" the exponential-MTBF fit treats as draws (analysis/goodput)
+ELASTIC_INTERVALS = (1, 2, 4, 8)
+ELASTIC_SEEDS = (0, 1, 2)
+ELASTIC_RUNS = 16  # measured steps per sweep run (+1 warmup)
+
+
+def elastic_plan(seed: int, *, warmup: int = 1) -> dict:
+    """The seeded preempt -> rejoin plan of one sweep run: rank 2 is
+    evicted at a seed-drawn step (grace 20 ms) and returns 4 steps
+    later.  Deterministic given the seed — the sweep is replayable."""
+    import random
+    rng = random.Random(seed)
+    pre = warmup + 4 + rng.randrange(5)  # plan steps 5..9
+    return {"policy": "shrink", "events": [
+        {"kind": "preempt", "ranks": [2], "iteration": pre,
+         "magnitude_us": 20000, "seed": seed},
+        {"kind": "rejoin", "ranks": [2], "iteration": pre + 4}]}
 
 
 def _fault_base(repo: str, runs: int = 6) -> list[str]:
@@ -250,7 +273,7 @@ def run_fault_plan(args, records: Path) -> int:
     plan = json.dumps({"events": [{"kind": "delay", "ranks": [2],
                                    "iteration": 4,
                                    "magnitude_us": 30000}]})
-    print("[fault 1/3] straggler: fsdp/shm world 4, 30 ms delay on "
+    print("[fault 1/5] straggler: fsdp/shm world 4, 30 ms delay on "
           "rank 2 from step 4", flush=True)
     rc = subprocess.run(
         [str(native / "fsdp"), "--world", "4", "--num_units", "4",
@@ -264,7 +287,7 @@ def run_fault_plan(args, records: Path) -> int:
     # 2. rank crash + shrink (tcp, 3 processes; rank 1 is the victim)
     plan = json.dumps({"events": [{"kind": "crash", "ranks": [1],
                                    "iteration": 4}]})
-    print("[fault 2/3] crash+shrink: dp/tcp world 3, rank 1 dies at "
+    print("[fault 2/5] crash+shrink: dp/tcp world 3, rank 1 dies at "
           "step 4, survivors regroup", flush=True)
     port = free_port()
     parts = [records.parent / f".fault_p{r}.jsonl" for r in range(3)]
@@ -296,7 +319,7 @@ def run_fault_plan(args, records: Path) -> int:
     plan = json.dumps({"events": [{"kind": "drop", "ranks": [0],
                                    "iteration": 0, "rate": 0.2,
                                    "magnitude_us": 200, "seed": 42}]})
-    print("[fault 3/3] drop+retry: dp/tcp world 2, 20 % injected frame "
+    print("[fault 3/5] drop+retry: dp/tcp world 2, 20 % injected frame "
           "loss, exponential backoff", flush=True)
     port = free_port()
     parts = [records.parent / f".fault_d{r}.jsonl" for r in range(2)]
@@ -322,10 +345,83 @@ def run_fault_plan(args, records: Path) -> int:
             failed += 1
     for p in parts:
         p.unlink(missing_ok=True)
+
+    # 4. preempt + rejoin (tcp, 3 processes): rank 1 is gracefully
+    # evicted at step 4 (20 ms drain), survivors run degraded, everyone
+    # re-splits onto the pre-built full-world comm at step 8 — ALL
+    # THREE ranks emit records, degraded_world is cleared, rejoin_ms
+    # measures the grow rendezvous (fault_session.hpp's grow half)
+    plan = json.dumps(elastic_plan(0, warmup=1))
+    print("[fault 4/5] preempt+rejoin: dp/tcp world 3, rank 1 evicted "
+          "(20 ms grace), rejoins 4 steps later — full world restored",
+          flush=True)
+    port = free_port()
+    parts = [records.parent / f".fault_e{r}.jsonl" for r in range(3)]
+    for p in parts:
+        p.unlink(missing_ok=True)
+    procs = [subprocess.Popen(
+        [str(native / "dp"), "--world", "3", "--backend", "tcp",
+         "--rank", str(r), "--coordinator", f"127.0.0.1:{port}",
+         "--num_buckets", "2", "--fault", plan,
+         "--fault_policy", "shrink", "--out", str(parts[r])]
+        + _fault_base(repo, runs=ELASTIC_RUNS),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for r in range(3)]
+    rcs = [p.wait(timeout=300) for p in procs]
+    if any(rcs):
+        print(f"  FAILED rcs={rcs}", file=sys.stderr)
+        failed += 1
+    else:
+        try:
+            merge_files(records, parts)
+        except ValueError as e:
+            print(f"  merge failed: {e}", file=sys.stderr)
+            failed += 1
+    for p in parts:
+        p.unlink(missing_ok=True)
+
+    # 5. the seeded goodput-vs-interval sweep (python tier: it owns the
+    # checkpoint subsystem): the full preempt -> drain-save -> restore
+    # -> shrink -> rejoin arc at every checkpoint interval x seed, each
+    # a fresh cli subprocess on the virtual mesh, stall-mode npz saves
+    # (the whole durable write on the timed path — the Daly model's d).
+    # fault_report fits the model and verdicts measured-vs-predicted.
+    n_pts = len(ELASTIC_INTERVALS) * len(ELASTIC_SEEDS)
+    print(f"[fault 5/5] goodput sweep: dp x {args.devices} virtual "
+          f"devices, intervals {ELASTIC_INTERVALS} x seeds "
+          f"{ELASTIC_SEEDS} ({n_pts} runs)", flush=True)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={args.devices}")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, env.get("PYTHONPATH")) if p)
+    for every in ELASTIC_INTERVALS:
+        for seed in ELASTIC_SEEDS:
+            ckpt_dir = records.parent / f".ckpt_e{every}_s{seed}"
+            rc = subprocess.run(
+                [sys.executable, "-m", "dlnetbench_tpu.cli", "dp",
+                 "--model", FAULT_MODEL, "--platform", "cpu",
+                 "--num_buckets", "2", "-r", str(ELASTIC_RUNS),
+                 "-w", "1", "--size_scale", "0.0001",
+                 "--time_scale", "0.001", "--no_topology",
+                 "--fault", json.dumps(elastic_plan(seed, warmup=1)),
+                 "--checkpoint_dir", str(ckpt_dir),
+                 "--checkpoint_every", str(every),
+                 "--checkpoint_mode", "stall",
+                 "--checkpoint_backend", "npz",
+                 "--tag", f"elastic_seed={seed}",
+                 "--out", str(records)],
+                env=env, stdout=subprocess.DEVNULL).returncode
+            import shutil
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+            if rc != 0:
+                print(f"  FAILED every={every} seed={seed} rc={rc}",
+                      file=sys.stderr)
+                failed += 1
     return failed
 
 
-def fault_report(args, records: Path) -> None:
+def fault_report(args, records: Path) -> int:
     from dlnetbench_tpu.analysis.bandwidth import bandwidth_summary, \
         straggler_amplification
     from dlnetbench_tpu.metrics.parser import load_records
@@ -335,24 +431,51 @@ def fault_report(args, records: Path) -> None:
           "(docs/RESILIENCE.md columns) ===")
     header = (f"{'section':<8} {'fault':<18} {'policy':<10} "
               f"{'straggler_amp':>13} {'detection_ms':>12} "
-              f"{'recovery_ms':>11} {'drops':>6} {'retries':>8} "
+              f"{'recovery_ms':>11} {'rejoin_ms':>10} {'ckpt_ms':>8} "
+              f"{'lost':>5} {'goodput':>8} {'drops':>6} {'retries':>8} "
               f"degraded_world")
     print(header)
+
+    def _f(v, width, prec=3):
+        return (f"{v:>{width}.{prec}f}" if isinstance(v, (int, float))
+                else f"{'-':>{width}}")
+
     for rec in recs:
         g = rec.get("global", {})
         plan = g.get("fault_plan") or {}
         kinds = "+".join(sorted({e.get("kind", "?")
                                  for e in plan.get("events", [])})) or "-"
         amp = straggler_amplification(rec)
-        det, rcv = g.get("detection_ms"), g.get("recovery_ms")
         print(f"{rec.get('section', '?'):<8} {kinds:<18} "
               f"{g.get('fault_policy', '-'):<10} "
               f"{amp if amp == amp else float('nan'):>13.3f} "
-              f"{det if det is not None else float('nan'):>12.3f} "
-              f"{rcv if rcv is not None else float('nan'):>11.3f} "
+              f"{_f(g.get('detection_ms'), 12)} "
+              f"{_f(g.get('recovery_ms'), 11)} "
+              f"{_f(g.get('rejoin_ms'), 10)} "
+              f"{_f(g.get('checkpoint_ms'), 8)} "
+              f"{_f(g.get('lost_steps'), 5, 0)} "
+              f"{_f(g.get('goodput'), 8, 2)} "
               f"{g.get('fault_drops', 0):>6} "
               f"{g.get('fault_retries', 0):>8} "
               f"{g.get('degraded_world', '-')}")
+
+    # the Daly-interval validation over the goodput sweep records
+    # (analysis/goodput.py): nonzero when the measured optimum falls
+    # OUTSIDE the model's prediction band — the study's acceptance
+    # criterion, enforced at generation time, not just documented
+    rc = 0
+    from dlnetbench_tpu.analysis import goodput as goodput_mod
+    try:
+        verdict = goodput_mod.validate_sweep(recs)
+    except ValueError:
+        verdict = None  # no sweep records in this artifact
+    if verdict is not None:
+        print("\n=== checkpoint-interval planning: measured goodput vs "
+              "the Daly model (analysis/goodput.py) ===")
+        rc = 0 if verdict["in_band"] else 1
+        goodput_mod.report(records, verdict=verdict)
+        with open(args.out_dir / "goodput_verdict.json", "w") as f:
+            json.dump(verdict, f, indent=1)
 
     bw = bandwidth_summary(recs)
     if not bw.empty:
@@ -366,6 +489,7 @@ def fault_report(args, records: Path) -> None:
                   index=False)
     print(f"\nwrote {records} and "
           f"{args.out_dir}/fault_bandwidth_summary.csv")
+    return rc
 
 
 def report(args, records: Path) -> None:
@@ -488,9 +612,14 @@ def main() -> int:
                          "proxy grid: a straggler point (fsdp/shm, "
                          "measured amplification), a rank-crash point "
                          "(dp/tcp, shrink policy, detection/recovery + "
-                         "degraded merge), and a drop point (dp/tcp, "
-                         "retry policy with backoff counts) — one "
-                         "records.jsonl artifact; docs/RESILIENCE.md")
+                         "degraded merge), a drop point (dp/tcp, retry "
+                         "policy with backoff counts), a preempt+rejoin "
+                         "point (dp/tcp, graceful eviction, full world "
+                         "restored, rejoin_ms), and the seeded "
+                         "goodput-vs-checkpoint-interval sweep the Daly "
+                         "model is validated against (python tier, "
+                         "analysis/goodput.py) — one records.jsonl "
+                         "artifact; docs/RESILIENCE.md")
     ap.add_argument("--congest", action="store_true",
                     help="run a dp_loop congestor pair (native TCP fabric) "
                          "for the duration of the sweep — sustained "
@@ -528,7 +657,7 @@ def main() -> int:
         if not args.report_only:
             records.unlink(missing_ok=True)
             failed = run_fault_plan(args, records)
-        fault_report(args, records)
+        failed += fault_report(args, records)
         if failed:
             print(f"\n{failed} fault study point(s) failed",
                   file=sys.stderr)

@@ -9,12 +9,23 @@
 // (shared schema with the Python tier's dlnetbench_tpu/faults/plan.py):
 //
 //   {"policy": "fail_fast" | "retry" | "shrink",
-//    "events": [{"kind": "delay|jitter|drop|crash|partition",
+//    "events": [{"kind": "delay|jitter|drop|crash|partition|preempt|rejoin",
 //                "ranks": [..], "iteration": K, "until": -1,
 //                "magnitude_us": 20000, "rate": 0.05, "seed": 7,
 //                "where": "step" | "collective",
 //                "group": [..]  // partition: the ranks on THIS side
 //               }, ...]}
+//
+// Elastic eviction (policy `shrink` required, like the python tier):
+//   preempt — a scripted GRACEFUL drain: the victim sleeps its
+//             magnitude_us grace window at the trigger, then idles out
+//             of the run (no Bye-less death — the departure is
+//             plan-known to every rank, so survivors pre-split their
+//             degraded communicator like shrink does).
+//   rejoin  — the evicted ranks return at `iteration`: fault::Session
+//             re-splits everyone onto a pre-built FULL-world
+//             communicator with a fresh comm id (the grow half of
+//             shrink) and the record clears degraded_world.
 //
 // Injection points (all driven through the process-global Plan
 // singleton, loaded from --fault / DLNB_FAULT_PLAN):
@@ -85,7 +96,7 @@ struct RankFailure : std::runtime_error {
   long long iteration;
 };
 
-enum class Kind { Delay, Jitter, Drop, Crash, Partition };
+enum class Kind { Delay, Jitter, Drop, Crash, Partition, Preempt, Rejoin };
 
 inline const char* kind_name(Kind k) {
   switch (k) {
@@ -94,6 +105,8 @@ inline const char* kind_name(Kind k) {
     case Kind::Drop: return "drop";
     case Kind::Crash: return "crash";
     case Kind::Partition: return "partition";
+    case Kind::Preempt: return "preempt";
+    case Kind::Rejoin: return "rejoin";
   }
   return "?";
 }
@@ -104,6 +117,8 @@ inline Kind kind_from_name(const std::string& s) {
   if (s == "drop") return Kind::Drop;
   if (s == "crash") return Kind::Crash;
   if (s == "partition") return Kind::Partition;
+  if (s == "preempt") return Kind::Preempt;
+  if (s == "rejoin") return Kind::Rejoin;
   throw std::runtime_error("fault plan: unknown kind '" + s + "'");
 }
 
@@ -144,6 +159,12 @@ struct Report {
   std::atomic<double> recovery_us{0.0};
   std::atomic<bool> shrunk{false};
   std::atomic<double> injected_delay_us{0.0};
+  // elastic grow (preempt -> rejoin): did this rank reach the
+  // full-world re-split, and what did its first rejoined step cost
+  // (the grow-side recovery — waiting for the returning rank to
+  // rendezvous on the fresh comm)?
+  std::atomic<bool> rejoined{false};
+  std::atomic<double> rejoin_us{0.0};
 };
 
 class Plan {
@@ -195,11 +216,46 @@ class Plan {
       if (ev.kind == Kind::Partition && ev.group.empty())
         throw std::runtime_error(
             "fault plan: partition needs 'group' (the ranks on one side)");
+      if (ev.kind == Kind::Preempt && ev.ranks.empty())
+        throw std::runtime_error(
+            "fault plan: preempt needs explicit 'ranks' (the evicted "
+            "ranks must be plan-known on every tier)");
       events_.push_back(std::move(ev));
     }
     if (policy_ != "fail_fast" && policy_ != "retry" && policy_ != "shrink")
       throw std::runtime_error("fault plan: unknown policy '" + policy_ +
                                "' (fail_fast | retry | shrink)");
+    {
+      bool has_pre = false, has_rej = false;
+      for (const auto& e : events_) {
+        has_pre = has_pre || e.kind == Kind::Preempt;
+        has_rej = has_rej || e.kind == Kind::Rejoin;
+      }
+      if ((has_pre || has_rej) && policy_ != "shrink")
+        throw std::runtime_error(
+            "fault plan: preempt/rejoin model elastic eviction and "
+            "recovery — they need policy 'shrink' (an eviction under "
+            "fail_fast is just a crash; script that instead)");
+      if (has_rej && !has_pre)
+        throw std::runtime_error(
+            "fault plan: rejoin without a preempt — nobody left to "
+            "return");
+      for (const auto& r : events_) {
+        if (r.kind != Kind::Rejoin) continue;
+        for (const auto& p : events_) {
+          if (p.kind != Kind::Preempt) continue;
+          bool related = r.ranks.empty();
+          for (int rr : r.ranks)
+            for (int pp : p.ranks) related = related || rr == pp;
+          if (related && r.iteration <= p.iteration)
+            throw std::runtime_error(
+                "fault plan: rejoin at iteration " +
+                std::to_string(r.iteration) +
+                " does not follow its preempt at " +
+                std::to_string(p.iteration));
+        }
+      }
+    }
     raw_ = j;
     active_ = !events_.empty();
   }
@@ -219,7 +275,9 @@ class Plan {
   // of stamping fault provenance onto an actually-clean run.
   bool has_step_events() const {
     for (const auto& e : events_) {
-      if (e.kind == Kind::Crash || e.kind == Kind::Partition) return true;
+      if (e.kind == Kind::Crash || e.kind == Kind::Partition ||
+          e.kind == Kind::Preempt || e.kind == Kind::Rejoin)
+        return true;
       if ((e.kind == Kind::Delay || e.kind == Kind::Jitter) &&
           e.where == "step")
         return true;
@@ -249,6 +307,64 @@ class Plan {
     return out;
   }
 
+  // ---- elastic eviction (preempt/rejoin) queries -------------------
+  std::vector<int> preempt_victims() const {
+    std::vector<int> out;
+    for (const auto& e : events_)
+      if (e.kind == Kind::Preempt)
+        for (int r : e.ranks)
+          if (std::find(out.begin(), out.end(), r) == out.end())
+            out.push_back(r);
+    std::sort(out.begin(), out.end());
+    return out;
+  }
+
+  bool has_preempt() const { return !preempt_victims().empty(); }
+
+  // first step index at which evicted ranks return (-1: never grows)
+  long long rejoin_iteration() const {
+    long long at = -1;
+    for (const auto& e : events_)
+      if (e.kind == Kind::Rejoin && (at < 0 || e.iteration < at))
+        at = e.iteration;
+    return at;
+  }
+
+  // Is `rank` out of the run at `iter` — inside a preempt window no
+  // rejoin (or `until`) has closed yet?  Mirrors faults/plan.py.
+  bool evicted(int rank, long long iter) const {
+    for (const auto& e : events_) {
+      if (e.kind != Kind::Preempt ||
+          std::find(e.ranks.begin(), e.ranks.end(), rank) == e.ranks.end())
+        continue;
+      long long end = e.until;
+      for (const auto& r : events_) {
+        if (r.kind != Kind::Rejoin || r.iteration <= e.iteration) continue;
+        if (!r.targets(rank)) continue;
+        end = end < 0 ? r.iteration : std::min(end, r.iteration);
+      }
+      if (iter >= e.iteration && (end < 0 || iter < end)) return true;
+    }
+    return false;
+  }
+
+  bool any_evicted(long long iter) const {
+    for (int r : preempt_victims())
+      if (evicted(r, iter)) return true;
+    return false;
+  }
+
+  // Survivor set of the elastic eviction window (crash victims are
+  // gone forever, preempt victims only inside their window).
+  std::vector<int> elastic_survivors() const {
+    auto pre = preempt_victims();
+    std::vector<int> out;
+    for (int r : survivors())
+      if (std::find(pre.begin(), pre.end(), r) == pre.end())
+        out.push_back(r);
+    return out;
+  }
+
   // ---- step boundary: delay/jitter sleeps, crash throw -------------
   // Returns the injected sleep in microseconds (already slept).
   double on_step_begin(int rank) {
@@ -269,9 +385,17 @@ class Plan {
         case Kind::Crash:
           if (iter == e.iteration) throw RankFailure(rank, iter);
           break;
+        case Kind::Preempt:
+          // the scripted graceful drain: the victim spends its grace
+          // window at the eviction trigger (the SIGTERM-notice cost),
+          // then fault::Session idles it out — no throw, no Bye-less
+          // death; the departure is announced
+          if (iter == e.iteration) slept += sleep_us(e.magnitude_us);
+          break;
         case Kind::Drop:
         case Kind::Partition:
-          break;  // injected at the transport layer
+        case Kind::Rejoin:
+          break;  // transport-layer / Session-driven events
       }
     }
     if (slept > 0) add_delay(rank, slept);

@@ -66,12 +66,13 @@ inline void step_guard(Fabric& fab, int rank) {
 inline void require_no_shrink(const char* proxy) {
   auto& plan = Plan::instance();
   if (plan.active() && plan.policy() == "shrink" &&
-      !plan.crash_victims().empty())
+      (!plan.crash_victims().empty() || plan.has_preempt()))
     throw std::runtime_error(
         std::string(proxy) +
-        ": the shrink policy needs a survivor regrouping this proxy's "
-        "communicator grid does not support — use the dp proxy (or the "
-        "python tier's rebuild path), or policy fail_fast/retry");
+        ": the shrink policy (and the preempt/rejoin elastic arc) "
+        "needs a survivor regrouping this proxy's communicator grid "
+        "does not support — use the dp proxy (or the python tier's "
+        "rebuild path), or policy fail_fast/retry");
 }
 
 // For proxies with NO step-boundary fault driver at all: refuse plans
@@ -99,12 +100,33 @@ class Session {
     auto victims = plan_.crash_victims();
     victim_ = std::find(victims.begin(), victims.end(), rank_) !=
               victims.end();
-    if (plan_.policy() == "shrink" && !victims.empty())
+    auto evictees = plan_.preempt_victims();
+    evictee_ = std::find(evictees.begin(), evictees.end(), rank_) !=
+               evictees.end();
+    if (plan_.policy() == "shrink" &&
+        (!victims.empty() || !evictees.empty())) {
       // collective split while everyone is still alive: survivors get
-      // color 0, victims color 1 (their group is never used) — a new
-      // comm id everywhere, so stale frames of a failed world-comm
-      // step can never match the survivor group's traffic
-      surv_ = fab.split(world_rank, victim_ ? 1 : 0, "fault_survivors");
+      // color 0 — a new comm id everywhere, so stale frames of a
+      // failed world-comm step can never match the survivor group's
+      // traffic.  Crash victims share color 1 (their group is never
+      // used — they die); each PREEMPT victim gets its own singleton
+      // group (color 2 + rank): an evicted rank keeps replaying its
+      // schedule locally while drained (staying hot to rejoin
+      // quickly), so its timer arrays keep one sample per iteration —
+      // the record shape every parser validates — while it moves no
+      // fabric bytes.  The faulted-window busbw refusal keeps those
+      // local samples out of every bandwidth figure.
+      int color = 0;
+      if (victim_) color = 1;
+      if (evictee_) color = 2 + rank_;
+      surv_ = fab.split(world_rank, color, "fault_survivors");
+    }
+    if (plan_.policy() == "shrink" && plan_.rejoin_iteration() >= 0)
+      // the grow half, pre-split like shrink's: every rank (including
+      // the future evictee) takes color 0 on a FRESH comm id, so the
+      // returning rank is accepted deterministically — no runtime
+      // agreement protocol, the plan already told everyone
+      rejoin_ = fab.split(world_rank, 0, "fault_rejoin");
   }
 
   template <typename Body>
@@ -119,7 +141,37 @@ class Session {
       fab_.mark_rank_dead(rank_);
       throw;
     }
-    ProxyCommunicator& c = (shrunk_ && surv_) ? *surv_ : world;
+    long long it = plan_.iteration_of(rank_) - 1;  // the step running now
+    // ---- elastic eviction window (preempt -> rejoin) ----
+    evicted_now_ = plan_.evicted(rank_, it);
+    long long rejoin_at = plan_.rejoin_iteration();
+    if (rejoin_ && rejoin_at >= 0 && it >= rejoin_at) {
+      // grow back: everyone — the returning evictee included — runs on
+      // the pre-split full-world comm from the rejoin trigger on.  The
+      // first step's wall time is the measured grow cost (the
+      // rendezvous waits for the returning rank) and degraded_world is
+      // cleared by the emitter (proxy_runner.hpp).
+      evicted_now_ = false;
+      if (!rejoined_) {
+        auto r0 = Clock::now();
+        body(*rejoin_);
+        auto& rep = plan_.report(rank_);
+        rep.rejoin_us.store(us_since(r0));
+        rep.rejoined.store(true);
+        rejoined_ = true;
+        return;
+      }
+      body(*rejoin_);
+      return;
+    }
+    if (evicted_now_ && surv_) {
+      // the drained victim: local singleton replay (see ctor comment)
+      body(*surv_);
+      return;
+    }
+    ProxyCommunicator& c =
+        ((shrunk_ || (plan_.any_evicted(it) && !evictee_)) && surv_)
+            ? *surv_ : world;
     auto snapshot = t.sizes();
     auto t0 = Clock::now();
     try {
@@ -143,14 +195,27 @@ class Session {
 
   bool shrunk() const { return shrunk_; }
   bool victim() const { return victim_; }
+  // elastic-eviction state as of the LAST step() call — the selftest's
+  // expected-sum oracle
+  bool evicted_now() const { return evicted_now_; }
+  bool rejoined() const { return rejoined_; }
+  // degraded membership while any rank is drained (survivor view)
+  bool degraded_now() const {
+    return !rejoined_ && !evicted_now_ &&
+           plan_.any_evicted(plan_.iteration_of(rank_) - 1);
+  }
 
  private:
   Fabric& fab_;
   int rank_;
   Plan& plan_;
-  bool victim_ = false;
+  bool victim_ = false;    // scripted crash victim
+  bool evictee_ = false;   // scripted preempt victim
   bool shrunk_ = false;
+  bool evicted_now_ = false;
+  bool rejoined_ = false;
   std::unique_ptr<ProxyCommunicator> surv_;
+  std::unique_ptr<ProxyCommunicator> rejoin_;
 };
 
 }  // namespace fault

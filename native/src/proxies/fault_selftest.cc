@@ -81,8 +81,14 @@ int main(int argc, char** argv) {
           c.Allreduce(src.data(), dst.data(), count);
           // expected sum over the LIVE membership of this step
           float expect = 0;
-          if (fses.shrunk())
+          if (fses.rejoined())
+            expect = world * (world + 1) / 2.0f;  // full world again
+          else if (fses.evicted_now())
+            expect = static_cast<float>(r + 1);   // singleton replay
+          else if (fses.shrunk())
             for (int s : plan.survivors()) expect += s + 1;
+          else if (fses.degraded_now())
+            for (int s : plan.elastic_survivors()) expect += s + 1;
           else
             expect = world * (world + 1) / 2.0f;
           if (dst.get(0) != expect ||
@@ -110,8 +116,13 @@ int main(int argc, char** argv) {
         j["injected_delay_us"] = rep.injected_delay_us.load();
         j["drops"] = static_cast<std::int64_t>(plan.drops());
         j["retries"] = static_cast<std::int64_t>(plan.retries());
+        j["rejoined"] = rep.rejoined.load();
+        j["rejoin_us"] = rep.rejoin_us.load();
         Json dw = Json::array();
-        for (int s : plan.survivors()) dw.push_back(s);
+        // a rejoined run ended full-world: degraded_world is cleared
+        for (int s : (rep.rejoined.load() ? plan.survivors()
+                                          : plan.elastic_survivors()))
+          dw.push_back(s);
         j["degraded_world"] = dw;
       }
       std::cout << j.dump() << std::endl;

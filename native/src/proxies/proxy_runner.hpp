@@ -353,6 +353,30 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
     local = kept;
     degraded = true;
   }
+  if (plan.active() && plan.policy() == "shrink" && plan.has_preempt()) {
+    // an eviction that never grew back degrades the run to its end:
+    // the drained evictee's rows are local replay (no fabric work) —
+    // drop them and declare survivor membership below, mirroring the
+    // python tier's preempt-without-rejoin record.  A fired rejoin
+    // (every live rank's report says so) keeps full coverage instead.
+    bool rejoined_any = false;
+    for (int r : fab.local_ranks())
+      rejoined_any = rejoined_any || plan.report(r).rejoined.load();
+    if (!rejoined_any) {
+      auto ev = plan.preempt_victims();
+      std::vector<int> kept;
+      for (int r : local)
+        if (std::find(ev.begin(), ev.end(), r) == ev.end())
+          kept.push_back(r);
+      local = kept;
+      degraded = true;
+    }
+  }
+  if (local.empty())
+    // every locally-owned rank drained out of the run (the tcp evictee
+    // process of an unrejoined preempt): alive, exit 0, no record —
+    // merge's degraded pathway tolerates the absent process
+    return 0;
   std::string host = local_hostname();
   if (plan.active())
     for (int r : local)
@@ -418,24 +442,35 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
   if (plan.active()) {
     // fault provenance: the plan itself + run-wide drop/retry counters
     plan.describe(meta);
-    double inj = 0, det = 0, rec = 0;
-    bool shrunk = false;
+    double inj = 0, det = 0, rec = 0, rej = 0;
+    bool shrunk = false, rejoined = false;
     for (int r : local) {
       auto& rep = plan.report(r);
       inj += rep.injected_delay_us.load();
       det = std::max(det, rep.detection_us.load());
       rec = std::max(rec, rep.recovery_us.load());
+      rej = std::max(rej, rep.rejoin_us.load());
       shrunk = shrunk || rep.shrunk.load();
+      rejoined = rejoined || rep.rejoined.load();
     }
     meta["fault_injected_delay_us"] = inj;
-    if (degraded) {
+    if (degraded && !rejoined) {
+      // a rejoined run ended FULL world: degraded_world stays CLEARED
+      // (preempt victims are alive and emit rows, so the record covers
+      // range(world) again).  elastic_survivors: crash victims are
+      // gone forever AND an unrejoined evictee drained out for good.
       Json dw = Json::array();
-      for (int r : plan.survivors()) dw.push_back(r);
+      for (int r : plan.elastic_survivors()) dw.push_back(r);
       meta["degraded_world"] = dw;
     }
     if (shrunk) {
       meta["detection_ms"] = det / 1e3;
       meta["recovery_ms"] = rec / 1e3;
+    }
+    if (rejoined) {
+      meta["fault_rejoin_step"] =
+          static_cast<std::int64_t>(plan.rejoin_iteration());
+      meta["rejoin_ms"] = rej / 1e3;
     }
   }
   Json mesh = Json::object();

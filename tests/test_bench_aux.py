@@ -235,6 +235,52 @@ def test_aux_deadline_skips_instead_of_running(capsys, monkeypatch):
     assert "deadline" in line["skipped"]
 
 
+def test_checkpoint_ab_line_schema_locked(monkeypatch, tmp_path):
+    """The stall-vs-async checkpoint A/B is a BENCH artifact: lock the
+    schema — headline {value, unit, n}, the three step bands, the
+    measured save-cost band, state size and backend — without paying
+    for a real dp build (the proxy step is a stub; the checkpointer
+    runs for real over a tiny state, so save costs are measured)."""
+    import jax.numpy as jnp
+
+    import bench
+
+    class FakeBundle:
+        full = staticmethod(lambda: None)
+        state = {"w": jnp.ones((64,), jnp.float32)}
+
+    monkeypatch.setattr(
+        "dlnetbench_tpu.proxies.dp.build", lambda *a, **k: FakeBundle())
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+
+    def fake_time_chain(fn, k):
+        import time as _t
+        t0 = _t.monotonic()
+        for _ in range(k):
+            fn()
+        return 0.001 + (_t.monotonic() - t0) / k
+
+    monkeypatch.setattr("dlnetbench_tpu.utils.timing.time_chain",
+                        fake_time_chain)
+    line = bench._bench_checkpoint_ab()
+    assert line is not None
+    assert line["metric"].startswith("checkpoint A/B")
+    assert line["unit"].startswith("fraction of save cost")
+    for key in ("baseline_ms", "stall_ms", "async_ms", "save_ms"):
+        sub = line[key]
+        assert set(sub) == {"value", "best", "band", "n"}
+        assert sub["band"][0] <= sub["value"] <= sub["band"][1]
+    # a stall-mode save rides the step; the async step must sit closer
+    # to the baseline than the stall step does
+    assert line["stall_ms"]["value"] >= line["async_ms"]["value"]
+    assert line["save_ms"]["n"] == 12  # 3 rounds x k=4, every=1
+    assert line["state_bytes"] == 64 * 4
+    assert line["backend"] in ("npz", "orbax")
+    assert line["n"] == 3
+    # nothing left behind: the A/B cleans up its checkpoint tree
+    assert not list(tmp_path.glob("dlnb_ckpt_ab_*"))
+
+
 def test_straggler_ab_line_schema_locked(monkeypatch):
     """The faulted-vs-clean straggler A/B is a BENCH artifact: lock the
     schema — amplification headline {value, unit, n}, both step bands

@@ -1,5 +1,12 @@
-"""Checkpoint/resume (utils/checkpoint.py) incl. a simulated crash-resume
-of the sharded SPMD training step on the 8-device mesh."""
+"""Checkpoint/resume (utils/checkpoint.py): both backends (orbax when
+installed, the pure-numpy npz fallback always), the crash-resume loop,
+and the in-loop SnapshotCheckpointer the fault harness wires into
+faulted runs.
+
+No blanket orbax importorskip (ISSUE 7 satellite): the npz backend has
+no dependency beyond jax/numpy, so the crash-resume contract is
+exercised in tier-1 on machines without orbax; orbax-specific cases
+skip individually."""
 from __future__ import annotations
 
 import jax
@@ -7,15 +14,28 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-pytest.importorskip("orbax.checkpoint")
-
-from dlnetbench_tpu.models import spmd
 from dlnetbench_tpu.utils import checkpoint as ckpt
 
 
-def test_save_restore_roundtrip(tmp_path):
+def _has_orbax() -> bool:
+    try:
+        import orbax.checkpoint  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+BACKENDS = ["npz"] + (["orbax"] if _has_orbax() else [])
+
+
+@pytest.fixture(params=BACKENDS)
+def backend(request):
+    return request.param
+
+
+def test_save_restore_roundtrip(tmp_path, backend):
     params = {"w": jnp.arange(8.0).reshape(2, 4), "b": jnp.ones((3,))}
-    ckpt.save_checkpoint(tmp_path / "c", 5, params)
+    ckpt.save_checkpoint(tmp_path / "c", 5, params, backend=backend)
     assert ckpt.latest_step(tmp_path / "c") == 5
     template = jax.tree.map(jnp.zeros_like, params)
     restored, step = ckpt.restore_checkpoint(tmp_path / "c", template)
@@ -24,29 +44,107 @@ def test_save_restore_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_npz_roundtrips_bfloat16_bit_exact(tmp_path):
+    """dtypes numpy cannot natively serialize (bfloat16 registers as a
+    void kind) round-trip through the bit-pattern path."""
+    params = {"w": jnp.linspace(-3, 3, 16, dtype=jnp.bfloat16)}
+    ckpt.save_checkpoint(tmp_path / "c", 0, params, backend="npz")
+    restored, _ = ckpt.restore_checkpoint(tmp_path / "c", params)
+    assert restored["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(params["w"]).view(np.uint16),
+        np.asarray(restored["w"]).view(np.uint16))
+
+
+def test_dual_layout_dir_prefers_newest_across_backends(tmp_path):
+    """A backend="auto" directory written under changing environments
+    can hold BOTH layouts; latest/default-restore must take the max
+    across them — preferring the npz files outright would silently
+    resume from a stale step and supersede the real newest save."""
+    pytest.importorskip("orbax.checkpoint")
+    params = {"w": jnp.arange(4.0)}
+    d = tmp_path / "c"
+    ckpt.save_checkpoint(d, 2, params, backend="npz")
+    newer = {"w": jnp.arange(4.0) + 10.0}
+    ckpt.save_checkpoint(d, 4, newer, backend="orbax")
+    assert ckpt.latest_step(d) == 4
+    template = jax.tree.map(jnp.zeros_like, params)
+    restored, step = ckpt.restore_checkpoint(d, template)
+    assert step == 4
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(newer["w"]))
+    # an explicit step still routes to the layout that holds it
+    restored2, step2 = ckpt.restore_checkpoint(d, template, step=2)
+    assert step2 == 2
+    np.testing.assert_array_equal(np.asarray(restored2["w"]),
+                                  np.asarray(params["w"]))
+
+
 def test_latest_step_empty(tmp_path):
     assert ckpt.latest_step(tmp_path / "nope") is None
     with pytest.raises(FileNotFoundError):
         ckpt.restore_checkpoint(tmp_path / "nope2", {})
 
 
-def test_keep_limit_prunes_old_steps(tmp_path):
+def test_unknown_backend_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown checkpoint backend"):
+        ckpt.save_checkpoint(tmp_path / "c", 0, {"w": jnp.ones(2)},
+                             backend="pickle")
+
+
+def test_keep_limit_prunes_old_steps(tmp_path, backend):
     params = {"w": jnp.ones((2,))}
     for s in range(5):
-        ckpt.save_checkpoint(tmp_path / "c", s, params, keep=2)
+        ckpt.save_checkpoint(tmp_path / "c", s, params, keep=2,
+                             backend=backend)
     assert ckpt.latest_step(tmp_path / "c") == 4
     # steps 0..2 were pruned by keep=2 — only 3 and 4 remain on disk
-    steps_on_disk = sorted(int(p.name) for p in (tmp_path / "c").iterdir()
-                           if p.name.isdigit())
+    steps_on_disk = sorted(int(p.name.removesuffix(".npz"))
+                           for p in (tmp_path / "c").iterdir()
+                           if p.name.removesuffix(".npz").isdigit())
     assert steps_on_disk == [3, 4]
     with pytest.raises(FileNotFoundError, match="no checkpoint for step 0"):
         ckpt.restore_checkpoint(tmp_path / "c", params, step=0)
 
 
+def test_crash_resume_loop_npz(tmp_path):
+    """The crash-resume contract WITHOUT orbax: 4 steps straight vs
+    2 -> 'crash' -> resume -> 2 more must agree exactly (the npz
+    backend gathers to host and rebuilds, so equality is bit-exact
+    on the same machine)."""
+    def step(params, batch):
+        p = params["w"] - 0.1 * batch
+        return {"w": p}, float(jnp.sum(p))
+
+    batch = jnp.ones((4,))
+    p0 = {"w": jnp.zeros((4,))}
+    p_ref, ref_losses = p0, []
+    for _ in range(4):
+        p_ref, loss = step(p_ref, batch)
+        ref_losses.append(loss)
+
+    d = tmp_path / "run"
+    p1, losses1, start1 = ckpt.train_with_checkpointing(
+        step, p0, batch, num_steps=2, ckpt_dir=d, save_every=1,
+        backend="npz")
+    assert start1 == 0 and len(losses1) == 2
+    p2, losses2, start2 = ckpt.train_with_checkpointing(
+        step, p0, batch, num_steps=4, ckpt_dir=d, save_every=1,
+        backend="npz")
+    assert start2 == 2 and len(losses2) == 2
+    assert losses1 + losses2 == pytest.approx(ref_losses)
+    np.testing.assert_array_equal(np.asarray(p_ref["w"]),
+                                  np.asarray(p2["w"]))
+
+
 @pytest.mark.slow
 def test_spmd_crash_resume_matches_uninterrupted(eight_devices, tmp_path):
     """Run 4 steps straight vs. 2 steps -> 'crash' -> resume -> 2 more:
-    the final sharded params must match."""
+    the final sharded params must match (orbax: sharding-aware
+    restore)."""
+    pytest.importorskip("orbax.checkpoint")
+    from dlnetbench_tpu.models import spmd
+
     cfg = spmd.SpmdConfig(capacity_factor=8.0)
     mesh, _, step, params0, tokens = spmd.build(8, cfg)
     shardings = spmd.param_shardings(mesh, cfg.sp_mode)
@@ -78,3 +176,124 @@ def test_spmd_crash_resume_matches_uninterrupted(eight_devices, tmp_path):
     # restored arrays keep their mesh sharding (no host-gather restore)
     leaf = p2["layers"]["wq"]
     assert len(leaf.sharding.device_set) > 1
+
+
+# ---------------------------------------------- SnapshotCheckpointer
+def _state():
+    return {"w": jnp.arange(64.0).reshape(8, 8),
+            "b": jnp.ones((16,), jnp.float32)}
+
+
+def test_snapshot_periodic_saves_and_costs(tmp_path):
+    sc = ckpt.SnapshotCheckpointer(tmp_path / "c", _state(), every=2,
+                                   mode="stall", backend="npz")
+    for step in range(6):
+        sc.on_step(step)
+    assert sc.saves == 3  # steps 1, 3, 5
+    assert sc.last_saved_step == 5
+    assert len(sc.checkpoint_ms) == 3
+    assert sc.state_bytes == 64 * 4 + 16 * 4  # f32 leaves
+    stats = sc.stats()
+    assert stats["checkpoint_saves"] == 3
+    assert stats["checkpoint_backend"] == "npz"
+    assert stats["checkpoint_ms"] > 0
+    # stall mode: the in-window cost IS the whole save
+    assert stats["checkpoint_stall_ms"] >= stats["checkpoint_ms"] * 0.5
+
+
+def test_snapshot_async_completion_gates_lost_work(tmp_path):
+    """last_saved_step advances only when the durable write COMPLETES —
+    lost_steps computed before the drain must not credit an in-flight
+    save."""
+    sc = ckpt.SnapshotCheckpointer(tmp_path / "c", _state(), every=1,
+                                   mode="async", backend="npz")
+    for step in range(4):
+        sc.on_step(step)
+    sc.wait()
+    assert sc.last_saved_step == 3
+    # steps 0..5 completed when step 6 failed; last save covered step 3
+    assert sc.lost_steps(6) == 2
+    # a failure right after the covered step loses nothing
+    assert sc.lost_steps(4) == 0
+    # restore-from-latest round-trips
+    restored, step = ckpt.restore_checkpoint(tmp_path / "c", _state())
+    assert step == 3
+
+
+def test_snapshot_lost_steps_without_any_save(tmp_path):
+    sc = ckpt.SnapshotCheckpointer(tmp_path / "c", _state(), every=8,
+                                   mode="stall", backend="npz")
+    assert sc.last_saved_step is None
+    assert sc.lost_steps(5) == 5  # everything since the start is redone
+
+
+def test_snapshot_drain_save_respects_grace_budget(tmp_path):
+    """save_now refuses when the measured median save cost does not fit
+    the grace window (a torn final save is worse than the last good
+    periodic one), and saves when it does."""
+    sc = ckpt.SnapshotCheckpointer(tmp_path / "c", _state(), every=1,
+                                   mode="stall", backend="npz")
+    sc.on_step(0)  # calibrate: one measured save
+    assert not sc.save_now(3, budget_us=0.001)  # 1 ns: nothing fits
+    assert sc.last_saved_step == 0
+    assert sc.save_now(3, budget_us=60_000_000.0)  # 60 s: plenty
+    assert sc.last_saved_step == 3
+
+
+def test_latest_step_refuses_unreadable_orbax_layout(tmp_path,
+                                                     monkeypatch):
+    """An orbax-layout directory read on a box without orbax must NOT
+    masquerade as checkpoint-free — a resume would silently restart
+    from step 0 over real saves.  An empty directory stays an honest
+    None."""
+    d = tmp_path / "c"
+    (d / "3").mkdir(parents=True)
+
+    def no_orbax(*a, **k):
+        raise ImportError("no orbax")
+
+    monkeypatch.setattr(ckpt, "_manager", no_orbax)
+    with pytest.raises(RuntimeError, match="orbax-layout"):
+        ckpt.latest_step(d)
+    e = tmp_path / "empty"
+    e.mkdir()
+    assert ckpt.latest_step(e) is None
+
+
+def test_snapshot_drain_save_attempts_when_uncalibrated(tmp_path):
+    """With no completed save to price from, the drain attempts anyway
+    — refusing would waste the grace window exactly when everything
+    since start is at stake — and lands when the realized cost fits."""
+    sc = ckpt.SnapshotCheckpointer(tmp_path / "c", _state(), every=8,
+                                   mode="stall", backend="npz")
+    assert sc.last_saved_step is None
+    assert sc.save_now(3, budget_us=60_000_000.0)
+    assert sc.last_saved_step == 3
+
+
+def test_snapshot_drain_save_cut_off_rolls_back(tmp_path):
+    """A drain whose REALIZED cost overran the grace window was cut off
+    by the eviction: atomic publication means the torn write never
+    became a checkpoint, so it is unpublished and the last-saved
+    pointer (and restore-from-latest) fall back to the previous save."""
+    sc = ckpt.SnapshotCheckpointer(tmp_path / "c", _state(), every=1,
+                                   mode="stall", backend="npz")
+    # uncalibrated, 1 ns window: attempted, overran, rolled back to none
+    assert not sc.save_now(3, budget_us=0.001)
+    assert sc.last_saved_step is None
+    assert ckpt.latest_step(tmp_path / "c") is None
+    # with a prior periodic save: the cut-off drain falls back to it
+    sc.on_step(0)
+    assert sc.last_saved_step == 0
+    sc.checkpoint_ms.clear()  # force the attempt past the up-front gate
+    assert not sc.save_now(5, budget_us=0.001)
+    assert sc.last_saved_step == 0
+    assert ckpt.latest_step(tmp_path / "c") == 0
+
+
+def test_snapshot_rejects_bad_config(tmp_path):
+    with pytest.raises(ValueError, match="interval"):
+        ckpt.SnapshotCheckpointer(tmp_path, _state(), every=0)
+    with pytest.raises(ValueError, match="mode"):
+        ckpt.SnapshotCheckpointer(tmp_path, _state(), every=1,
+                                  mode="lazy")

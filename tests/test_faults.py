@@ -251,6 +251,152 @@ def test_shrink_without_rebuild_or_bad_trigger_rejected(proxy_cfg):
         run_faulted("dp", FakeBundle(), cfg2, plan2, rebuild=lambda s: s)
 
 
+def test_preempt_rejoin_plan_validation_and_queries():
+    """The elastic schema (ISSUE 7): preempt needs explicit ranks and
+    policy shrink; rejoin must follow its preempt; the eviction-window
+    queries and the fault window close at the rejoin."""
+    with pytest.raises(ValueError, match="ranks"):
+        FaultPlan(events=[FaultEvent(kind="preempt", iteration=3)],
+                  policy="shrink").validate()
+    with pytest.raises(ValueError, match="shrink"):
+        FaultPlan(events=[FaultEvent(kind="preempt", ranks=[1],
+                                     iteration=3)]).validate()
+    with pytest.raises(ValueError, match="nobody left"):
+        FaultPlan(events=[FaultEvent(kind="rejoin", ranks=[1],
+                                     iteration=5)],
+                  policy="shrink").validate()
+    with pytest.raises(ValueError, match="does not follow"):
+        FaultPlan(events=[
+            FaultEvent(kind="preempt", ranks=[1], iteration=5),
+            FaultEvent(kind="rejoin", ranks=[1], iteration=4),
+        ], policy="shrink").validate()
+
+    plan = FaultPlan(events=[
+        FaultEvent(kind="preempt", ranks=[2], iteration=4,
+                   magnitude_us=20000.0),
+        FaultEvent(kind="rejoin", ranks=[2], iteration=8),
+    ], policy="shrink").validate()
+    assert plan.preempt_victims() == [2]
+    assert plan.first_preempt_iteration() == 4
+    assert plan.rejoin_iteration() == 8
+    assert not plan.evicted(2, 3)
+    assert plan.evicted(2, 4) and plan.evicted(2, 7)
+    assert not plan.evicted(2, 8)  # back in the world
+    assert not plan.evicted(0, 5)  # survivors were never out
+    # window closes at rejoin + 1: the rejoin step pays the grow
+    # re-split and must not pass as clean
+    assert plan.fault_window() == (4, 9)
+    # round-trips through the shared wire format
+    assert FaultPlan.loads(plan.dumps()).to_dict() == plan.to_dict()
+    # the segmented python tier needs a degraded step between the two
+    with pytest.raises(ValueError, match="preempt \\+ 2"):
+        FaultPlan(events=[
+            FaultEvent(kind="preempt", ranks=[2], iteration=4),
+            FaultEvent(kind="rejoin", ranks=[2], iteration=5),
+        ], policy="shrink").validate().check_config(
+            ProxyConfigStub())
+
+
+class ProxyConfigStub:
+    warmup = 1
+    runs = 8
+    reps_per_fence = 1
+    min_exectime_s = 0.0
+
+
+def test_preempt_restore_rejoin_end_to_end(eight_devices, proxy_cfg,
+                                           tmp_path):
+    """The acceptance arc on the python tier: preempt -> grace-window
+    drain -> restore-from-latest -> shrink -> rejoin restores the FULL
+    world (degraded_world cleared), with checkpoint costs, lost work,
+    and goodput stamped — and the record parses clean."""
+    import dataclasses
+
+    from dlnetbench_tpu.faults.policy import CheckpointPolicy, run_faulted
+    from dlnetbench_tpu.metrics.emit import result_to_record
+    from dlnetbench_tpu.metrics.parser import records_to_dataframe, \
+        validate_record
+
+    cfg = dataclasses.replace(proxy_cfg, runs=8)
+    plan = FaultPlan(events=[
+        FaultEvent(kind="preempt", ranks=[2], iteration=4,
+                   magnitude_us=50000.0),
+        FaultEvent(kind="rejoin", ranks=[2], iteration=7),
+    ], policy="shrink").validate()
+    bundle = _dp_bundle(cfg, eight_devices)
+
+    def rebuild(ranks):
+        return _dp_bundle(cfg, [eight_devices[i] for i in ranks])
+
+    res = run_faulted("dp", bundle, cfg, plan, rebuild=rebuild,
+                      checkpoint=CheckpointPolicy(
+                          dir=tmp_path / "ck", every=2, mode="stall",
+                          backend="npz"))
+    g = res.global_meta
+    # the world grew back: NO degraded_world, full rank coverage
+    assert "degraded_world" not in g
+    assert g["fault_rejoin_step"] == 7
+    assert g["rejoin_ms"] > 0
+    assert g["world_size"] == 8
+    # checkpoint accounting: periodic saves happened, the eviction
+    # restored from the latest, and the redone work is priced
+    assert g["checkpoint_saves"] >= 1
+    assert g["checkpoint_ms"] > 0 and g["checkpoint_stall_ms"] > 0
+    assert g["checkpoint_backend"] == "npz"
+    assert g["restore_ms"] > 0
+    assert 0 <= g["lost_steps"] < cfg.runs
+    assert g["goodput"] > 0
+    assert g["goodput_useful_steps"] == cfg.runs - g["lost_steps"]
+    assert g["detection_ms"] >= 0 and g["recovery_ms"] > 0
+    assert res.num_runs == cfg.runs
+
+    rec = result_to_record(res)
+    validate_record(rec)
+    assert [row["rank"] for row in rec["ranks"]] == list(range(8))
+    df = records_to_dataframe([rec])
+    assert len(df) == 8 * cfg.runs
+
+
+def test_preempt_without_rejoin_stays_degraded(eight_devices, proxy_cfg):
+    """A plan that never grows back degrades to the end like shrink —
+    degraded_world keeps the survivor set."""
+    from dlnetbench_tpu.faults.policy import run_faulted
+
+    plan = FaultPlan(events=[
+        FaultEvent(kind="preempt", ranks=[2], iteration=3,
+                   magnitude_us=1000.0),
+    ], policy="shrink").validate()
+    bundle = _dp_bundle(proxy_cfg, eight_devices)
+
+    def rebuild(ranks):
+        return _dp_bundle(proxy_cfg, [eight_devices[i] for i in ranks])
+
+    res = run_faulted("dp", bundle, proxy_cfg, plan, rebuild=rebuild)
+    g = res.global_meta
+    assert g["degraded_world"] == [0, 1, 3, 4, 5, 6, 7]
+    assert "fault_rejoin_step" not in g
+    assert g["goodput"] > 0  # the arc still yields its bottom line
+
+
+def test_checkpoint_policy_requires_declared_state(eight_devices,
+                                                   proxy_cfg, tmp_path):
+    """A bundle without StepBundle.state cannot honestly price
+    checkpointing — refused up front, never priced at zero bytes."""
+    import dataclasses
+
+    from dlnetbench_tpu.faults.policy import CheckpointPolicy, run_faulted
+
+    plan = FaultPlan(events=[FaultEvent(kind="crash", ranks=[2],
+                                        iteration=3)],
+                     policy="shrink").validate()
+    bundle = dataclasses.replace(_dp_bundle(proxy_cfg, eight_devices),
+                                 state=None)
+    with pytest.raises(ValueError, match="checkpointable state"):
+        run_faulted("dp", bundle, proxy_cfg, plan,
+                    rebuild=lambda s: s,
+                    checkpoint=CheckpointPolicy(dir=tmp_path / "ck"))
+
+
 def test_parallel_stragglers_gate_on_max_not_sum():
     """Delays on DIFFERENT ranks run in parallel: the per-step injected
     figure (amplification denominator) is the max over target ranks,
@@ -354,6 +500,37 @@ def test_bandwidth_suppresses_faulted_runs_and_reports_amplification():
     bw2 = bandwidth_summary([crash])
     assert (bw2["detection_ms"].dropna() == 5.0).all()
     assert (bw2["recovery_ms"].dropna() == 7.0).all()
+
+
+def test_bandwidth_elastic_recovery_columns():
+    """checkpoint_ms / restore_ms / lost_steps / goodput ride every
+    bandwidth row of a record that measured them, NaN otherwise; the
+    preempt window's runs still get busbw refused."""
+    from dlnetbench_tpu.analysis.bandwidth import bandwidth_summary, \
+        effective_bandwidth
+
+    rec = _faulted_record(checkpoint_ms=12.5, restore_ms=3.25,
+                          lost_steps=2, goodput=6.125)
+    rec["global"]["fault_plan"] = {
+        "policy": "shrink",
+        "events": [{"kind": "preempt", "ranks": [1], "iteration": 3,
+                    "magnitude_us": 20000.0},
+                   {"kind": "rejoin", "ranks": [1], "iteration": 5}]}
+    bw = effective_bandwidth([rec])
+    for col, want in (("checkpoint_ms", 12.5), ("restore_ms", 3.25),
+                      ("lost_steps", 2.0), ("goodput", 6.125)):
+        assert (bw[col] == want).all()
+    # warmup 1: plan steps 3..5 (+1 for the rejoin step) = runs 2..4
+    faulted = bw[bw["bound"] == "faulted"]
+    assert sorted(faulted["run"].unique()) == [2, 3]
+    assert faulted["busbw_GBps"].isna().all()
+    summary = bandwidth_summary([rec])
+    assert (summary["goodput"].dropna() == 6.125).all()
+
+    clean = _faulted_record()
+    bw2 = effective_bandwidth([clean])
+    for col in ("checkpoint_ms", "restore_ms", "lost_steps", "goodput"):
+        assert bw2[col].isna().all()
 
 
 def test_clean_records_unaffected_by_fault_columns():

@@ -1,0 +1,175 @@
+"""Checkpoint-interval planning (analysis/goodput.py): the Daly
+approximation, the exponential efficiency model, the cost fit over
+sweep records, and the acceptance verdict against the committed
+elastic-study artifact."""
+from __future__ import annotations
+
+import math
+from pathlib import Path
+
+import pytest
+
+from dlnetbench_tpu.analysis import goodput as gp
+
+
+# ------------------------------------------------------------ the model
+def test_daly_matches_young_at_small_overhead():
+    """For d << M the higher-order terms vanish: Daly converges to
+    Young's sqrt(2dM)."""
+    d, M = 0.001, 1000.0
+    assert gp.daly_interval_s(d, M) == pytest.approx(
+        math.sqrt(2 * d * M), rel=1e-2)
+
+
+def test_daly_degenerate_inputs():
+    # free saves: eff(tau) is strictly decreasing in tau when d = 0 —
+    # saving constantly loses nothing, so the optimum is "save always"
+    # (NOT inf: a zero-cost corner of the prediction band must not
+    # widen the band to the sparse edge and accept a wrong optimum)
+    assert gp.daly_interval_s(0.0, 100.0) == 0.0
+    assert gp.daly_interval_s(1.0, 0.0) == 0.0         # constant failure
+    # d >= 2M: the approximation's validity edge — checkpoint once per
+    # MTBF, never longer
+    assert gp.daly_interval_s(10.0, 1.0) == 1.0
+    # "save never" emerges only from no failures (M -> inf)
+    assert gp.daly_interval_s(1.0, math.inf) == math.inf
+
+
+def test_daly_is_the_efficiency_argmax():
+    """The approximation must sit at (near) the exact exponential
+    model's argmax — that is its whole claim."""
+    d, M, R = 0.05, 10.0, 0.5
+    tau_opt = gp.daly_interval_s(d, M)
+    e_opt = gp.efficiency(tau_opt, d, M, R)
+    for factor in (0.5, 0.8, 1.25, 2.0):
+        assert gp.efficiency(tau_opt * factor, d, M, R) <= e_opt + 1e-9
+
+
+def test_efficiency_monotone_in_costs():
+    assert gp.efficiency(1.0, 0.1, 10.0) > gp.efficiency(1.0, 0.5, 10.0)
+    assert gp.efficiency(1.0, 0.1, 10.0) > gp.efficiency(1.0, 0.1, 5.0)
+    assert gp.efficiency(1.0, 0.1, 10.0, 0.0) > \
+        gp.efficiency(1.0, 0.1, 10.0, 2.0)
+    assert gp.efficiency(0.0, 0.1, 10.0) == 0.0
+
+
+# ------------------------------------------------------- record fitting
+def _sweep_record(every: int, *, goodput: float, stall_ms: float = 10.0,
+                  preempt_at: int = 8, step_us: float = 20000.0) -> dict:
+    return {
+        "section": "dp", "version": 2, "process": 0,
+        "global": {"proxy": "dp", "world_size": 8,
+                   "checkpoint_every": every,
+                   "checkpoint_stall_ms": stall_ms,
+                   "checkpoint_ms": stall_ms,
+                   "restore_ms": 5.0, "detection_ms": 1.0,
+                   "recovery_ms": 100.0, "lost_steps": every // 2,
+                   "goodput": goodput, "fault_iteration": preempt_at},
+        "mesh": {"platform": "cpu"},
+        "num_runs": 8,
+        "warmup_times": [1.0],
+        "ranks": [{"rank": 0, "device_id": 0, "process_index": 0,
+                   "hostname": "h", "runtimes": [step_us] * 8}],
+    }
+
+
+def _sweep(goodputs: dict[int, list[float]], **kw) -> list[dict]:
+    return [_sweep_record(e, goodput=v, **kw)
+            for e, vals in goodputs.items() for v in vals]
+
+
+def test_fit_costs_reads_the_measured_fields():
+    recs = _sweep({1: [4.0, 4.2], 8: [6.0, 6.1]})
+    m = gp.fit_costs(recs)
+    # step time from the SPARSEST records' pooled median (20 ms here)
+    assert m.step_s == pytest.approx(0.02)
+    assert m.ckpt_s == pytest.approx(0.010)
+    assert m.restart_s == pytest.approx(0.106)
+    # MTBF: preempt trigger 8 x 20 ms = 160 ms per draw
+    assert m.mtbf_s == pytest.approx(0.16)
+    assert m.n_records == 4
+
+
+def test_fit_costs_refuses_unswept_records():
+    with pytest.raises(ValueError, match="goodput"):
+        gp.fit_costs([{"global": {}, "ranks": []}])
+
+
+def test_validate_sweep_in_band_and_outside():
+    """A sweep whose measured optimum matches the model's band passes;
+    moving the measured peak far outside fails — the verdict is a real
+    tripwire, not a formality."""
+    # d=10 ms, M ~ 160 ms -> tau_opt ~ sqrt(2*.01*.16) ~ 56.6 ms ~ 2.8
+    # steps at 20 ms/step: the band straddles {2, 4}
+    good = _sweep({1: [4.0, 4.1], 2: [7.0, 7.1],
+                   4: [6.9, 7.05], 8: [5.0, 5.1]})
+    v = gp.validate_sweep(good)
+    assert v["measured_opt_every"] == 2
+    assert 4 in v["candidate_optima"]  # overlapping band
+    assert v["in_band"] is True
+    assert set(v["predicted_rel"]) == {1, 2, 4, 8}
+    assert max(v["predicted_rel"].values()) == 1.0
+
+    # same costs, but the measured curve peaks hard at every=1 with
+    # bands DISJOINT from everything the model predicts
+    bad = _sweep({1: [20.0, 20.1], 2: [7.0, 7.1],
+                  4: [6.0, 6.1], 8: [5.0, 5.1]})
+    v2 = gp.validate_sweep(bad)
+    assert v2["measured_opt_every"] == 1
+    assert v2["candidate_optima"] == [1]
+    assert v2["in_band"] is False
+
+
+def test_band_snap_widens_to_grid_resolution():
+    assert gp._snap_band_to_grid((2.5, 3.5), [1, 2, 4, 8]) == (2, 4)
+    assert gp._snap_band_to_grid((0.2, 0.4), [1, 2, 4, 8]) == (1, 1)
+    assert gp._snap_band_to_grid((9.0, 20.0), [1, 2, 4, 8]) == (8, 8)
+    assert gp._snap_band_to_grid((1.0, 8.0), [1, 2, 4, 8]) == (1, 8)
+
+
+# -------------------------------------------- the committed artifact
+STUDY = Path(__file__).resolve().parent.parent / "docs" / "studies" / \
+    "elastic_study_r10" / "records.jsonl"
+
+
+def test_committed_elastic_study_verdict_holds():
+    """The acceptance criterion, re-derived from the committed artifact
+    on every test run: the measured goodput-vs-interval optimum falls
+    inside the Daly prediction band, and every sweep record carries
+    the four elastic fields."""
+    from dlnetbench_tpu.metrics.parser import load_records
+
+    recs = load_records(STUDY)
+    sweep = [r for r in recs
+             if r["global"].get("checkpoint_every") is not None]
+    assert len(sweep) == 12  # 4 intervals x 3 seeds
+    for r in sweep:
+        g = r["global"]
+        for field in ("checkpoint_ms", "restore_ms", "lost_steps",
+                      "goodput"):
+            assert isinstance(g.get(field), (int, float)), field
+        assert "degraded_world" not in g  # every run rejoined
+        assert g["fault_rejoin_step"] > g["fault_iteration"]
+    v = gp.validate_sweep(recs)
+    assert v["in_band"] is True
+    assert v["model"]["n_records"] == 12
+
+    # the native preempt+rejoin point also ended full-world
+    native = [r for r in recs
+              if r["global"].get("fault_rejoin_step") is not None
+              and r["global"].get("checkpoint_every") is None]
+    assert len(native) == 1
+    assert [row["rank"] for row in native[0]["ranks"]] == [0, 1, 2]
+    assert native[0]["global"]["rejoin_ms"] > 0
+
+
+def test_report_cli_renders_and_exits_by_verdict(tmp_path, capsys):
+    assert gp.main(["report", str(STUDY)]) == 0
+    out = capsys.readouterr().out
+    assert "Daly optimum" in out and "INSIDE" in out
+    # no sweep records -> exit 2, not a stack trace
+    empty = tmp_path / "none.jsonl"
+    empty.write_text('{"section": "dp", "version": 2, "process": 0, '
+                     '"global": {}, "mesh": {}, "num_runs": 1, '
+                     '"warmup_times": [], "ranks": []}\n')
+    assert gp.main(["report", str(empty)]) == 2

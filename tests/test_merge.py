@@ -328,6 +328,103 @@ def test_merge_degraded_missing_survivor_still_caught():
         merge_records(recs)
 
 
+def _rejoin_proc_record(proc: int, runs: int = 6) -> dict:
+    """A per-process record of a preempt->rejoin run: every rank emits
+    (the evictee drained locally, nobody died), degraded_world is
+    CLEARED, and the plan-derived rejoin trigger must agree."""
+    return {
+        "section": "dp", "version": 2, "process": proc,
+        "global": {"proxy": "dp", "model": "m", "world_size": 3,
+                   "num_processes": 3,
+                   "fault_plan": {"policy": "shrink", "events": [
+                       {"kind": "preempt", "ranks": [1], "iteration": 2,
+                        "magnitude_us": 20000.0},
+                       {"kind": "rejoin", "ranks": [1], "iteration": 4}]},
+                   "fault_policy": "shrink",
+                   "fault_rejoin_step": 4,
+                   # per-process clocks: volatile, never a mismatch
+                   "rejoin_ms": 10.0 + proc,
+                   "checkpoint_ms": 5.0 + proc,
+                   "restore_ms": 2.0 + proc,
+                   "lost_steps": proc,
+                   "goodput": 6.0 + proc},
+        "mesh": {"platform": "tcp", "device_kind": "process-rank"},
+        "num_runs": runs,
+        "warmup_times": [10.0 + proc],
+        "ranks": [{"rank": proc, "device_id": proc, "process_index": proc,
+                   "hostname": f"host{proc}",
+                   "runtimes": [100.0 + proc] * runs}],
+    }
+
+
+def test_merge_rejoined_run_requires_full_coverage():
+    """After a rejoin the world is FULL again: every rank's record is
+    required (no degraded relaxation — the evictee is alive and
+    emits), the per-process elastic measurements merge as volatile,
+    and the plan-derived rejoin trigger must match."""
+    recs = [_rejoin_proc_record(p) for p in range(3)]
+    merged = merge_records(recs)
+    assert [r["rank"] for r in merged["ranks"]] == [0, 1, 2]
+    assert "degraded_world" not in merged["global"]
+    assert merged["global"]["fault_rejoin_step"] == 4
+    # volatile per-process measurements: anchor process's values kept
+    assert merged["global"]["rejoin_ms"] == 10.0
+    assert merged["global"]["goodput"] == 6.0
+    validate_record(merged)
+    df = records_to_dataframe([merged])
+    assert len(df) == 3 * 6
+
+    # a missing rank is NOT tolerated — the rejoined record declares no
+    # degraded_world, so full coverage is enforced
+    with pytest.raises(ValueError, match="missing|rank set"):
+        validate_record(merge_records(
+            [_rejoin_proc_record(p) for p in (0, 2)]))
+
+
+def test_merge_rejects_mismatched_rejoin_trigger():
+    """fault_rejoin_step is PLAN-derived, not a per-process clock: two
+    processes disagreeing about when the world grew back are different
+    runs and must refuse to merge."""
+    recs = [_rejoin_proc_record(p) for p in range(3)]
+    recs[2]["global"]["fault_rejoin_step"] = 5
+    with pytest.raises(ValueError, match="fault_rejoin_step"):
+        merge_records(recs)
+
+
+def test_rejoin_fixture_roundtrip():
+    """Committed elastic artifact (a REAL merged dp-over-tcp
+    preempt->rejoin run: rank 1 evicted at step 5 with a 20 ms grace
+    drain, back at step 9): coverage is degraded mid-run — the fault
+    window says so — yet the record ends FULL world: all three ranks
+    emit, degraded_world is cleared, and rejoin_ms prices the grow."""
+    from pathlib import Path
+
+    from dlnetbench_tpu.faults.plan import FaultPlan
+    from dlnetbench_tpu.metrics.parser import load_records
+
+    fixture = Path(__file__).parent / "data" / "record_rejoin.jsonl"
+    recs = load_records(fixture)
+    assert len(recs) == 1
+    rec = recs[0]
+    validate_record(rec)
+    g = rec["global"]
+    assert "degraded_world" not in g
+    assert g["fault_policy"] == "shrink"
+    assert {e["kind"] for e in g["fault_plan"]["events"]} == \
+        {"preempt", "rejoin"}
+    assert g["fault_rejoin_step"] == 9
+    assert g["rejoin_ms"] > 0
+    assert [r["rank"] for r in rec["ranks"]] == [0, 1, 2]
+    df = records_to_dataframe(recs)
+    assert len(df) == 3 * rec["num_runs"]
+    assert (df["runtime"] > 0).all()
+    # the plan parses through the shared schema and the eviction window
+    # is visible mid-run: rank 1 out from its preempt to its rejoin
+    plan = FaultPlan.from_dict(g["fault_plan"]).validate()
+    assert plan.evicted(1, plan.first_preempt_iteration())
+    assert not plan.evicted(1, g["fault_rejoin_step"])
+
+
 def test_faulted_fixture_roundtrip():
     """Committed degraded artifact (a REAL merged dp-over-tcp shrink
     run: crash of rank 1 at iteration 4, survivors finished): parses,

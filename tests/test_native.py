@@ -1121,3 +1121,34 @@ def test_native_tsan_fabrics(tmp_path):
             f"tcp shrink survivor {r} under tsan:\n{outs[r]}"
     for out_text in outs:
         assert "ThreadSanitizer" not in out_text, out_text
+
+    # preempt + rejoin (ISSUE 7 grow path): the evictee's drained
+    # singleton replay runs CONCURRENTLY with the survivors' degraded
+    # window, then everyone live-switches onto the pre-built full-world
+    # comm at the rejoin trigger — the thread-heaviest elastic
+    # transition (three communicators active across one run).  shm
+    # races rank threads in one process; tcp adds reader threads and
+    # the returning rank's cross-process rendezvous.
+    rejoin = ('{"policy":"shrink","events":['
+              '{"kind":"preempt","ranks":[1],"iteration":3,'
+              '"magnitude_us":5000},'
+              '{"kind":"rejoin","ranks":[1],"iteration":7}]}')
+    out = subprocess.run(
+        [str(build / "bin" / "fault_selftest"), "--world", "4",
+         "--iters", "10", "--fault", rejoin, "--fault_policy", "shrink"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, f"shm rejoin under tsan:\n{out.stdout}"
+    assert "ThreadSanitizer" not in out.stdout + out.stderr, out.stdout
+    procs, outs = _spawn_ranks_with_port_retry(
+        lambda r, port: ([str(build / "bin" / "fault_selftest"),
+                          "--backend", "tcp", "--world", "3",
+                          "--rank", str(r),
+                          "--coordinator", f"127.0.0.1:{port}",
+                          "--iters", "10", "--fault", rejoin,
+                          "--fault_policy", "shrink"], None),
+        3, timeout=300)
+    for r in range(3):  # nobody dies on the elastic arc
+        assert procs[r].returncode == 0, \
+            f"tcp rejoin rank {r} under tsan:\n{outs[r]}"
+    for out_text in outs:
+        assert "ThreadSanitizer" not in out_text, out_text

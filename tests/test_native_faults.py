@@ -11,6 +11,7 @@ opt-in ``-m native_slow`` lane, and the crash paths also run under TSan
 from __future__ import annotations
 
 import json
+import os
 import shutil
 import subprocess
 from pathlib import Path
@@ -20,8 +21,10 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 
 pytestmark = pytest.mark.skipif(
-    shutil.which("cmake") is None or shutil.which("ninja") is None,
-    reason="cmake/ninja not available")
+    not os.environ.get("DLNB_NATIVE_BIN")
+    and (shutil.which("cmake") is None or shutil.which("ninja") is None),
+    reason="cmake/ninja not available (set DLNB_NATIVE_BIN to a "
+           "prebuilt bin dir to run anyway)")
 
 # every survivor must RAISE within this budget, never hang — the
 # watchdog-style bound satellite 1 asserts on the provoked death path
@@ -32,6 +35,13 @@ DELAY_PLAN = ('{"events":[{"kind":"delay","ranks":[2],"iteration":3,'
               '"magnitude_us":30000}]}')
 DROP_PLAN = ('{"events":[{"kind":"drop","ranks":[0],"iteration":0,'
              '"rate":0.2,"magnitude_us":200,"seed":42}]}')
+REJOIN_PLAN = ('{"policy":"shrink","events":['
+               '{"kind":"preempt","ranks":[1],"iteration":3,'
+               '"magnitude_us":5000},'
+               '{"kind":"rejoin","ranks":[1],"iteration":7}]}')
+PREEMPT_ONLY_PLAN = ('{"policy":"shrink","events":['
+                     '{"kind":"preempt","ranks":[1],"iteration":3,'
+                     '"magnitude_us":5000}]}')
 
 
 def _free_port():
@@ -83,6 +93,33 @@ def test_shm_crash_shrink_survivors_finish(native_bin):
         assert r["shrunk"] is True
         assert r["degraded_world"] == [0, 1, 3]
         assert r["detection_us"] > 0 and r["recovery_us"] > 0
+
+
+def test_shm_preempt_rejoin_restores_full_world(native_bin):
+    """The grow half (ISSUE 7 tentpole) on the threaded fabric: the
+    evictee drains its grace window and replays locally, survivors run
+    the degraded window on the pre-split comm, and at the rejoin
+    trigger EVERY rank re-splits onto the pre-built full-world comm —
+    exact full-world sums again, rejoin cost measured, nobody dies."""
+    out = subprocess.run(
+        [str(native_bin / "fault_selftest"), "--world", "4", "--iters",
+         "10", "--fault", REJOIN_PLAN, "--fault_policy", "shrink"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rows = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    # ALL ranks emit (the evictee never died) and all rejoined
+    assert [r["rank"] for r in rows] == [0, 1, 2, 3]
+    for r in rows:
+        assert r["checks"] == "OK" and r["iters_done"] == 10
+        assert r["rejoined"] is True
+        assert r["rejoin_us"] > 0
+        assert r["shrunk"] is False  # grow, not shrink: nobody crashed
+        assert r["degraded_world"] == [0, 1, 2, 3]  # full again
+    by_rank = {r["rank"]: r for r in rows}
+    # the evictee slept its grace window; the others did not
+    assert by_rank[1]["injected_delay_us"] >= 5000
+    assert by_rank[0]["injected_delay_us"] == 0.0
 
 
 def test_shm_crash_fail_fast_aborts_not_hangs(native_bin):
@@ -322,6 +359,105 @@ def test_dp_tcp_crash_shrink_merge_degraded(native_bin, tmp_path):
     df = records_to_dataframe([merged])
     assert len(df) == 2 * merged["num_runs"]
     assert (df["runtime"] > 0).all()
+
+
+@pytest.mark.slow
+@pytest.mark.native_slow
+def test_tcp_preempt_rejoin_all_ranks_finish(native_bin):
+    """The grow half across OS processes: the returning rank is
+    accepted deterministically on the plan-known fresh comm — all
+    three processes finish with exact sums and measured rejoin cost."""
+    port = _free_port()
+    procs = [_spawn_tcp(native_bin, "fault_selftest", 3, r, port,
+                        "--iters", 10, "--fault", REJOIN_PLAN,
+                        "--fault_policy", "shrink")
+             for r in range(3)]
+    outs = _communicate_all(procs, timeout=60)
+    for r in range(3):
+        assert procs[r].returncode == 0, f"rank {r}:\n{outs[r]}"
+        row = json.loads([ln for ln in outs[r].splitlines()
+                          if ln.startswith("{")][0])
+        assert row["rejoined"] is True and row["rejoin_us"] > 0
+        assert row["iters_done"] == 10 and row["checks"] == "OK"
+
+
+@pytest.mark.slow
+@pytest.mark.native_slow
+def test_dp_tcp_preempt_rejoin_record_full_world(native_bin, tmp_path):
+    """The native-tier end-to-end rejoin acceptance: dp under a
+    preempt->rejoin plan — ALL processes emit records (the evictee
+    drained, nobody died), the merged record CLEARS degraded_world,
+    stamps fault_rejoin_step + rejoin_ms, and parses with full rank
+    coverage."""
+    from dlnetbench_tpu.metrics.merge import merge_files
+    from dlnetbench_tpu.metrics.parser import records_to_dataframe, \
+        validate_record
+
+    port = _free_port()
+    world = 3
+    outs_p = [tmp_path / f"p{r}.jsonl" for r in range(world)]
+    procs = [subprocess.Popen(
+        [str(native_bin / "dp"), "--model", "gpt2_l_16_bfloat16",
+         "--world", str(world), "--backend", "tcp", "--rank", str(r),
+         "--coordinator", f"127.0.0.1:{port}", "--num_buckets", "2",
+         "--time_scale", "0.001", "--size_scale", "0.0001",
+         "--runs", "10", "--warmup", "1", "--no_topology",
+         "--base_path", str(REPO), "--fault", REJOIN_PLAN,
+         "--fault_policy", "shrink", "--out", str(outs_p[r])],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(world)]
+    texts = _communicate_all(procs, timeout=120)
+    for r in range(world):
+        assert procs[r].returncode == 0, f"process {r}:\n{texts[r]}"
+        assert outs_p[r].exists()  # the evictee emits too
+
+    merged = merge_files(tmp_path / "merged.jsonl", outs_p)
+    validate_record(merged)
+    assert [row["rank"] for row in merged["ranks"]] == [0, 1, 2]
+    g = merged["global"]
+    assert "degraded_world" not in g          # the world grew back
+    assert g["fault_rejoin_step"] == 7
+    assert g["rejoin_ms"] > 0
+    df = records_to_dataframe([merged])
+    assert len(df) == world * merged["num_runs"]
+    assert (df["runtime"] > 0).all()
+
+
+@pytest.mark.slow
+@pytest.mark.native_slow
+def test_dp_tcp_preempt_without_rejoin_record_degraded(native_bin,
+                                                      tmp_path):
+    """An eviction that never grows back mirrors the python tier's
+    record: the evictee drains out alive (exit 0) but emits NOTHING —
+    its post-eviction rows are local replay, not fabric work — and the
+    survivors declare degraded_world, so the merged record rides the
+    degraded pathway exactly like a shrink."""
+    from dlnetbench_tpu.metrics.merge import merge_files
+    from dlnetbench_tpu.metrics.parser import validate_record
+
+    port = _free_port()
+    world = 3
+    outs_p = [tmp_path / f"p{r}.jsonl" for r in range(world)]
+    procs = [subprocess.Popen(
+        [str(native_bin / "dp"), "--model", "gpt2_l_16_bfloat16",
+         "--world", str(world), "--backend", "tcp", "--rank", str(r),
+         "--coordinator", f"127.0.0.1:{port}", "--num_buckets", "2",
+         "--time_scale", "0.001", "--size_scale", "0.0001",
+         "--runs", "8", "--warmup", "1", "--no_topology",
+         "--base_path", str(REPO), "--fault", PREEMPT_ONLY_PLAN,
+         "--fault_policy", "shrink", "--out", str(outs_p[r])],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(world)]
+    texts = _communicate_all(procs, timeout=120)
+    for r in range(world):   # the evictee drained — nobody dies
+        assert procs[r].returncode == 0, f"process {r}:\n{texts[r]}"
+    assert not outs_p[1].exists()            # ...but it emits no record
+
+    merged = merge_files(tmp_path / "merged.jsonl",
+                         [outs_p[0], outs_p[2]])
+    validate_record(merged)
+    assert merged["global"]["degraded_world"] == [0, 2]
+    assert [row["rank"] for row in merged["ranks"]] == [0, 2]
 
 
 # ------------------------------------------------------------ hier lane

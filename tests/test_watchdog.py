@@ -118,6 +118,56 @@ def test_stall_dumps_active_span_stack(capsys):
     assert meta["watchdog_stall_spans"] == ["timed > fence"]
 
 
+def test_stall_message_and_record_carry_checkpoint_age(capsys):
+    """Satellite (ISSUE 7): a hang report should say how much work a
+    kill would lose — the stall message and the record stamp carry the
+    step and age of the last COMPLETED checkpoint save (wired by
+    utils/checkpoint.SnapshotCheckpointer.checkpoint_saved)."""
+    wd = StepWatchdog(0.05, name="step")
+    assert wd.last_checkpoint_age_s() is None
+    wd.checkpoint_saved(7)
+    with wd:
+        time.sleep(0.12)
+    err = capsys.readouterr().err
+    assert wd.stalls == 1
+    assert "last completed checkpoint: step 7" in err
+    assert "loses the work since" in err
+    meta = {}
+    wd.stamp(meta)
+    assert meta["last_checkpoint_step"] == 7
+    assert meta["last_checkpoint_age_s"] >= 0.12
+
+
+def test_record_stamp_without_checkpoint_has_no_age_keys():
+    wd = StepWatchdog(5.0, name="step")
+    meta = {}
+    wd.stamp(meta)
+    assert "last_checkpoint_age_s" not in meta
+    assert "last_checkpoint_step" not in meta
+
+
+def test_snapshot_checkpointer_wires_watchdog(tmp_path):
+    """The integration seam: a SnapshotCheckpointer given a watchdog
+    reports each COMPLETED save into it — async saves only after the
+    durable write lands."""
+    import jax.numpy as jnp
+
+    from dlnetbench_tpu.utils.checkpoint import SnapshotCheckpointer
+
+    wd = StepWatchdog(30.0, name="step")
+    sc = SnapshotCheckpointer(tmp_path / "c", {"w": jnp.ones((4,))},
+                              every=2, mode="async", backend="npz",
+                              watchdog=wd)
+    sc.on_step(0)  # no save yet (period 2)
+    assert wd.last_checkpoint_age_s() is None
+    sc.on_step(1)
+    sc.wait()
+    assert wd.last_checkpoint_age_s() is not None
+    meta = {}
+    wd.stamp(meta)
+    assert meta["last_checkpoint_step"] == 1
+
+
 def test_stall_without_tracing_has_no_span_noise(capsys):
     """Span tracing off (the default run mode): the stall message keeps
     its shape with no empty 'active spans' suffix and nothing stamped."""
