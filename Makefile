@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test check-bench check-resilience sentinel-scan
+.PHONY: test check-bench check-resilience check-serving sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -32,6 +32,19 @@ check-resilience:
 	    tests/test_goodput.py tests/test_merge.py
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_sentinel.py -q \
 	    -m sentinel
+
+# the serving lane (docs/SERVING.md): arrival-plan schema + fixtures,
+# the paged KV cache, decode-vs-forward parity, the continuous-batching
+# engine, fault composition (straggler p99 inflation, crash+shrink SLO
+# dip/recovery), the committed record fixture round-trip, and the
+# serving_decode bench-line schema + sentinel comparability.  The
+# heavyweight load sweeps stay in the slow lane.  ~1 min wall.
+check-serving:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m 'serving and not slow' \
+	    tests/test_serving.py
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
+	    tests/test_bench_aux.py::test_serving_decode_line_schema_locked \
+	    tests/test_sentinel.py::test_serving_latency_line_is_comparable
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

@@ -315,6 +315,58 @@ def effective_bandwidth(records: list[dict]):
     return pd.DataFrame(rows)
 
 
+def serving_summary(records: list[dict]):
+    """One row per SERVING record (serving/, record global ``serving``):
+    the latency-vs-offered-load table — offered/measured request rates,
+    tokens/s, TTFT/TPOT/e2e percentiles, goodput-at-SLO — with the same
+    provenance discipline as the bandwidth table: ``transport`` says
+    what moved the bytes, the fault columns (``straggler_amp`` via the
+    plan's declared delay against the e2e medians is NOT computable
+    here — serving latency is queue-coupled — so the plan's injected
+    delay and the recovery costs ride raw), and records without a
+    serving block contribute nothing.  Training records flow through
+    ``effective_bandwidth``/``bandwidth_summary`` unchanged; this is
+    the serving tier's summary in the same module so one analysis
+    import covers both."""
+    import pandas as pd
+
+    rows = []
+    for rec in records:
+        g = rec.get("global", {})
+        srv = g.get("serving")
+        if not isinstance(srv, dict):
+            continue
+        plan = g.get("fault_plan") or {}
+        kinds = "+".join(sorted({e.get("kind", "?")
+                                 for e in plan.get("events", [])}))
+        row = {
+            "section": rec.get("section"),
+            "model": g.get("model"),
+            "transport": transport_of(rec),
+            "world": len(rec.get("ranks", [])),
+            "offered_rps": srv.get("offered_rps"),
+            "measured_rps": srv.get("measured_rps"),
+            "completed": srv.get("completed"),
+            "tokens_per_s": srv.get("tokens_per_s"),
+            "goodput_rps": srv.get("goodput_rps"),
+            "goodput_frac": srv.get("goodput_frac"),
+            "queue_depth_max": srv.get("queue_depth_max"),
+            "batch_occupancy_mean": srv.get("batch_occupancy_mean"),
+            "fault": kinds or "-",
+            "detection_ms": float(g.get("detection_ms", float("nan"))),
+            "recovery_ms": float(g.get("recovery_ms", float("nan"))),
+            "injected_delay_us": float(
+                g.get("fault_injected_delay_us", float("nan"))),
+        }
+        for base in ("ttft_ms", "tpot_ms", "e2e_ms"):
+            pcts = srv.get(base) or {}
+            for p in ("p50", "p95", "p99"):
+                row[f"{base[:-3]}_{p}_ms"] = float(
+                    pcts.get(p, float("nan")))
+        rows.append(row)
+    return pd.DataFrame(rows)
+
+
 def bandwidth_summary(records: list[dict]):
     """Mean per (section, model, collective): the north-star table.
     Carries the ``bound`` marker so lower-bound rows stay labeled, the

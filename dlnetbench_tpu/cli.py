@@ -214,7 +214,14 @@ def main(argv: list[str] | None = None) -> int:
     p_uly.add_argument("--max_layers", type=int, default=0,
                        help="cap replayed layers (0 = full depth)")
 
+    _add_serve(sub.add_parser(
+        "serve", help="serving tier: paged-KV decode under continuous "
+                      "batching + an open-loop arrival plan "
+                      "(docs/SERVING.md)"))
+
     args = parser.parse_args(argv)
+    if args.proxy == "serve":
+        return _run_serve(args, parser)
     cfg = _cfg(args)
 
     if getattr(args, "max_layers", 0) < 0:
@@ -399,6 +406,139 @@ def _run_measured(args, parser, stats, cfg, devices, dtype, dtype_name,
                                 for k in ("compute", "hbm",
                                           "comm_exposed", "host"))
               + ")", file=sys.stderr)
+    return 0
+
+
+def _add_serve(p: argparse.ArgumentParser) -> None:
+    """The serving tier's own flag set (no stats file, no proxy grid —
+    the workload is an arrival plan over a decode-shaped model)."""
+    p.add_argument("--arrival", required=True, metavar="PLAN",
+                   help="JSON arrival plan (inline or @path; "
+                        "serving/arrivals.py schema): poisson/bursty/"
+                        "replay traffic with seeded splitmix64 draws — "
+                        "a committable artifact like a fault plan")
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode slots = max continuous batch")
+    p.add_argument("--page_size", type=int, default=8,
+                   help="tokens per KV page")
+    p.add_argument("--num_pages", type=int, default=128,
+                   help="physical KV pages shared by all slots")
+    p.add_argument("--max_seq_len", type=int, default=128,
+                   help="per-request cap (prompt + output); must be a "
+                        "multiple of --page_size")
+    p.add_argument("--prefill", default="separate",
+                   choices=["separate", "inline"],
+                   help="separate: drain the whole prompt at admit "
+                        "time; inline: one chunk per engine step, "
+                        "interleaved with decode")
+    p.add_argument("--prefill_chunk", type=int, default=16)
+    p.add_argument("--slo_ttft_ms", type=float, default=500.0)
+    p.add_argument("--slo_tpot_ms", type=float, default=200.0)
+    p.add_argument("--world", type=int, default=1,
+                   help="capacity ranks (the fault-shrink unit: a "
+                        "crashed rank takes slots/world decode slots "
+                        "down with it)")
+    p.add_argument("--kv_shard", type=int, default=1,
+                   help=">1: shard paged attention along GQA KV heads "
+                        "over this many devices via shard_map "
+                        "(SNIPPETS [3] recipe)")
+    p.add_argument("--attn_impl", default="auto",
+                   choices=["auto", "pallas", "gather"],
+                   help="decode attention path: Pallas paged_attention "
+                        "kernel (TPU) vs dense gather fallback; auto "
+                        "picks by backend")
+    # decode-model shape (tiny CPU-feasible defaults; a real study on
+    # chip raises these)
+    p.add_argument("--embed", type=int, default=64)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv_heads", type=int, default=2)
+    p.add_argument("--ff", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--seed", type=int, default=0,
+                   help="weight-init seed")
+    p.add_argument("--fault", default=None, metavar="PLAN",
+                   help="JSON fault plan (faults/plan.py schema) on the "
+                        "decode loop: delay/jitter sleep at engine-step "
+                        "boundaries inside the measured window; crash "
+                        "under policy shrink costs capacity and prices "
+                        "recovery (docs/SERVING.md, docs/RESILIENCE.md)")
+    p.add_argument("--fault_policy", default=None,
+                   choices=["fail_fast", "retry", "shrink"])
+    p.add_argument("--out", default=None,
+                   help="append the JSON record to a file")
+    p.add_argument("--tag", action="append", default=[],
+                   metavar="KEY=VALUE")
+    p.add_argument("--platform", default=None)
+
+
+def _run_serve(args, parser) -> int:
+    from dlnetbench_tpu.metrics.emit import scheduler_variables
+    variables = scheduler_variables()
+    for tag in args.tag:
+        key, sep, value = tag.partition("=")
+        if not sep or not key:
+            parser.error(f"--tag wants KEY=VALUE, got {tag!r}")
+        variables[key] = value
+
+    import os
+    platform = args.platform or os.environ.get("JAX_PLATFORMS") or None
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+    from dlnetbench_tpu.serving.arrivals import ArrivalPlan
+    from dlnetbench_tpu.serving.scheduler import (ServingConfig,
+                                                  run_serving)
+    try:
+        plan = ArrivalPlan.loads(args.arrival)
+    except (ValueError, OSError, KeyError) as e:
+        parser.error(f"--arrival: {e}")
+    fault_plan = None
+    if args.fault:
+        from dlnetbench_tpu.faults.plan import FaultPlan
+        try:
+            fault_plan = FaultPlan.loads(args.fault)
+            if args.fault_policy:
+                fault_plan.policy = args.fault_policy
+            fault_plan.validate()
+        except (ValueError, OSError, KeyError) as e:
+            parser.error(f"--fault: {e}")
+
+    from dlnetbench_tpu.models.transformer import TransformerConfig
+    model_cfg = TransformerConfig(
+        vocab_size=args.vocab, embed_dim=args.embed,
+        num_heads=args.heads, num_kv_heads=args.kv_heads,
+        ff_dim=args.ff, num_layers=args.layers,
+        seq_len=args.max_seq_len, gated=True, max_positions=0,
+        dtype=args.dtype)
+    srv_cfg = ServingConfig(
+        slots=args.slots, page_size=args.page_size,
+        num_pages=args.num_pages, max_seq_len=args.max_seq_len,
+        prefill=args.prefill, prefill_chunk=args.prefill_chunk,
+        slo_ttft_ms=args.slo_ttft_ms, slo_tpot_ms=args.slo_tpot_ms,
+        world=args.world, kv_shard=args.kv_shard,
+        attn_impl=args.attn_impl)
+    try:
+        srv_cfg.validate()
+    except ValueError as e:
+        parser.error(str(e))
+
+    import jax
+    from dlnetbench_tpu.models.transformer import init_params
+    params = init_params(jax.random.key(args.seed), model_cfg)
+    result = run_serving(model_cfg, srv_cfg, plan,
+                         fault_plan=fault_plan, params=params)
+    if variables:
+        result.global_meta["variables"] = variables
+    record = emit_result(result, path=args.out)
+    srv = record.get("global", {}).get("serving", {})
+    print(f"serving: {srv.get('completed')} requests at offered "
+          f"{srv.get('offered_rps')} rps — ttft p99 "
+          f"{(srv.get('ttft_ms') or {}).get('p99')} ms, goodput "
+          f"{srv.get('goodput_frac')}", file=sys.stderr)
     return 0
 
 

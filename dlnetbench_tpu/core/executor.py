@@ -205,6 +205,57 @@ class CompiledProgram:
         self._args = list(jax.tree.unflatten(self._treedef, flat_args))
 
 
+class CompiledStep:
+    """An AOT-compiled callable that still takes per-call arguments.
+
+    ``CompiledProgram`` owns fixed buffers and exposes a zero-arg
+    callable — right for the proxy schedules, whose every iteration is
+    identical.  A serving decode step is not: tokens, positions and
+    block tables change every engine step while the weights and KV page
+    pools persist.  ``CompiledStep`` keeps the engine's AOT contract —
+    compile at build time (``compile_ms``/``cost_analysis``/
+    ``memory_analysis`` recorded, persistent cache honored), never
+    inside a measured window — but leaves argument passing to the
+    caller.
+
+    ``donate_argnums`` are honored WITHOUT the private-clone rebinding
+    machinery: the caller owns the donated buffers and must rebind them
+    from the outputs itself (the serving engine threads its page pools
+    functionally, so that is its natural shape anyway).  Arguments must
+    match the example args' shapes/dtypes exactly — AOT executables
+    don't re-trace.
+    """
+
+    def __init__(self, fn: Callable, example_args: tuple,
+                 donate_argnums: tuple = (),
+                 compiler_options: dict | None = None):
+        enable_persistent_cache()
+        self.traceable = fn
+        donate = (() if os.environ.get(ENV_NO_DONATION)
+                  else tuple(donate_argnums))
+        t0 = time.perf_counter()
+        with spans.span("compile", fn=getattr(fn, "__name__",
+                                              type(fn).__name__)):
+            lowered = jax.jit(fn, donate_argnums=donate).lower(
+                *example_args)
+            self._compiled = lowered.compile(compiler_options)
+        self.stats = {"compile_ms": round(
+            (time.perf_counter() - t0) * 1e3, 3),
+            "donated_argnums": list(donate)}
+        self.stats.update(_analyses(self._compiled))
+
+    @property
+    def cost_analysis(self) -> dict | None:
+        return self.stats.get("cost_analysis")
+
+    @property
+    def memory_analysis(self) -> dict | None:
+        return self.stats.get("memory_analysis")
+
+    def __call__(self, *args):
+        return self._compiled(*args)
+
+
 def _clone(tree):
     """Device-side copy of a pytree of jax.Arrays, shardings preserved.
     ``device_put`` with the same sharding short-circuits to the original

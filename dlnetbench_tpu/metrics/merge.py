@@ -64,7 +64,13 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      # its own device ops, and attributes its own
                      # clocks; the merged record gets attribution
                      # recomputed over the pooled rows below
-                     "host_rtt_us", "attribution", "device_top_ops"}
+                     "host_rtt_us", "attribution", "device_top_ops",
+                     # serving-tier measurements (serving/): each
+                     # process clocks its own requests; the ARRIVAL
+                     # PLAN itself ("arrival_plan") stays comparable —
+                     # different traffic schedules ARE different runs,
+                     # exactly like fault plans
+                     "serving"}
 
 # scheduler-stamped variables that identify the PROCESS, not the run
 # (metrics.emit.scheduler_variables): they legitimately differ between

@@ -148,6 +148,23 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                 attr = g.get("attribution")
                 if isinstance(attr, dict) and attr.get("bound"):
                     row["attr_bound"] = attr["bound"]
+                # serving block (a dict global, skipped above): hoist
+                # the latency-vs-load axes — offered load, the tail
+                # percentiles and goodput-at-SLO — to plain columns so
+                # a load sweep groups like any other study grid;
+                # training records simply lack them
+                srv = g.get("serving")
+                if isinstance(srv, dict):
+                    row["serving_offered_rps"] = srv.get("offered_rps")
+                    row["serving_goodput_rps"] = srv.get("goodput_rps")
+                    row["serving_goodput_frac"] = srv.get("goodput_frac")
+                    for base in ("ttft_ms", "tpot_ms", "e2e_ms"):
+                        pcts = srv.get(base)
+                        if isinstance(pcts, dict):
+                            for p in ("p50", "p99"):
+                                if p in pcts:
+                                    row[f"serving_{base[:-3]}_{p}_ms"] \
+                                        = pcts[p]
                 for tname, tvals in timers.items():
                     if run < len(tvals):
                         # singular column names a la reference ('runtime')

@@ -1,0 +1,37 @@
+"""Serving tier: paged-KV decode, continuous batching, latency-vs-load.
+
+Everything else in this repo benchmarks *training* — step time, busbw,
+overlap, goodput under faults.  The north star serves "heavy traffic
+from millions of users", and the serving schedule worth reproducing is
+the Orca/vLLM line: a prefill/decode split transformer over a paged KV
+cache, continuously batched under an open-loop arrival process, judged
+by latency percentiles vs offered load instead of step time.
+
+Modules:
+
+* ``arrivals``  — ``ArrivalPlan``: the committable JSON traffic schema
+  (poisson / bursty / replay; seeded splitmix64 draws), deliberately
+  mirroring ``faults/plan.py`` so traffic plans are artifacts.
+* ``kv_cache``  — block-table paged KV cache (allocate/append/free
+  pages, occupancy + fragmentation stats) with the Pallas
+  ``paged_attention`` decode path on TPU, a dense gather-attention
+  fallback everywhere else, and a ``shard_map`` wrapper sharding along
+  GQA KV heads.
+* ``decode``    — the decode-path transformer: one AOT-compiled
+  single-token decode step + one chunked prefill program, sharing
+  ``models/transformer`` weights.
+* ``scheduler`` — the continuous-batching engine loop (admit from the
+  queue into free decode slots each step, evict on finish, prefill
+  inline-chunked or as a separate phase) plus the fault-composed run
+  (straggler delays inflate measured latency; crash+shrink loses
+  capacity, re-queues in-flight work, and prices recovery).
+* ``metrics``   — per-request TTFT/TPOT/e2e, p50/p95/p99, tokens/s and
+  goodput-at-SLO, emitted through the existing record schema v2 (a
+  ``serving`` global block + per-request timer arrays riding
+  ``metrics/emit`` -> ``parser`` -> ``merge`` ->
+  ``analysis/bandwidth``).
+
+docs/SERVING.md documents the knobs, the plan schema and the SLO
+metric definitions.
+"""
+from dlnetbench_tpu.serving.arrivals import ArrivalPlan, Request  # noqa: F401

@@ -1,0 +1,220 @@
+"""The decode-path transformer: prefill/decode split over the paged
+KV cache.
+
+Shares weights (``models/transformer.init_params``) and math (RoPE,
+RMSNorm, SwiGLU — the gated/llama family) with the training forward, so
+decode output is bit-checkable against ``transformer.forward`` on the
+same token prefix (tests/test_serving.py does exactly that).  Two
+programs cover serving:
+
+* ``make_decode_step``   — ONE token per active decode slot, full-batch
+  (shape ``[slots]``, inactive slots masked by dropping their cache
+  writes): project q/k/v for the fed token, write k/v into the slot's
+  current page, run paged attention over everything cached, MLP, and
+  greedy-sample the next token.  This is the program the engine runs
+  every step of the continuous-batching loop — AOT-compiled via
+  ``core/executor.CompiledStep`` with the page pools donated.
+* ``make_prefill_chunk`` — one sequence, one CHUNK of its prompt
+  (static chunk length, ``n_valid`` masking): writes the chunk's K/V
+  into the slot's pages and attends causally over cache + chunk.
+  ``scheduler`` drives it either to completion at admit time (separate
+  prefill phase) or one chunk per engine step (inline-chunked).
+
+Only the dense gated (SwiGLU + RMSNorm + RoPE) config is supported —
+the same subset every low-precision path in this repo covers first.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dlnetbench_tpu.models import layers as L
+from dlnetbench_tpu.models.transformer import TransformerConfig
+from dlnetbench_tpu.serving.kv_cache import (CacheConfig,
+                                             paged_attention_decode,
+                                             sharded_paged_attention)
+
+_F32 = jnp.float32
+
+
+def check_config(cfg: TransformerConfig) -> TransformerConfig:
+    if not cfg.gated or cfg.num_experts > 1 or cfg.max_positions:
+        raise ValueError(
+            "serving decode covers the dense gated (SwiGLU+RMSNorm+"
+            "RoPE) family only — non-gated / MoE / learned-position "
+            "configs have no decode path yet")
+    return cfg
+
+
+def _rope_decode(q, k, positions, theta=10000.0):
+    """RoPE with a PER-ELEMENT position (decode: every slot sits at its
+    own sequence offset).  q: [B, H, Dh], k: [B, Hkv, Dh],
+    positions: [B].  Same split-halves convention as ``layers.rope``."""
+    dh = q.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, dh, 2, dtype=_F32) / dh))
+    angles = positions.astype(_F32)[:, None] * inv_freq[None, :]
+    cos = jnp.cos(angles)[:, None, :]   # [B, 1, Dh/2]
+    sin = jnp.sin(angles)[:, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(_F32), 2, axis=-1)
+        return jnp.concatenate([x1 * cos - x2 * sin,
+                                x1 * sin + x2 * cos],
+                               axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def _attn_fn(cache_cfg: CacheConfig, attn_impl: str, mesh):
+    if mesh is not None:
+        return sharded_paged_attention(mesh, impl=attn_impl)
+    return functools.partial(paged_attention_decode, impl=attn_impl)
+
+
+def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
+                     *, attn_impl: str = "auto", mesh=None):
+    """``decode_step(params, k_pages, v_pages, tokens, positions,
+    block_tables, active) -> (k_pages, v_pages, next_tokens)``.
+
+    tokens/positions/active: ``[slots]`` (int32/int32/bool); a slot's
+    ``position`` is the cache index its token is written at (= tokens
+    already cached), so attention covers ``position + 1`` tokens.
+    Inactive slots write nowhere (out-of-bounds page index + ``drop``
+    mode) and their next_token is garbage the engine ignores."""
+    check_config(cfg)
+    scale = cfg.head_dim ** -0.5
+    page_size = cache_cfg.page_size
+    num_pages = cache_cfg.num_pages
+    attn = _attn_fn(cache_cfg, attn_impl, mesh)
+
+    def decode_step(params, k_pages, v_pages, tokens, positions,
+                    block_tables, active):
+        b = tokens.shape[0]
+        x = params["embed"][tokens]                      # [B, D]
+        page_col = positions // page_size
+        page_id = jnp.take_along_axis(block_tables, page_col[:, None],
+                                      axis=1)[:, 0]
+        w_pages = jnp.where(active, page_id, num_pages)  # OOB -> drop
+        slots = positions % page_size
+        att_lengths = positions + 1
+        for li in range(cfg.num_layers):
+            lp = jax.tree.map(lambda a: a[li], params["layers"])
+            y = L.rmsnorm(x, lp["norm1"])
+            q = jnp.dot(y, lp["wq"]).reshape(b, cfg.num_heads,
+                                             cfg.head_dim)
+            k = jnp.dot(y, lp["wk"]).reshape(b, cfg.num_kv_heads,
+                                             cfg.head_dim)
+            v = jnp.dot(y, lp["wv"]).reshape(b, cfg.num_kv_heads,
+                                             cfg.head_dim)
+            q, k = _rope_decode(q, k, positions)
+            # write-then-read: the new token's k/v land in the page pool
+            # first, so attention covers it like every cached token
+            k_pages = k_pages.at[li, :, w_pages, slots, :].set(
+                k, mode="drop")
+            v_pages = v_pages.at[li, :, w_pages, slots, :].set(
+                v, mode="drop")
+            att = attn(q * scale, k_pages[li], v_pages[li], att_lengths,
+                       block_tables)
+            x = x + jnp.dot(att.reshape(b, cfg.embed_dim), lp["wo"])
+            y = L.rmsnorm(x, lp["norm2"])
+            x = x + L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = L.rmsnorm(x, params["final_norm"])
+        head = params["embed"].T if cfg.tied_embeddings else params["head"]
+        logits = jnp.dot(x, head, preferred_element_type=_F32)
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return k_pages, v_pages, next_tokens
+
+    return decode_step
+
+
+def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
+                       chunk: int):
+    """``prefill_chunk(params, k_pages, v_pages, tokens, start, n_valid,
+    block_row) -> (k_pages, v_pages, next_token)``.
+
+    One sequence, one chunk: ``tokens`` is ``[chunk]`` (padded),
+    ``start`` the sequence offset of its first token, ``n_valid`` how
+    many entries are real.  The chunk's K/V are written into the pages
+    ``block_row`` maps, attention is causal over cache + chunk, and
+    ``next_token`` is the greedy continuation after the LAST valid
+    token — meaningful only on the chunk that completes the prompt
+    (that token IS the request's first generated token; its TTFT
+    stamp)."""
+    check_config(cfg)
+    scale = cfg.head_dim ** -0.5
+    page_size = cache_cfg.page_size
+    num_pages = cache_cfg.num_pages
+    pmax = cache_cfg.max_pages_per_seq
+
+    def prefill_chunk(params, k_pages, v_pages, tokens, start, n_valid,
+                      block_row):
+        positions = start + jnp.arange(chunk, dtype=jnp.int32)
+        valid = jnp.arange(chunk) < n_valid
+        x = params["embed"][tokens]                        # [C, D]
+        page_col = jnp.minimum(positions // page_size, pmax - 1)
+        page_id = block_row[page_col]
+        w_pages = jnp.where(valid, page_id, num_pages)     # OOB -> drop
+        slots = positions % page_size
+        last = jnp.maximum(n_valid - 1, 0)
+        for li in range(cfg.num_layers):
+            lp = jax.tree.map(lambda a: a[li], params["layers"])
+            y = L.rmsnorm(x, lp["norm1"])
+            q = jnp.dot(y, lp["wq"]).reshape(chunk, cfg.num_heads,
+                                             cfg.head_dim)
+            k = jnp.dot(y, lp["wk"]).reshape(chunk, cfg.num_kv_heads,
+                                             cfg.head_dim)
+            v = jnp.dot(y, lp["wv"]).reshape(chunk, cfg.num_kv_heads,
+                                             cfg.head_dim)
+            # layers.rope wants [B, S, H, Dh] + positions [S]
+            q, k = L.rope(q[None], k[None], positions)
+            q, k = q[0], k[0]
+            k_pages = k_pages.at[li, :, w_pages, slots, :].set(
+                k, mode="drop")
+            v_pages = v_pages.at[li, :, w_pages, slots, :].set(
+                v, mode="drop")
+            # causal attention over cache + chunk: gather the whole
+            # sequence contiguous from the slot's pages (chunk included
+            # — just written), mask keys past each query's position
+            kseq = k_pages[li][:, block_row]   # [Hkv, Pmax, S, Dh]
+            vseq = v_pages[li][:, block_row]
+            hkv, _, _, dh = kseq.shape
+            t = pmax * page_size
+            kseq = kseq.reshape(hkv, t, dh).astype(_F32)
+            vseq = vseq.reshape(hkv, t, dh).astype(_F32)
+            g = cfg.num_heads // hkv
+            qg = (q * scale).reshape(chunk, hkv, g, dh).astype(_F32)
+            scores = jnp.einsum("chgd,htd->hgct", qg, kseq)
+            causal = (jnp.arange(t)[None, :]
+                      <= positions[:, None])               # [C, T]
+            from dlnetbench_tpu.serving.kv_cache import MASK_VALUE
+            scores = jnp.where(causal[None, None], scores, MASK_VALUE)
+            p = jax.nn.softmax(scores, axis=-1)
+            att = jnp.einsum("hgct,htd->chgd", p, vseq)
+            att = att.reshape(chunk, cfg.embed_dim).astype(x.dtype)
+            x = x + jnp.dot(att, lp["wo"])
+            y = L.rmsnorm(x, lp["norm2"])
+            x = x + L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = L.rmsnorm(x, params["final_norm"])
+        head = params["embed"].T if cfg.tied_embeddings else params["head"]
+        logits = jnp.dot(x[last], head, preferred_element_type=_F32)
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return k_pages, v_pages, next_token
+
+    return prefill_chunk
+
+
+def prompt_tokens(rid: int, prompt_len: int, vocab_size: int):
+    """Deterministic synthetic prompt for request ``rid`` (the serving
+    analogue of the proxies' seeded buffers): the workload is
+    replayable from the arrival plan alone.  splitmix64 on the host —
+    a ``jax.random.randint`` here would jit-compile once per distinct
+    prompt length, a hidden multi-hundred-ms admission stall."""
+    import numpy as np
+
+    from dlnetbench_tpu.serving.arrivals import _Rng
+    rng = _Rng((rid + 1) * 0x9E3779B9)
+    return np.fromiter((rng.uniform_int(0, vocab_size - 1)
+                        for _ in range(prompt_len)),
+                       dtype=np.int32, count=prompt_len)

@@ -1,0 +1,243 @@
+"""Block-table paged KV cache + the paged-attention decode path.
+
+The cache is split along the host/device line the way a real serving
+engine splits it:
+
+* ``PagedKVCache`` (host) — the page allocator: a free list over
+  ``num_pages`` physical pages, per-slot block tables mapping logical
+  token positions to (page, slot-in-page), allocate/append/free, and
+  occupancy/fragmentation stats.  Pure numpy bookkeeping; nothing here
+  touches a device.
+* device page buffers — ``k_pages``/``v_pages`` arrays of shape
+  ``[layers, kv_heads, num_pages, page_size, head_dim]`` (the layout
+  the Pallas TPU ``paged_attention`` kernel consumes per layer),
+  created by ``device_buffers`` and threaded FUNCTIONALLY through the
+  compiled decode/prefill programs (serving/decode.py) — the engine
+  rebinds them from program outputs, the executor donates them.
+
+``paged_attention_decode`` dispatches the per-layer decode attention:
+the Pallas ``jax.experimental.pallas.ops.tpu.paged_attention`` kernel
+on a TPU backend, and a dense gather-attention fallback (gather the
+sequence's pages into a contiguous [T, d] view, mask by length) on the
+CPU mesh — the same backend split ``ops/pallas_common.interpret_mode``
+gates every kernel in ops/ on, so the whole serving tier is
+unit-testable on a laptop.  ``sharded_paged_attention`` wraps either
+impl in ``shard_map`` sharded along GQA KV heads (the SNIPPETS.md [3]
+recipe): KV pages are partitioned by head, query heads follow their
+group, and no collective is needed until the output projection.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlnetbench_tpu.ops import pallas_common
+from dlnetbench_tpu.utils.jax_compat import shard_map
+
+MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+class CacheOOM(RuntimeError):
+    """The free list is empty — the admission-control contract was
+    violated (the scheduler must reserve a request's worst-case pages
+    at admit time, so a running sequence can always append)."""
+
+
+@dataclasses.dataclass
+class CacheConfig:
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    num_pages: int           # physical pages shared by every slot
+    page_size: int           # tokens per page
+    max_seqs: int            # decode slots (the block table's rows)
+    max_pages_per_seq: int   # block-table width = max seq len / page_size
+    dtype: str = "float32"
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+    def validate(self) -> "CacheConfig":
+        for name in ("num_layers", "num_kv_heads", "head_dim",
+                     "num_pages", "page_size", "max_seqs",
+                     "max_pages_per_seq"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"kv cache: {name} must be >= 1")
+        return self
+
+
+class PagedKVCache:
+    """Host-side page allocator + block tables (one row per decode
+    slot).  Page 0 is a real, allocatable page; block-table padding
+    also points at 0 — harmless, every consumer masks by length."""
+
+    def __init__(self, cfg: CacheConfig):
+        self.cfg = cfg.validate()
+        self._free: list[int] = list(range(cfg.num_pages - 1, -1, -1))
+        self.block_tables = np.zeros(
+            (cfg.max_seqs, cfg.max_pages_per_seq), np.int32)
+        self.lengths = np.zeros((cfg.max_seqs,), np.int32)
+        self._pages_of: list[list[int]] = [[] for _ in range(cfg.max_seqs)]
+        self.peak_pages_in_use = 0
+
+    # ---- allocator ---------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.cfg.num_pages - len(self._free)
+
+    def can_fit(self, n_tokens: int) -> bool:
+        need = -(-n_tokens // self.cfg.page_size)
+        return need <= len(self._free)
+
+    def allocate(self, slot: int, n_tokens: int) -> None:
+        """Reserve pages for ``n_tokens`` on an empty slot (admission:
+        the scheduler reserves prompt+output worst case up front, so
+        ``append`` can never OOM mid-sequence)."""
+        if self._pages_of[slot]:
+            raise ValueError(f"kv cache: slot {slot} already allocated")
+        need = -(-n_tokens // self.cfg.page_size)
+        if need > self.cfg.max_pages_per_seq:
+            raise ValueError(
+                f"kv cache: {n_tokens} tokens need {need} pages > "
+                f"max_pages_per_seq {self.cfg.max_pages_per_seq}")
+        if need > len(self._free):
+            raise CacheOOM(
+                f"kv cache: need {need} pages, {len(self._free)} free — "
+                f"admission control must gate on can_fit()")
+        for i in range(need):
+            page = self._free.pop()
+            self._pages_of[slot].append(page)
+            self.block_tables[slot, i] = page
+        self.lengths[slot] = 0
+        self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                     self.pages_in_use)
+
+    def append(self, slot: int, n: int = 1) -> None:
+        """Advance the slot's length by ``n`` tokens (the device write
+        happened inside the compiled step); grows into the reserved
+        pages — exceeding the reservation is a scheduler bug."""
+        new_len = int(self.lengths[slot]) + n
+        if new_len > len(self._pages_of[slot]) * self.cfg.page_size:
+            raise CacheOOM(
+                f"kv cache: slot {slot} grew to {new_len} tokens past "
+                f"its {len(self._pages_of[slot])}-page reservation")
+        self.lengths[slot] = new_len
+
+    def free(self, slot: int) -> None:
+        for page in self._pages_of[slot]:
+            self._free.append(page)
+        self._pages_of[slot] = []
+        self.block_tables[slot, :] = 0
+        self.lengths[slot] = 0
+
+    # ---- stats (ride the serving record block) -----------------------
+    def stats(self) -> dict:
+        """Occupancy = fraction of physical pages in use; fragmentation
+        = fraction of ALLOCATED token capacity holding no token (the
+        cost of page-granular allocation + worst-case reservation)."""
+        cap = self.pages_in_use * self.cfg.page_size
+        toks = int(self.lengths.sum())
+        return {
+            "num_pages": self.cfg.num_pages,
+            "page_size": self.cfg.page_size,
+            "pages_in_use": self.pages_in_use,
+            "peak_pages_in_use": self.peak_pages_in_use,
+            "occupancy": round(self.pages_in_use / self.cfg.num_pages, 4),
+            "peak_occupancy": round(
+                self.peak_pages_in_use / self.cfg.num_pages, 4),
+            "fragmentation": (round((cap - toks) / cap, 4) if cap else 0.0),
+        }
+
+
+def device_buffers(cfg: CacheConfig) -> tuple[jax.Array, jax.Array]:
+    """Zeroed K/V page pools: ``[L, H_kv, num_pages, page_size, Dh]``
+    (the Pallas kernel's per-layer layout, stacked over layers)."""
+    shape = (cfg.num_layers, cfg.num_kv_heads, cfg.num_pages,
+             cfg.page_size, cfg.head_dim)
+    dt = jnp.dtype(cfg.dtype)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+# ---------------------------------------------------------------------
+# decode attention over the page pool
+
+
+def _gather_attention(q, k_pages, v_pages, lengths, page_indices):
+    """Dense fallback: gather each sequence's pages contiguous, mask by
+    length, fp32 softmax.  ``q`` arrives PRE-SCALED (both impls share
+    the convention; the Pallas kernel applies no sm_scale either).
+
+    q: [B, Hq, Dh]; k/v_pages: [Hkv, P, S, Dh]; lengths: [B] (valid
+    tokens incl. the one just written); page_indices: [B, Pmax]."""
+    hkv = k_pages.shape[0]
+    s = k_pages.shape[2]
+    # [Hkv, B, Pmax, S, Dh] -> [B, Hkv, T, Dh]
+    k = jnp.moveaxis(k_pages[:, page_indices], 0, 1)
+    v = jnp.moveaxis(v_pages[:, page_indices], 0, 1)
+    b, _, pmax, _, dh = k.shape
+    k = k.reshape(b, hkv, pmax * s, dh)
+    v = v.reshape(b, hkv, pmax * s, dh)
+    g = q.shape[1] // hkv
+    qg = q.reshape(b, hkv, g, dh).astype(jnp.float32)
+    scores = jnp.einsum("bhgd,bhtd->bhgt", qg, k.astype(jnp.float32))
+    mask = jnp.arange(pmax * s)[None, :] < lengths[:, None]  # [B, T]
+    scores = jnp.where(mask[:, None, None, :], scores, MASK_VALUE)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgt,bhtd->bhgd", p, v.astype(jnp.float32))
+    return out.reshape(b, hkv * g, dh).astype(q.dtype)
+
+
+def paged_attention_decode(q, k_pages, v_pages, lengths, page_indices,
+                           *, impl: str = "auto"):
+    """One decode step's attention for a batch of slots.  ``impl``:
+    ``auto`` picks the Pallas TPU kernel on a TPU backend and the dense
+    gather fallback elsewhere (the ``pallas_common`` backend split);
+    ``pallas``/``gather`` force a path.  ``q`` must be pre-scaled by
+    ``head_dim**-0.5`` — neither impl applies a softmax scale."""
+    if impl == "auto":
+        impl = "gather" if pallas_common.interpret_mode() else "pallas"
+    if impl == "gather":
+        return _gather_attention(q, k_pages, v_pages, lengths,
+                                 page_indices)
+    if impl != "pallas":
+        raise ValueError(f"paged_attention_decode: unknown impl "
+                         f"{impl!r} (auto|pallas|gather)")
+    from jax.experimental.pallas.ops.tpu.paged_attention import \
+        paged_attention
+    pages_per_seq = page_indices.shape[1]
+    return paged_attention(
+        q, k_pages, v_pages, lengths.astype(jnp.int32),
+        page_indices.astype(jnp.int32),
+        pages_per_compute_block=pallas_common.fit_block(
+            pages_per_seq, min(pages_per_seq, 8)))
+
+
+def sharded_paged_attention(mesh, axis: str = "kv",
+                            impl: str = "auto"):
+    """Shard the decode attention along GQA KV heads via ``shard_map``
+    (the SNIPPETS.md [3] recipe): KV pages partition by head
+    (``P(axis, None, None, None)``), query heads follow their group
+    (``P(None, axis, None)``), lengths/block tables replicate.  Each
+    shard attends over its own heads only — embarrassingly parallel, no
+    collective until the caller's output projection (jit inserts the
+    resharding there).  Requires ``num_kv_heads % axis_size == 0``."""
+    from jax.sharding import PartitionSpec as P
+
+    def fn(q, k_pages, v_pages, lengths, page_indices):
+        return paged_attention_decode(q, k_pages, v_pages, lengths,
+                                      page_indices, impl=impl)
+
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, axis, None), P(axis, None, None, None),
+                  P(axis, None, None, None), P(), P()),
+        out_specs=P(None, axis, None),
+        check_rep=False)

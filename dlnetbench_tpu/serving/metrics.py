@@ -1,0 +1,195 @@
+"""Serving metrics: per-request TTFT/TPOT, percentiles, goodput-at-SLO.
+
+The figures shift from step time (the training tier's currency) to the
+serving tier's:
+
+* **TTFT** — time to first token, ``first_token - arrival``.  Includes
+  queue wait: an open-loop arrival that waited for a slot pays for it
+  here, which is how saturation shows up as a TTFT p99 blowup.
+* **TPOT** — time per output token AFTER the first,
+  ``(finish - first_token) / (output_len - 1)`` (NaN-free: requests
+  with a single output token contribute no TPOT sample).
+* **e2e**  — ``finish - arrival``.
+* **goodput-at-SLO** — completed requests meeting BOTH SLOs (TTFT and
+  TPOT budgets) per wall second; the serving analogue of the elastic
+  tier's useful-steps-per-second.  ``goodput_timeline`` windows the
+  same predicate over finish times so a fault's dip AND recovery are
+  visible in one record.
+
+``build_result`` shapes everything as a ``ProxyResult`` so the serving
+tier rides the EXISTING record schema v2 unchanged: per-request
+ttft/tpot/e2e arrays are per-rank "timers" (``metrics.emit``
+band-summarizes them like any timer), the aggregate block is a
+``serving`` global, and the arrival plan is a comparable global —
+``metrics.merge`` refuses to combine records from different plans
+exactly as it refuses different fault plans.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from dlnetbench_tpu.proxies.base import ProxyResult
+from dlnetbench_tpu.serving.arrivals import ArrivalPlan
+
+
+@dataclasses.dataclass
+class Completed:
+    """One finished request's stamps (seconds, engine-clock relative)."""
+    rid: int
+    arrival_s: float
+    admitted_s: float
+    first_token_s: float
+    finish_s: float
+    prompt_len: int
+    output_len: int
+
+    @property
+    def ttft_ms(self) -> float:
+        return (self.first_token_s - self.arrival_s) * 1e3
+
+    @property
+    def tpot_ms(self) -> float:
+        """NaN for single-token outputs (no inter-token interval)."""
+        if self.output_len < 2:
+            return float("nan")
+        return ((self.finish_s - self.first_token_s)
+                / (self.output_len - 1)) * 1e3
+
+    @property
+    def e2e_ms(self) -> float:
+        return (self.finish_s - self.arrival_s) * 1e3
+
+
+def percentile(vals: list[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]); NaN on empty input.
+    With serving-study sample counts, interpolation would be theater —
+    same honesty rule as ``metrics.stats`` bands."""
+    vals = sorted(v for v in vals if not math.isnan(v))
+    if not vals:
+        return float("nan")
+    rank = max(1, math.ceil(q / 100.0 * len(vals)))
+    return vals[min(rank, len(vals)) - 1]
+
+
+def latency_summary(vals_ms: list[float], ndigits: int = 3) -> dict:
+    clean = [v for v in vals_ms if not math.isnan(v)]
+    if not clean:
+        return {"p50": float("nan"), "p95": float("nan"),
+                "p99": float("nan"), "mean": float("nan"), "n": 0}
+    return {
+        "p50": round(percentile(clean, 50), ndigits),
+        "p95": round(percentile(clean, 95), ndigits),
+        "p99": round(percentile(clean, 99), ndigits),
+        "mean": round(sum(clean) / len(clean), ndigits),
+        "n": len(clean),
+    }
+
+
+def meets_slo(c: Completed, slo_ttft_ms: float, slo_tpot_ms: float) -> bool:
+    """Both budgets must hold; a request without a TPOT sample (one
+    output token) is judged on TTFT alone."""
+    if c.ttft_ms > slo_ttft_ms:
+        return False
+    tpot = c.tpot_ms
+    return math.isnan(tpot) or tpot <= slo_tpot_ms
+
+
+def goodput_timeline(completed: list[Completed], slo_ttft_ms: float,
+                     slo_tpot_ms: float, window_s: float = 0.5) -> list:
+    """Windowed SLO-goodput over finish times: one
+    ``{"t_s", "completed", "slo_ok", "goodput_frac"}`` entry per
+    ``window_s`` bucket — the channel a crash's SLO dip and the
+    post-recovery climb are visible in (docs/RESILIENCE.md)."""
+    if not completed:
+        return []
+    horizon = max(c.finish_s for c in completed)
+    n_win = max(1, math.ceil(horizon / window_s))
+    out = []
+    for w in range(n_win):
+        lo, hi = w * window_s, (w + 1) * window_s
+        done = [c for c in completed if lo <= c.finish_s < hi]
+        ok = sum(1 for c in done if meets_slo(c, slo_ttft_ms,
+                                              slo_tpot_ms))
+        out.append({
+            "t_s": round(hi, 3),
+            "completed": len(done),
+            "slo_ok": ok,
+            # a window with NO completions states "no data" (null), not
+            # a fabricated 1.0 — a crash outage spanning whole windows
+            # must never read as perfect goodput
+            "goodput_frac": round(ok / len(done), 4) if done else None,
+        })
+    return out
+
+
+def serving_block(completed: list[Completed], plan: ArrivalPlan, *,
+                  slo_ttft_ms: float, slo_tpot_ms: float,
+                  wall_s: float, engine_steps: int,
+                  cache_stats: dict | None = None,
+                  queue_depth_max: int = 0,
+                  batch_occupancy_mean: float = 0.0) -> dict:
+    """The record's ``serving`` global: aggregate latency percentiles,
+    throughput, and goodput-at-SLO for one run."""
+    ttft = [c.ttft_ms for c in completed]
+    tpot = [c.tpot_ms for c in completed]
+    e2e = [c.e2e_ms for c in completed]
+    tokens = sum(c.output_len for c in completed)
+    ok = sum(1 for c in completed if meets_slo(c, slo_ttft_ms,
+                                               slo_tpot_ms))
+    block = {
+        "offered_rps": round(plan.offered_rps(), 4),
+        "completed": len(completed),
+        "measured_rps": round(len(completed) / wall_s, 4) if wall_s > 0
+        else 0.0,
+        "tokens_per_s": round(tokens / wall_s, 4) if wall_s > 0 else 0.0,
+        "engine_steps": engine_steps,
+        "ttft_ms": latency_summary(ttft),
+        "tpot_ms": latency_summary(tpot),
+        "e2e_ms": latency_summary(e2e),
+        "slo": {"ttft_ms": slo_ttft_ms, "tpot_ms": slo_tpot_ms},
+        "goodput_frac": round(ok / len(completed), 4) if completed
+        else 0.0,
+        "goodput_rps": round(ok / wall_s, 4) if wall_s > 0 else 0.0,
+        "queue_depth_max": queue_depth_max,
+        "batch_occupancy_mean": round(batch_occupancy_mean, 4),
+        "goodput_timeline": goodput_timeline(completed, slo_ttft_ms,
+                                             slo_tpot_ms),
+    }
+    if cache_stats:
+        block["kv_cache"] = cache_stats
+    return block
+
+
+def build_result(completed: list[Completed], plan: ArrivalPlan,
+                 global_meta: dict, *, section: str = "serving"
+                 ) -> ProxyResult:
+    """Shape a serving run as a ProxyResult for ``metrics.emit``: one
+    "run" per completed request, per-request ttft/tpot/e2e arrays as
+    the per-rank timers (band-summarized by emit like every timer), the
+    aggregate ``serving`` block + ``arrival_plan`` already in
+    ``global_meta`` (scheduler stamps them)."""
+    order = sorted(completed, key=lambda c: c.finish_s)
+    # ms-unit per-request arrays (the names deliberately carry no
+    # trailing 's' — the parser's singular-column rule would mangle
+    # "ttft_ms" into "ttft_m"); units documented here + docs/SERVING.md
+    timers = {
+        "ttft": [round(c.ttft_ms, 3) for c in order],
+        # single-token outputs have no inter-token interval: their
+        # timer entry is 0.0 (arrays must stay numeric and num_runs
+        # long); the serving block's percentiles NaN-filter instead
+        "tpot": [0.0 if math.isnan(c.tpot_ms) else round(c.tpot_ms, 3)
+                 for c in order],
+        "e2e": [round(c.e2e_ms, 3) for c in order],
+        # "output_len", not "output_tokens": the trailing 's' would be
+        # stripped by the parser's singular-column rule too
+        "output_len": [c.output_len for c in order],
+    }
+    return ProxyResult(
+        name=section,
+        global_meta=global_meta,
+        timers_us=timers,   # ms/count units — names say so; the record
+                            # schema carries arbitrary named timers
+        warmup_times_us=[],
+        num_runs=len(order),
+    )

@@ -1,0 +1,534 @@
+"""Continuous-batching engine: the serving schedule worth reproducing.
+
+The Orca/vLLM loop, measured honestly on the wall clock: requests
+arrive on an OPEN-LOOP schedule (serving/arrivals.py — arrivals never
+wait for the server), are admitted from the queue into free decode
+slots whenever pages for their worst case (prompt + output) can be
+reserved, prefill either as a separate phase at admit time or
+inline-chunked one chunk per engine step, decode one token per active
+slot per step over the paged KV cache, and evict on completion.  A
+saturated engine builds a queue; TTFT p99 blows up — the knee
+``examples/pod_study.py --serving`` sweeps for.
+
+Fault composition (the payoff of riding the existing record schema):
+``run_serving`` takes the SAME fault plan the training tier uses —
+``delay``/``jitter`` events sleep at engine-step boundaries inside the
+measured loop (a straggler decode step inflates every in-flight
+request's latency, which is what a straggler does to a serving fleet),
+and a ``crash`` under policy ``shrink`` costs capacity: the engine
+loses the dead rank's share of decode slots, in-flight requests are
+re-queued on a rebuilt (recompiled — priced) engine with their ORIGINAL
+arrival stamps, so the disruption lands in their latency and the
+record's SLO-goodput timeline shows the dip and the recovery arc
+(the segmentation mirrors ``faults/policy.run_faulted``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlnetbench_tpu.core import executor
+from dlnetbench_tpu.metrics import spans
+from dlnetbench_tpu.models.transformer import (TransformerConfig,
+                                               init_params)
+from dlnetbench_tpu.serving import decode as D
+from dlnetbench_tpu.serving import metrics as M
+from dlnetbench_tpu.serving.arrivals import ArrivalPlan, Request
+from dlnetbench_tpu.serving.kv_cache import (CacheConfig, PagedKVCache,
+                                             device_buffers)
+
+PREFILL_MODES = ("separate", "inline")
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Engine knobs (docs/SERVING.md documents the trade-offs)."""
+    slots: int = 4              # decode slots = max continuous batch
+    page_size: int = 8          # tokens per KV page
+    num_pages: int = 64         # physical pages shared by all slots
+    max_seq_len: int = 64       # per-request cap (prompt + output)
+    prefill: str = "separate"   # "separate" (drain at admit) | "inline"
+    prefill_chunk: int = 16     # prompt tokens per prefill program call
+    slo_ttft_ms: float = 500.0
+    slo_tpot_ms: float = 200.0
+    world: int = 1              # capacity ranks (fault-shrink unit):
+                                # slots are split evenly across ranks,
+                                # a crashed rank takes its share down
+    attn_impl: str = "auto"     # kv_cache.paged_attention_decode impl
+    kv_shard: int = 1           # >1: shard_map along GQA KV heads over
+                                # the first kv_shard devices
+    warmup_requests: int = 8    # run_serving drives this many synthetic
+                                # requests through the engine BEFORE the
+                                # measured run (0 disables): first-call
+                                # dispatch/allocator warm-in must not
+                                # ride the measured latencies — the
+                                # run_proxy warmup discipline applied to
+                                # the serving loop
+
+    def validate(self) -> "ServingConfig":
+        if self.prefill not in PREFILL_MODES:
+            raise ValueError(f"serving: prefill must be one of "
+                             f"{PREFILL_MODES}, got {self.prefill!r}")
+        for name in ("slots", "page_size", "num_pages", "max_seq_len",
+                     "prefill_chunk", "world", "kv_shard"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"serving: {name} must be >= 1")
+        if self.max_seq_len % self.page_size:
+            raise ValueError("serving: max_seq_len must be a multiple "
+                             "of page_size (block tables are "
+                             "page-granular)")
+        if self.num_pages < self.max_seq_len // self.page_size:
+            raise ValueError(
+                f"serving: num_pages {self.num_pages} cannot hold even "
+                f"one max_seq_len request "
+                f"({self.max_seq_len // self.page_size} pages) — the "
+                f"admission gate would starve the queue head forever")
+        if self.slots % self.world:
+            raise ValueError("serving: slots must divide evenly across "
+                             "world ranks (the fault-shrink unit)")
+        return self
+
+
+class _SlotState:
+    """One in-flight request's host-side state."""
+
+    def __init__(self, req: Request, admitted_s: float):
+        self.req = req
+        self.admitted_s = admitted_s
+        self.prompt = None          # jnp [prompt_len] int32, lazy
+        self.prefill_done = 0       # prompt tokens already cached
+        self.generated = 0
+        self.last_token = 0
+        self.first_token_s: float | None = None
+
+
+class Engine:
+    """One serving engine instance over a fixed slot/page capacity.
+
+    The decode step and the prefill-chunk program are AOT-compiled at
+    construction (``core/executor.CompiledStep`` — compile cost
+    recorded in ``global_meta``, never inside the measured loop); the
+    KV page pools are donated and rebound functionally each call."""
+
+    def __init__(self, model_cfg: TransformerConfig,
+                 cfg: ServingConfig, *, params=None, devices=None,
+                 mesh=None):
+        self.model_cfg = D.check_config(model_cfg)
+        self.cfg = cfg.validate()
+        self.devices = (list(devices) if devices is not None
+                        else jax.devices()[:max(cfg.world,
+                                                cfg.kv_shard)])
+        if len(self.devices) < cfg.world:
+            raise ValueError(
+                f"serving: world {cfg.world} needs {cfg.world} devices, "
+                f"have {len(self.devices)}")
+        self.cache_cfg = CacheConfig(
+            num_layers=model_cfg.num_layers,
+            num_kv_heads=model_cfg.num_kv_heads,
+            head_dim=model_cfg.head_dim,
+            num_pages=cfg.num_pages, page_size=cfg.page_size,
+            max_seqs=cfg.slots,
+            max_pages_per_seq=cfg.max_seq_len // cfg.page_size,
+            dtype=model_cfg.dtype)
+        if mesh is None and cfg.kv_shard > 1:
+            from dlnetbench_tpu.parallel.mesh import make_flat_mesh
+            if model_cfg.num_kv_heads % cfg.kv_shard:
+                raise ValueError(
+                    f"serving: kv_shard {cfg.kv_shard} must divide "
+                    f"num_kv_heads {model_cfg.num_kv_heads}")
+            # the mesh comes from THIS engine's device set — a shrink
+            # rebuild over the survivors must never keep sharding onto
+            # the dead rank's device (refused loudly when too few
+            # survivors remain to hold the shard)
+            if len(self.devices) < cfg.kv_shard:
+                raise ValueError(
+                    f"serving: kv_shard {cfg.kv_shard} needs "
+                    f"{cfg.kv_shard} devices, engine has "
+                    f"{len(self.devices)} — a shrunk world cannot keep "
+                    f"the KV shard; lower kv_shard with it")
+            mesh = make_flat_mesh(devices=self.devices[:cfg.kv_shard],
+                                  axis="kv")
+        if mesh is not None and "kv" not in mesh.axis_names:
+            raise ValueError("serving: the KV-shard mesh must name its "
+                             "axis 'kv' (sharded_paged_attention's "
+                             "specs)")
+        self.mesh = mesh
+        self.params = params if params is not None else init_params(
+            jax.random.key(0), model_cfg)
+        self.meta: dict = {}
+        with spans.span("build", what="serving engine"):
+            self._decode = executor.CompiledStep(
+                D.make_decode_step(model_cfg, self.cache_cfg,
+                                   attn_impl=cfg.attn_impl, mesh=mesh),
+                self._decode_example_args(), donate_argnums=(1, 2))
+            self._prefill = executor.CompiledStep(
+                D.make_prefill_chunk(model_cfg, self.cache_cfg,
+                                     cfg.prefill_chunk),
+                self._prefill_example_args(), donate_argnums=(1, 2))
+        self.meta["compile_ms"] = {
+            "decode_step": self._decode.stats["compile_ms"],
+            "prefill_chunk": self._prefill.stats["compile_ms"]}
+        self.meta["aot"] = {
+            "decode_step": {k: v for k, v in self._decode.stats.items()
+                            if k != "compile_ms"},
+            "prefill_chunk": {k: v for k, v in self._prefill.stats.items()
+                              if k != "compile_ms"}}
+        self._reset_state()
+
+    # ---- construction helpers ----------------------------------------
+    def _pools(self):
+        """Fresh zeroed page pools, pre-placed with the KV-head-sharded
+        layout when a mesh is in play: the AOT executables are lowered
+        against THESE shardings and their outputs keep them, so every
+        later call sees exactly the sharding it was compiled for (an
+        AOT program never auto-reshards — the /verify catch that
+        motivated this helper)."""
+        k, v = device_buffers(self.cache_cfg)
+        if self.mesh is None:
+            return k, v
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        s = NamedSharding(self.mesh, P(None, "kv", None, None, None))
+        return jax.device_put(k, s), jax.device_put(v, s)
+
+    def _pool_avals(self):
+        """Abstract stand-ins for the page pools at lowering time —
+        ``jax.jit(...).lower`` takes ShapeDtypeStructs, so the example
+        args need not ALLOCATE two extra full-size pool pairs (the
+        largest buffers in the tier; on a memory-tight chip the
+        redundant copies could OOM a config the steady-state engine
+        fits).  Carries the same sharding ``_pools`` places."""
+        cc = self.cache_cfg
+        shape = (cc.num_layers, cc.num_kv_heads, cc.num_pages,
+                 cc.page_size, cc.head_dim)
+        sharding = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            sharding = NamedSharding(self.mesh,
+                                     P(None, "kv", None, None, None))
+        aval = jax.ShapeDtypeStruct(shape, jnp.dtype(cc.dtype),
+                                    sharding=sharding)
+        return aval, aval
+
+    def _decode_example_args(self):
+        cc = self.cache_cfg
+        k, v = self._pool_avals()
+        b = cc.max_seqs
+        return (self.params, k, v,
+                jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, cc.max_pages_per_seq), jnp.int32),
+                jnp.zeros((b,), bool))
+
+    def _prefill_example_args(self):
+        cc = self.cache_cfg
+        k, v = self._pool_avals()
+        return (self.params, k, v,
+                jnp.zeros((self.cfg.prefill_chunk,), jnp.int32),
+                jnp.int32(0), jnp.int32(0),
+                jnp.zeros((cc.max_pages_per_seq,), jnp.int32))
+
+    def _reset_state(self):
+        self.cache = PagedKVCache(self.cache_cfg)
+        self.k_pages, self.v_pages = self._pools()
+        self.slots: list[_SlotState | None] = [None] * self.cfg.slots
+        self.completed: list[M.Completed] = []
+        self.queue: deque[Request] = deque()
+        self.pending: list[Request] = []
+        self.engine_steps = 0
+        self.queue_depth_max = 0
+        self._occupancy_samples: list[int] = []
+
+    # ---- the loop ----------------------------------------------------
+    def run(self, requests: list[Request], *, injector=None,
+            t_origin: float | None = None
+            ) -> tuple[list[M.Completed], float]:
+        """Drive the engine until every request completes; returns
+        ``(completed, wall_s)``.  ``t_origin`` anchors the admission
+        clock — a fault-segmented continuation passes the FIRST
+        segment's origin so arrival stamps stay on one timeline.  A
+        scripted ``RankFailure``/``RankPreempted`` from the injector
+        propagates with all progress retained on the engine
+        (``drain_unfinished`` hands the leftovers to the rebuilt
+        engine)."""
+        self._reset_state()
+        for r in requests:
+            if r.prompt_len + r.output_len > self.cfg.max_seq_len:
+                raise ValueError(
+                    f"serving: request {r.rid} needs "
+                    f"{r.prompt_len + r.output_len} tokens > max_seq_len "
+                    f"{self.cfg.max_seq_len}")
+        self.queue = deque(sorted(requests, key=lambda r: r.arrival_s))
+        self._t0 = time.monotonic() if t_origin is None else t_origin
+        while self.queue or self.pending or any(
+                s is not None for s in self.slots):
+            now = self._now()
+            self._admit_arrivals(now)
+            if not any(s is not None for s in self.slots) \
+                    and not self.pending:
+                # idle: sleep to the next arrival (open loop — the
+                # engine must not busy-spin the clock forward)
+                if self.queue:
+                    dt = self.queue[0].arrival_s - self._now()
+                    if dt > 0:
+                        time.sleep(dt)
+                continue
+            if injector is not None:
+                injector.before_step()  # faults land INSIDE the loop
+            self._step()
+        wall = self._now()
+        return self.completed, wall
+
+    def drain_unfinished(self) -> list[Request]:
+        """Everything not completed, for a fault-segmented continuation:
+        in-flight requests lose their decode progress (their cache dies
+        with this engine) but KEEP their arrival stamps — the rebuilt
+        engine redoes their work and the disruption lands in their
+        measured latency.  Slots and pages are freed."""
+        leftovers = [s.req for s in self.slots if s is not None]
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                self.cache.free(i)
+                self.slots[i] = None
+        leftovers += self.pending
+        leftovers += list(self.queue)
+        self.pending, self.queue = [], deque()
+        return sorted(leftovers, key=lambda r: r.arrival_s)
+
+    # ---- internals ---------------------------------------------------
+    def _now(self) -> float:
+        return time.monotonic() - self._t0
+
+    def _admit_arrivals(self, now: float) -> None:
+        while self.queue and self.queue[0].arrival_s <= now:
+            self.pending.append(self.queue.popleft())
+        self.queue_depth_max = max(self.queue_depth_max,
+                                   len(self.pending))
+        for i in range(self.cfg.slots):
+            if not self.pending:
+                break
+            if self.slots[i] is not None:
+                continue
+            req = self.pending[0]
+            # admission control: reserve the WORST CASE (prompt +
+            # output) so a running sequence can never OOM mid-decode
+            if not self.cache.can_fit(req.prompt_len + req.output_len):
+                break  # FIFO: do not starve the head by admitting later
+            self.pending.pop(0)
+            self.cache.allocate(i, req.prompt_len + req.output_len)
+            st = _SlotState(req, admitted_s=self._now())
+            st.prompt = D.prompt_tokens(req.rid, req.prompt_len,
+                                        self.model_cfg.vocab_size)
+            self.slots[i] = st
+            if self.cfg.prefill == "separate":
+                # drain the whole prompt now (the separate-phase mode:
+                # prefill monopolizes the engine while it runs, which
+                # is the interference inline chunking exists to cut)
+                while self.slots[i] is not None \
+                        and st.prefill_done < req.prompt_len:
+                    self._prefill_one(i, st)
+
+    def _prefill_one(self, slot: int, st: _SlotState) -> None:
+        c = self.cfg.prefill_chunk
+        start = st.prefill_done
+        n = min(c, st.req.prompt_len - start)
+        # pad on the HOST: a jnp dynamic-length slice here would cache
+        # one compiled dispatch per distinct tail length
+        chunk_np = np.zeros((c,), np.int32)
+        chunk_np[:n] = st.prompt[start:start + n]
+        chunk = jnp.asarray(chunk_np)
+        row = jnp.asarray(self.cache.block_tables[slot])
+        self.k_pages, self.v_pages, nxt = self._prefill(
+            self.params, self.k_pages, self.v_pages, chunk,
+            jnp.int32(start), jnp.int32(n), row)
+        st.prefill_done += n
+        self.cache.append(slot, n)
+        if st.prefill_done >= st.req.prompt_len:
+            # the chunk completing the prompt produces the request's
+            # FIRST generated token — its TTFT stamp
+            st.last_token = int(nxt)
+            st.generated = 1
+            st.first_token_s = self._now()
+            self._maybe_finish(slot, st)
+
+    def _step(self) -> None:
+        """One engine step: inline prefill chunks first (one per
+        prefilling slot), then one decode token for every decode-phase
+        slot, batched."""
+        for i, st in enumerate(self.slots):
+            if st is not None and st.prefill_done < st.req.prompt_len:
+                self._prefill_one(i, st)
+        decode_ix = [i for i, st in enumerate(self.slots)
+                     if st is not None
+                     and st.prefill_done >= st.req.prompt_len]
+        self._occupancy_samples.append(len(decode_ix))
+        self.engine_steps += 1
+        if not decode_ix:
+            return
+        b = self.cfg.slots
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        for i in decode_ix:
+            st = self.slots[i]
+            tokens[i] = st.last_token
+            positions[i] = int(self.cache.lengths[i])
+            active[i] = True
+        self.k_pages, self.v_pages, nxt = self._decode(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(self.cache.block_tables), jnp.asarray(active))
+        nxt = np.asarray(nxt)
+        for i in decode_ix:
+            st = self.slots[i]
+            self.cache.append(i)          # the fed token is now cached
+            st.last_token = int(nxt[i])
+            st.generated += 1
+            self._maybe_finish(i, st)
+
+    def _maybe_finish(self, slot: int, st: _SlotState) -> None:
+        if st.generated < st.req.output_len:
+            return
+        now = self._now()
+        self.completed.append(M.Completed(
+            rid=st.req.rid, arrival_s=st.req.arrival_s,
+            admitted_s=st.admitted_s, first_token_s=st.first_token_s,
+            finish_s=now, prompt_len=st.req.prompt_len,
+            output_len=st.req.output_len))
+        self.cache.free(slot)
+        self.slots[slot] = None
+
+    # ---- record assembly ---------------------------------------------
+    def batch_occupancy_mean(self) -> float:
+        if not self._occupancy_samples:
+            return 0.0
+        return sum(self._occupancy_samples) / len(self._occupancy_samples)
+
+    def global_meta(self, plan: ArrivalPlan) -> dict:
+        from dlnetbench_tpu.parallel.mesh import (describe_mesh,
+                                                  make_flat_mesh)
+        cfg = self.cfg
+        return {
+            "proxy": "serving",
+            "model": (f"decode_d{self.model_cfg.embed_dim}"
+                      f"_l{self.model_cfg.num_layers}"
+                      f"_h{self.model_cfg.num_heads}"
+                      f"kv{self.model_cfg.num_kv_heads}"
+                      f"_v{self.model_cfg.vocab_size}"),
+            "world_size": cfg.world,
+            "arrival_plan": plan.to_dict(),
+            "serving_config": {
+                "slots": cfg.slots, "page_size": cfg.page_size,
+                "num_pages": cfg.num_pages,
+                "max_seq_len": cfg.max_seq_len,
+                "prefill": cfg.prefill,
+                "prefill_chunk": cfg.prefill_chunk,
+                "kv_shard": cfg.kv_shard,
+            },
+            "mesh": describe_mesh(make_flat_mesh(devices=self.devices)),
+            **self.meta,
+        }
+
+
+def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
+                plan: ArrivalPlan, *, fault_plan=None, params=None,
+                devices=None):
+    """One measured serving run -> ``ProxyResult`` (-> ``metrics.emit``).
+
+    Clean runs drive one engine.  With ``fault_plan``: delay/jitter
+    events sleep at step boundaries inside the loop; a crash under
+    policy ``shrink`` segments the run like ``faults/policy.run_faulted``
+    segments a training run — detection measured at the catch, the
+    engine rebuilt over the survivor ranks' slot share (recompile
+    priced into ``recovery_ms``), unfinished requests re-queued with
+    their original arrival stamps, and the record stamps
+    ``degraded_world``/``fault_*`` so the analysis layer reads serving
+    faults exactly like training faults."""
+    engine = Engine(model_cfg, cfg, params=params, devices=devices)
+    requests = plan.sample()
+    if cfg.warmup_requests > 0:
+        # warm-in: saturating synthetic mini-workload, discarded — the
+        # measured run starts with hot dispatch paths (run_proxy's
+        # warmup phase, serving-shaped)
+        p_len = min(cfg.prefill_chunk + 1, cfg.max_seq_len - 2)
+        warm = [Request(rid=-1 - i, arrival_s=0.0, prompt_len=p_len,
+                        output_len=2)
+                for i in range(cfg.warmup_requests)]
+        with spans.span("warmup", what="serving engine",
+                        reps=len(warm)):
+            engine.run(warm)
+    injector = None
+    if fault_plan is not None:
+        from dlnetbench_tpu.faults.inject import FaultInjector
+        fault_plan.validate()
+        injector = FaultInjector(fault_plan, world=cfg.world)
+
+    meta = engine.global_meta(plan)
+    extra: dict = {}
+    try:
+        with spans.span("serving_run", requests=len(requests)):
+            completed, wall = engine.run(requests, injector=injector)
+        final = engine
+    except Exception as e:
+        from dlnetbench_tpu.faults.inject import (RankFailure,
+                                                  RankPreempted)
+        if not isinstance(e, (RankFailure, RankPreempted)) \
+                or fault_plan.policy != "shrink":
+            raise
+        # capacity shrink: the dead rank takes its slot share down.
+        # Mirrors faults/policy.run_faulted's segmentation: detect,
+        # rebuild (recompile priced), finish degraded.
+        detection_ms = (time.monotonic()
+                        - injector.crash_raised_at) * 1e3
+        survivors = [r for r in range(cfg.world)
+                     if r not in fault_plan.crash_victims(cfg.world)
+                     and r not in fault_plan.preempt_victims()]
+        if not survivors:
+            raise
+        leftovers = engine.drain_unfinished()
+        done0 = list(engine.completed)
+        t_origin = engine._t0
+        steps0 = engine.engine_steps
+        occ0 = list(engine._occupancy_samples)
+        qmax0 = engine.queue_depth_max
+        t0 = time.monotonic()
+        shrunk = dataclasses.replace(
+            cfg, world=len(survivors),
+            slots=cfg.slots // cfg.world * len(survivors))
+        with spans.span("serving_rebuild", survivors=len(survivors)):
+            engine2 = Engine(model_cfg, shrunk, params=params,
+                             devices=[engine.devices[r]
+                                      for r in survivors])
+        recovery_ms = (time.monotonic() - t0) * 1e3
+        done1, wall = engine2.run(leftovers, injector=injector,
+                                  t_origin=t_origin)
+        completed = done0 + done1
+        final = engine2
+        final.engine_steps += steps0
+        final._occupancy_samples = occ0 + final._occupancy_samples
+        final.queue_depth_max = max(qmax0, final.queue_depth_max)
+        meta["mesh"] = engine2.global_meta(plan)["mesh"]
+        extra = {"detection_ms": round(detection_ms, 3),
+                 "recovery_ms": round(recovery_ms, 3),
+                 "degraded_world": survivors,
+                 "degraded_slots": shrunk.slots}
+
+    meta["serving"] = M.serving_block(
+        completed, plan, slo_ttft_ms=cfg.slo_ttft_ms,
+        slo_tpot_ms=cfg.slo_tpot_ms, wall_s=wall,
+        engine_steps=final.engine_steps,
+        cache_stats=final.cache.stats(),
+        queue_depth_max=final.queue_depth_max,
+        batch_occupancy_mean=final.batch_occupancy_mean())
+    if fault_plan is not None:
+        meta["fault_plan"] = fault_plan.to_dict()
+        meta["fault_policy"] = fault_plan.policy
+        meta["fault_injected_delay_us"] = round(
+            injector.injected_delay_us, 1)
+    meta.update(extra)
+    return M.build_result(completed, plan, meta)

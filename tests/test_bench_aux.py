@@ -222,6 +222,41 @@ def test_bandwidth_overlap_nan_without_decomposition():
     assert math.isnan(bw["overlap"].iloc[0])
 
 
+def test_serving_decode_line_schema_locked():
+    """bench.py's serving_decode aux line (ISSUE 8) is a BENCH
+    artifact: lock the stat-band schema — ms headline from the
+    round-median e2e p99 (lower-is-better, so the sentinel compares it
+    like every latency line), and {value, best, band, n} sub-objects
+    for TTFT/TPOT/p99/tokens-per-s/goodput."""
+    import bench
+    rounds = [
+        {"e2e_ms": {"p99": 10.0}, "ttft_ms": {"p50": 2.0},
+         "tpot_ms": {"p50": 1.0}, "tokens_per_s": 100.0,
+         "goodput_frac": 1.0, "completed": 16, "offered_rps": 80.0},
+        {"e2e_ms": {"p99": 12.0}, "ttft_ms": {"p50": 2.2},
+         "tpot_ms": {"p50": 1.1}, "tokens_per_s": 90.0,
+         "goodput_frac": 0.9, "completed": 16, "offered_rps": 80.0},
+        {"e2e_ms": {"p99": 11.0}, "ttft_ms": {"p50": 2.1},
+         "tpot_ms": {"p50": 1.05}, "tokens_per_s": 95.0,
+         "goodput_frac": 1.0, "completed": 16, "offered_rps": 80.0},
+    ]
+    line = bench._serving_decode_line(rounds, suffix=", test")
+    assert line["unit"] == "ms"
+    assert line["value"] == 11.0 and line["n"] == 3
+    assert line["band"] == [10.0, 12.0] and line["best"] == 10.0
+    for key in ("ttft_p50_ms", "tpot_p50_ms", "p99_ms",
+                "tokens_per_s", "goodput_frac"):
+        sub = line[key]
+        for k in ("value", "best", "band", "n"):
+            assert k in sub, (key, k)
+    assert line["ttft_p50_ms"]["value"] == 2.1
+    assert line["requests"] == 16 and line["offered_rps"] == 80.0
+    # sentinel comparability: the line is an ms line, so bench.py
+    # --check picks it up as "serving_decode" automatically
+    from dlnetbench_tpu.sentinel import is_ms_line
+    assert is_ms_line(line)
+
+
 def test_aux_deadline_skips_instead_of_running(capsys, monkeypatch):
     """Past the wall-clock deadline the aux fn must not even start —
     the headline line takes precedence over auxiliary coverage."""

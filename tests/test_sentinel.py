@@ -155,6 +155,41 @@ def test_check_surfaces_vanished_baseline_lines():
     assert full["missing"] == []
 
 
+@pytest.mark.sentinel
+def test_serving_latency_line_is_comparable():
+    """The serving_decode aux line (ISSUE 8) rides the headline like
+    every ms line, and the sentinel judges it with the same
+    lower-is-better, band-aware semantics: a p99 median that worsens
+    past threshold with disjoint bands is a regression; a
+    band-overlapping shift is noise."""
+    def serving_line(value, band):
+        return {"metric": "serving_decode: paged-KV decode e2e p99",
+                "value": value, "unit": "ms", "best": band[0],
+                "band": band, "n": 3,
+                "ttft_p50_ms": {"value": 2.0, "best": 1.9,
+                                "band": [1.9, 2.1], "n": 3}}
+
+    base = {"headline": _line(10.0, [9.9, 10.1]),
+            "serving_decode": serving_line(20.0, [19.5, 20.5])}
+    # engine p99 doubles with disjoint bands while the headline holds:
+    # the serving line alone must trip the verdict
+    cur = {"headline": _line(10.0, [9.9, 10.1]),
+           "serving_decode": serving_line(40.0, [39.0, 41.0])}
+    sent = sentinel.check(base, cur)
+    assert sent["verdict"] == "regression"
+    assert sent["regressions"] == ["serving_decode"]
+    # band-overlapping latency wobble is noise, not a regression
+    ok = sentinel.check(base, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "serving_decode": serving_line(22.0, [19.0, 24.0])})
+    assert ok["verdict"] == "clean"
+    # faster p99 with disjoint bands reads as an improvement
+    fast = sentinel.check(base, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "serving_decode": serving_line(12.0, [11.5, 12.5])})
+    assert fast["improvements"] == ["serving_decode"]
+
+
 def _artifact(path, value, band):
     head = _line(value, band)
     path.write_text(json.dumps({"parsed": head, "tail": ""}))
