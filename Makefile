@@ -3,7 +3,8 @@
 
 PYTHON ?= python
 
-.PHONY: test check-bench check-resilience check-serving sentinel-scan
+.PHONY: test check-bench check-resilience check-serving check-tuning \
+	sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -45,6 +46,19 @@ check-serving:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
 	    tests/test_bench_aux.py::test_serving_decode_line_schema_locked \
 	    tests/test_sentinel.py::test_serving_latency_line_is_comparable
+
+# the autotuner lane (docs/PERF.md "Autotuning"): TuningDB durability
+# (torn writes, schema refusal, the writer claim/retry race), the
+# seeded band-aware search, every consult site's empty-DB bit-identity,
+# the committed fixture round-trip, and the tune CLI proving
+# search -> commit -> consult -> hit end to end with a tiny-CPU
+# 2-candidate search.  Seconds of search inside ~1 min of lane wall.
+check-tuning:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_tuning.py -q \
+	    -m tuning
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
+	    tests/test_bench_aux.py::test_tuned_ab_line_schema_locked \
+	    tests/test_sentinel.py::test_tuned_ab_line_is_comparable
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

@@ -211,6 +211,15 @@ def effective_bandwidth(records: list[dict]):
             "attr_comm": float(attr_fr.get("comm_exposed", float("nan"))),
             "attr_host": float(attr_fr.get("host", float("nan"))),
         }
+        # tuning provenance (ISSUE 9): "hits/consults" of the tuned-
+        # config consult map the run recorded (metrics/emit), "-" on
+        # untuned/v1 records — every bandwidth row says whether the run
+        # it came from executed DB-tuned configs, like transport says
+        # what moved its bytes
+        tun = g.get("tuning")
+        tuned = (f"{int(tun.get('hits', 0))}/"
+                 f"{int(tun.get('hits', 0)) + int(tun.get('misses', 0))}"
+                 if isinstance(tun, dict) else "-")
         for rank_row in rec.get("ranks", []):
             # measured comm–compute overlap fraction (schema v2+,
             # proxies/base.py): one dimensionless sample per run, riding
@@ -303,6 +312,7 @@ def effective_bandwidth(records: list[dict]):
                                        / 1e9),
                         "bound": run_bound,
                         "transport": transport,
+                        "tuned": tuned,
                         "overlap": (float(ov[run])
                                     if ov is not None and run < len(ov)
                                     else float("nan")),
@@ -386,7 +396,7 @@ def bandwidth_summary(records: list[dict]):
     if bw.empty:
         return bw
     return (bw.groupby(["section", "model", "collective", "group_size",
-                        "bound", "transport", "attr_bound"])
+                        "bound", "transport", "tuned", "attr_bound"])
             [["time_us", "msg_bytes", "algbw_GBps", "busbw_GBps",
               "overlap", "straggler_amp", "detection_ms", "recovery_ms",
               "checkpoint_ms", "restore_ms", "lost_steps", "goodput",

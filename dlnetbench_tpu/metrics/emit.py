@@ -134,6 +134,22 @@ def result_to_record(result: ProxyResult) -> dict:
     # transport provenance (schema v2): proxies that know better (the
     # native tier, future DCN-aware builds) pre-stamp their own
     g.setdefault("transport", transport_label(mesh_info))
+    # tuning provenance (ISSUE 9): which tuned configs this process ran
+    # under — {db_dir, hits, misses, sites: {op|key -> config/hit/
+    # band}}.  Absent on untuned runs (tuning disabled or no tunable
+    # site consulted), so v1/pre-tuning records and this build's
+    # untuned records are byte-compatible; a DB-miss run (misses > 0,
+    # hits == 0) and a DB-hit run are distinguishable by construction.
+    # Derived data: a failure here must never cost the measurement.
+    try:
+        from dlnetbench_tpu import tuning
+        tp = tuning.provenance()
+        if tp is not None:
+            g.setdefault("tuning", tp)
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"tuning provenance stamping failed "
+              f"({type(e).__name__}: {e}); record unaffected",
+              file=sys.stderr)
     if num_procs > 1:
         g.setdefault("num_processes", num_procs)
     record = {

@@ -70,7 +70,13 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      # PLAN itself ("arrival_plan") stays comparable —
                      # different traffic schedules ARE different runs,
                      # exactly like fault plans
-                     "serving"}
+                     "serving",
+                     # tuning provenance (ISSUE 9): each process
+                     # consults its own DB on its own disk (and a host
+                     # without the env set consults nothing) — per-
+                     # process warm state, not run identity.  Process
+                     # 0's block survives in the merged record.
+                     "tuning"}
 
 # scheduler-stamped variables that identify the PROCESS, not the run
 # (metrics.emit.scheduler_variables): they legitimately differ between

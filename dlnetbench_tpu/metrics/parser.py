@@ -148,6 +148,14 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                 attr = g.get("attribution")
                 if isinstance(attr, dict) and attr.get("bound"):
                     row["attr_bound"] = attr["bound"]
+                # tuning provenance (a dict global, skipped above): the
+                # groupby-grade summary — "hits/consults" — rides as a
+                # plain column; untuned/v1 records simply lack it
+                tun = g.get("tuning")
+                if isinstance(tun, dict):
+                    hits = int(tun.get("hits", 0))
+                    total = hits + int(tun.get("misses", 0))
+                    row["tuned"] = f"{hits}/{total}"
                 # serving block (a dict global, skipped above): hoist
                 # the latency-vs-load axes — offered load, the tail
                 # percentiles and goodput-at-SLO — to plain columns so

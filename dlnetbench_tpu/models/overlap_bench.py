@@ -92,6 +92,9 @@ def build_programs(n_devices: int | None = None, devices=None,
     kw.setdefault("batch", dp * 2 * 2)
     kw.setdefault("num_microbatches", 2)
     base = spmd.SpmdConfig(tp_overlap="none", grad_sync="monolithic", **kw)
+    # resolve tuned-or-default knobs HERE (explicit cfg_kwargs win) so
+    # the metric string names the chunk grain the programs actually ran
+    base = base.resolve_tuned(dp, pp, tp)
     over = dataclasses.replace(base, tp_overlap="decomposed",
                                grad_sync="bucketed")
     cfgs = {"baseline": base, "overlapped": over}

@@ -109,7 +109,11 @@ class SpmdConfig:
     #               broken into ring chunks interleaved with the
     #               dependent matmul, forward AND backward (custom VJPs)
     tp_overlap: str = "none"
-    tp_overlap_chunks: int = 2   # row chunks per ring block (overlap grain)
+    # row chunks per ring block (overlap grain).  None = consult the
+    # tuning DB (dlnetbench_tpu/tuning, keyed per shape x tp x chip)
+    # and fall back to the frozen default 2 on a miss — an explicit
+    # int ALWAYS wins (resolve_tuned; resolved in make_train_step)
+    tp_overlap_chunks: int | None = None
     # DP gradient sync schedule:
     #   monolithic  one psum of the whole grad tree after backward
     #   bucketed    per-layer-group psums issued in reverse-layer order,
@@ -117,11 +121,64 @@ class SpmdConfig:
     #               streams as soon as its grads materialize (ZeRO/FSDP
     #               bucketing, the dp proxy's schedule made real)
     grad_sync: str = "monolithic"
-    grad_bucket_layers: int = 1  # local layers per bucket
+    # local layers per bucket.  None = tuning-DB consult, frozen
+    # default 1 on a miss; explicit ints always win (resolve_tuned)
+    grad_bucket_layers: int | None = None
 
     @property
     def head_dim(self) -> int:
         return self.embed_dim // self.num_heads
+
+    def resolve_tuned(self, dp: int, pp: int, tp: int) -> "SpmdConfig":
+        """Concrete overlap-grain / bucket-size knobs: explicit user
+        values pass through untouched; ``None`` fields consult the
+        tuning DB (dlnetbench_tpu/tuning — frozen after first consult)
+        and fall back to the frozen defaults (chunks=2, bucket=1) on a
+        miss, so an empty DB is bit-identical to the pre-tuning
+        harness.  A knob whose mode is off (``tp_overlap='none'`` /
+        ``grad_sync='monolithic'``) resolves straight to its default
+        WITHOUT a consult — the compiled program doesn't depend on it,
+        and a logged "hit" on an inert knob would stamp tuned
+        provenance onto a bit-identical-to-untuned run.  Returns self
+        when nothing needed resolving."""
+        chunks, bucket = self.tp_overlap_chunks, self.grad_bucket_layers
+        if chunks is None or bucket is None:
+            from dlnetbench_tpu import tuning
+
+            def positive(field):
+                def check(cfg):
+                    v = cfg.get(field)
+                    if not isinstance(v, int) or v < 1:
+                        raise ValueError(f"{field}={v!r} is not a "
+                                         f"positive int")
+                return check
+            if chunks is None:
+                if self.tp_overlap != "decomposed":
+                    chunks = 2   # inert knob: frozen default, no consult
+                else:
+                    chunks = tuning.consult(
+                        "tp_overlap_chunks",
+                        tuning.params.tp_overlap_chunks_key(
+                            self.embed_dim, self.ff_dim, self.seq_len,
+                            tp, self.dtype),
+                        {"chunks": 2},
+                        validate=positive("chunks"))["chunks"]
+            if bucket is None:
+                if self.grad_sync != "bucketed":
+                    bucket = 1   # inert knob: frozen default, no consult
+                else:
+                    bucket = tuning.consult(
+                        "grad_bucket_layers",
+                        tuning.params.grad_bucket_layers_key(
+                            self.num_layers, dp, pp, self.embed_dim,
+                            self.ff_dim),
+                        {"layers": 1},
+                        validate=positive("layers"))["layers"]
+        if (chunks, bucket) == (self.tp_overlap_chunks,
+                                self.grad_bucket_layers):
+            return self
+        return dataclasses.replace(self, tp_overlap_chunks=chunks,
+                                   grad_bucket_layers=bucket)
 
     @property
     def jdtype(self):
@@ -137,10 +194,12 @@ class SpmdConfig:
              f"unknown sp_mode {self.sp_mode!r}"),
             (self.tp_overlap in ("none", "decomposed"),
              f"unknown tp_overlap {self.tp_overlap!r}"),
-            (self.tp_overlap_chunks >= 1, "tp_overlap_chunks < 1"),
+            (self.tp_overlap_chunks is None or self.tp_overlap_chunks >= 1,
+             "tp_overlap_chunks < 1"),
             (self.grad_sync in ("monolithic", "bucketed"),
              f"unknown grad_sync {self.grad_sync!r}"),
-            (self.grad_bucket_layers >= 1, "grad_bucket_layers < 1"),
+            (self.grad_bucket_layers is None or
+             self.grad_bucket_layers >= 1, "grad_bucket_layers < 1"),
             (self.num_layers % pp == 0, "layers % pp != 0"),
             (self.batch % (dp * self.num_microbatches) == 0,
              "batch % (dp*microbatches) != 0"),
@@ -474,6 +533,10 @@ def _bucketed_grad_sync(cfg: SpmdConfig, grads: dict, specs: dict,
 def make_train_step(mesh: Mesh, cfg: SpmdConfig, variant: str = "full"):
     dp, pp, tp = (mesh.devices.shape[mesh.axis_names.index(a)]
                   for a in (AXIS_DP, AXIS_PP, AXIS_TP))
+    # tuned-or-default knob resolution FIRST (explicit values pass
+    # through; dlnetbench_tpu/tuning) so everything below — including
+    # validate — sees concrete ints
+    cfg = cfg.resolve_tuned(dp, pp, tp)
     cfg.validate(dp, pp, tp)
     if variant not in VARIANTS:
         raise ValueError(f"unknown variant {variant!r}; one of {VARIANTS}")

@@ -352,21 +352,72 @@ def _bwd_blocks_override(bq: int, bk: int, s: int):
     observe it) rather than silently keeping the stale compiled config
     through the jit cache — the pre-freeze behavior read the LIVE env
     at trace time, so an in-process sweep could believe it measured 4
-    configs while timing one."""
+    configs while timing one.  The error names the frozen -> attempted
+    values so the offending sweep knows exactly which config it tried
+    to smuggle in (tests/test_tuning.py locks both properties).
+    Returns None when the env is unset (the tuning DB may then answer,
+    ``_resolve_bwd_blocks``) — env always wins for reproducibility."""
     live = os.environ.get("DLNB_FLASH_BWD_BLOCKS", "")
     if live != _BWD_BLOCKS_ENV:
         raise ValueError(
             f"DLNB_FLASH_BWD_BLOCKS changed after import "
-            f"({_BWD_BLOCKS_ENV!r} -> {live!r}): the knob is captured at "
-            f"import time because jit caching is not keyed on it — set "
-            f"it before importing, or use a fresh process per value")
+            f"(frozen {_BWD_BLOCKS_ENV!r} -> attempted {live!r}): the "
+            f"knob is captured at import time because jit caching is "
+            f"not keyed on it — set it before importing, or use a "
+            f"fresh process per value")
+    if not _BWD_BLOCKS_ENV:
+        return None
     return _parse_bwd_blocks(_BWD_BLOCKS_ENV, bq, bk, s)
 
 
+def _validate_blocks(s: int, what: str):
+    """Loud validator for DB-tuned block configs: every block must be a
+    positive divisor of the sequence — a truncated grid would silently
+    drop contributions (same failure mode ``_parse_bwd_blocks`` guards
+    the env knob against)."""
+    def check(cfg: dict) -> None:
+        for name, blk in cfg.items():
+            if not isinstance(blk, int) or blk <= 0 or s % blk:
+                raise ValueError(
+                    f"{what}: tuned block {name}={blk!r} does not "
+                    f"divide seq_len {s}")
+    return check
+
+
+def _resolve_bwd_blocks(q, k, causal: bool, bq: int, bk: int,
+                        consult_db: bool = True):
+    """Backward per-kernel blocks, in override precedence order: the
+    env knob first (frozen at import, ``_bwd_blocks_override`` — a
+    sweep that sets it must measure ITS blocks whatever anything else
+    says), then — only when the caller passed no explicit blocks
+    (``consult_db``) — the tuning DB (``dlnetbench_tpu/tuning``,
+    frozen after first consult per shape key), then (bq, bk) for both
+    kernels: the caller's explicit blocks, or today's defaults, so an
+    empty DB is bit-identical to the pre-tuning harness and explicit
+    arguments are never silently overlaid by a DB hit."""
+    b, s, hq, _ = q.shape
+    env = _bwd_blocks_override(bq, bk, s)
+    if env is not None:
+        return env
+    if not consult_db:
+        return (bq, bk), (bq, bk)
+    from dlnetbench_tpu import tuning
+    cfg = tuning.consult(
+        "flash_bwd",
+        tuning.params.flash_bwd_key(b, s, hq, k.shape[2], q.shape[3],
+                                    causal, q.dtype),
+        {"bq_dq": bq, "bk_dq": bk, "bq_dkv": bq, "bk_dkv": bk},
+        validate=_validate_blocks(s, "flash_attention backward"))
+    return ((cfg["bq_dq"], cfg["bk_dq"]), (cfg["bq_dkv"], cfg["bk_dkv"]))
+
+
 def _bwd_impl(q, k, v, out, lse, do, *, causal: bool,
-              block_q: int, block_k: int):
-    (bq_dq, bk_dq), (bq_dkv, bk_dkv) = _bwd_blocks_override(
-        block_q, block_k, q.shape[1])
+              block_q: int, block_k: int, override_blocks=None,
+              consult_db: bool = True):
+    (bq_dq, bk_dq), (bq_dkv, bk_dkv) = (
+        override_blocks if override_blocks is not None
+        else _resolve_bwd_blocks(q, k, causal, block_q, block_k,
+                                 consult_db=consult_db))
     b, s, hq, dh = q.shape
     hkv = k.shape[2]
     group = hq // hkv
@@ -506,6 +557,20 @@ def _resolve_blocks(q, k, block_q, block_k,
 def _flash_fwd(q, k, v, causal, block_q, block_k):
     bq, bk = _resolve_blocks(q, k, block_q, block_k,
                              candidates=_BLOCK_CANDIDATES_FWD)
+    if block_q is None and block_k is None:
+        # no explicit blocks from the caller: the tuning DB may answer
+        # (dlnetbench_tpu/tuning — frozen after first consult per shape
+        # key; explicit arguments always bypass it); an empty/absent DB
+        # keeps today's _pick_block defaults bit-identically
+        from dlnetbench_tpu import tuning
+        b, s, hq, dh = q.shape
+        cfg = tuning.consult(
+            "flash_fwd",
+            tuning.params.flash_fwd_key(b, s, hq, k.shape[2], dh,
+                                        causal, q.dtype),
+            {"block_q": bq, "block_k": bk},
+            validate=_validate_blocks(s, "flash_attention forward"))
+        bq, bk = cfg["block_q"], cfg["block_k"]
     out, lse = _fwd(q, k, v, causal=causal, block_q=bq, block_k=bk)
     return out, (q, k, v, out, lse)
 
@@ -514,8 +579,11 @@ def _flash_bwd(causal, block_q, block_k, res, g):
     q, k, v, out, lse = res
     bq, bk = _resolve_blocks(q, k, block_q, block_k,
                              candidates=_BLOCK_CANDIDATES_BWD)
+    # explicit caller blocks bind the backward too (pre-tuning
+    # behavior): only an all-default call may let the DB answer
     return _bwd_impl(q, k, v, out, lse, g, causal=causal,
-                     block_q=bq, block_k=bk)
+                     block_q=bq, block_k=bk,
+                     consult_db=block_q is None and block_k is None)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

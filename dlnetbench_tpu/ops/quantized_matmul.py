@@ -148,9 +148,37 @@ def _fused_matmul_kernel(x_ref, wq_ref, sx_ref, sw_ref, *refs,
                         * (sx * sw_ref[0, 0])).astype(out_ref.dtype)
 
 
+# frozen default grid blocks (the pre-tuning constants): what every
+# call without explicit blocks and without a tuning-DB hit runs on —
+# locked bit-identical by tests/test_tuning.py
+DEFAULT_BLOCKS = {"block_m": 1024, "block_n": 2048, "block_k": 2048}
+
+
+def _tuned_blocks(t: int, kdim: int, n: int, fmt: str, xdtype) -> dict:
+    """Grid blocks for an (t, kdim) @ (kdim, n) fused matmul: the
+    tuning DB's answer (dlnetbench_tpu/tuning — frozen after first
+    consult per shape key) or ``DEFAULT_BLOCKS``.  Tuned values are
+    validated positive (``fit_block`` then shrinks them to divisors
+    exactly as it does the defaults, so any positive tuned block is
+    runnable — the committed value records the search's intent, the
+    fit the shape's constraint)."""
+    from dlnetbench_tpu import tuning
+
+    def check(cfg: dict) -> None:
+        for name in DEFAULT_BLOCKS:
+            blk = cfg.get(name)
+            if not isinstance(blk, int) or blk <= 0:
+                raise ValueError(f"fused_matmul: tuned {name}={blk!r} "
+                                 f"is not a positive int")
+    return tuning.consult(
+        "quantized_matmul",
+        tuning.params.quantized_matmul_key(t, kdim, n, fmt, xdtype),
+        DEFAULT_BLOCKS, validate=check)
+
+
 def fused_matmul(x, wq, sw, sx, *, fmt: str, out_dtype=None,
-                 collect_amax: bool = False, block_m: int = 1024,
-                 block_n: int = 2048, block_k: int = 2048):
+                 collect_amax: bool = False, block_m: int | None = None,
+                 block_n: int | None = None, block_k: int | None = None):
     """[..., K] master-dtype x  @  [K, N] pre-quantized w  ->  [..., N].
 
     ``sx`` is the PROVIDED activation scale (fresh or carried), ``sw``
@@ -158,6 +186,10 @@ def fused_matmul(x, wq, sw, sx, *, fmt: str, out_dtype=None,
     the fresh amax of x rides out as a per-(row, contraction)-tile side
     output, reduced here to one scalar — the delayed-scaling state for
     the next step.  Returns ``y`` or ``(y, amax)``.
+
+    Grid blocks: explicit arguments win; with none given the tuning DB
+    is consulted per (shape, dtype, chip) key and an empty DB keeps the
+    frozen ``DEFAULT_BLOCKS`` bit-identically (ISSUE 9).
     """
     if fmt not in _FORMATS:
         raise ValueError(f"unknown quantization format {fmt!r}; "
@@ -171,9 +203,15 @@ def fused_matmul(x, wq, sw, sx, *, fmt: str, out_dtype=None,
                          f"x[..., {kdim}] @ wq[{wq.shape[0]}, {n}]")
     t = math.prod(lead) if lead else 1
     x2 = x.reshape(t, kdim)
-    bm = fit_block(t, block_m)
-    bn = fit_block(n, block_n)
-    bk = fit_block(kdim, block_k)
+    if block_m is None and block_n is None and block_k is None:
+        blocks = _tuned_blocks(t, kdim, n, fmt, x.dtype)
+    else:  # explicit caller blocks: fill gaps from the frozen defaults
+        blocks = {"block_m": block_m or DEFAULT_BLOCKS["block_m"],
+                  "block_n": block_n or DEFAULT_BLOCKS["block_n"],
+                  "block_k": block_k or DEFAULT_BLOCKS["block_k"]}
+    bm = fit_block(t, blocks["block_m"])
+    bn = fit_block(n, blocks["block_n"])
+    bk = fit_block(kdim, blocks["block_k"])
     grid = (t // bm, n // bn, kdim // bk)
 
     out_dtype = out_dtype or x.dtype

@@ -195,16 +195,70 @@ def _gather_attention(q, k_pages, v_pages, lengths, page_indices):
     return out.reshape(b, hkv * g, dh).astype(q.dtype)
 
 
+def resolve_pages_per_compute_block(q, k_pages, page_indices,
+                                    pages_per_compute_block: int | None
+                                    ) -> int:
+    """The Pallas kernel's ``pages_per_compute_block`` knob: an
+    EXPLICIT value always wins and must divide the per-sequence page
+    count exactly (an experiment knob fails loud — a silently adjusted
+    block would record a time for a config nobody asked for); ``None``
+    consults the tuning DB (dlnetbench_tpu/tuning, keyed per cache
+    geometry x chip) and falls back to the historical default
+    ``fit_block(pages_per_seq, min(pages_per_seq, 8))`` bit-identically
+    on a miss (ISSUE 9 satellite — this replaces the old inline
+    hard-code)."""
+    pages_per_seq = page_indices.shape[1]
+    if pages_per_compute_block is not None:
+        p = pages_per_compute_block
+        if not isinstance(p, int) or p < 1 or pages_per_seq % p:
+            raise ValueError(
+                f"paged_attention: pages_per_compute_block={p!r} does "
+                f"not divide pages_per_seq {pages_per_seq}")
+        return p
+    default = pallas_common.fit_block(pages_per_seq,
+                                      min(pages_per_seq, 8))
+    from dlnetbench_tpu import tuning
+
+    def check(cfg: dict) -> None:
+        p = cfg.get("pages_per_compute_block")
+        if not isinstance(p, int) or p < 1 or pages_per_seq % p:
+            raise ValueError(
+                f"pages_per_compute_block={p!r} does not divide "
+                f"pages_per_seq {pages_per_seq}")
+    b, hq, dh = q.shape
+    hkv, _, page_size, _ = k_pages.shape
+    cfg = tuning.consult(
+        "paged_attention",
+        tuning.params.paged_attention_key(pages_per_seq, page_size, b,
+                                          hq, hkv, dh),
+        {"pages_per_compute_block": default}, validate=check)
+    return cfg["pages_per_compute_block"]
+
+
 def paged_attention_decode(q, k_pages, v_pages, lengths, page_indices,
-                           *, impl: str = "auto"):
+                           *, impl: str = "auto",
+                           pages_per_compute_block: int | None = None):
     """One decode step's attention for a batch of slots.  ``impl``:
     ``auto`` picks the Pallas TPU kernel on a TPU backend and the dense
     gather fallback elsewhere (the ``pallas_common`` backend split);
     ``pallas``/``gather`` force a path.  ``q`` must be pre-scaled by
-    ``head_dim**-0.5`` — neither impl applies a softmax scale."""
+    ``head_dim**-0.5`` — neither impl applies a softmax scale.
+
+    ``pages_per_compute_block`` sizes the Pallas kernel's per-grid-lane
+    page block (tuning-consulted when None — see
+    ``resolve_pages_per_compute_block``; validated either way).  The
+    dense gather fallback computes the mathematically identical full
+    attention regardless of blocking, so results are block-invariant by
+    construction on both impls (tests/test_serving.py parity)."""
     if impl == "auto":
         impl = "gather" if pallas_common.interpret_mode() else "pallas"
     if impl == "gather":
+        if pages_per_compute_block is not None:
+            # validate even on the path that ignores it: a bad explicit
+            # knob must fail identically on every backend, not only
+            # where the Pallas kernel happens to run
+            resolve_pages_per_compute_block(q, k_pages, page_indices,
+                                            pages_per_compute_block)
         return _gather_attention(q, k_pages, v_pages, lengths,
                                  page_indices)
     if impl != "pallas":
@@ -212,16 +266,16 @@ def paged_attention_decode(q, k_pages, v_pages, lengths, page_indices,
                          f"{impl!r} (auto|pallas|gather)")
     from jax.experimental.pallas.ops.tpu.paged_attention import \
         paged_attention
-    pages_per_seq = page_indices.shape[1]
     return paged_attention(
         q, k_pages, v_pages, lengths.astype(jnp.int32),
         page_indices.astype(jnp.int32),
-        pages_per_compute_block=pallas_common.fit_block(
-            pages_per_seq, min(pages_per_seq, 8)))
+        pages_per_compute_block=resolve_pages_per_compute_block(
+            q, k_pages, page_indices, pages_per_compute_block))
 
 
 def sharded_paged_attention(mesh, axis: str = "kv",
-                            impl: str = "auto"):
+                            impl: str = "auto",
+                            pages_per_compute_block: int | None = None):
     """Shard the decode attention along GQA KV heads via ``shard_map``
     (the SNIPPETS.md [3] recipe): KV pages partition by head
     (``P(axis, None, None, None)``), query heads follow their group
@@ -232,8 +286,9 @@ def sharded_paged_attention(mesh, axis: str = "kv",
     from jax.sharding import PartitionSpec as P
 
     def fn(q, k_pages, v_pages, lengths, page_indices):
-        return paged_attention_decode(q, k_pages, v_pages, lengths,
-                                      page_indices, impl=impl)
+        return paged_attention_decode(
+            q, k_pages, v_pages, lengths, page_indices, impl=impl,
+            pages_per_compute_block=pages_per_compute_block)
 
     return shard_map(
         fn, mesh=mesh,

@@ -356,3 +356,54 @@ def test_straggler_ab_line_schema_locked(monkeypatch):
     assert line["faulted_ms"]["value"] > line["clean_ms"]["value"]
     assert 0.5 < line["value"] < 2.0  # measured amplification ~1 here
     assert line["n"] == 3
+
+
+def test_tuned_ab_line_schema_locked():
+    """bench.py's tuned-vs-frozen A/B line (ISSUE 9): the headline
+    ``value`` is the TUNED chain's median ms with {value, best, band,
+    n} bands, both variants ship sub-objects + of-peak ratios, the
+    paired per-round ratio band pairs them, band_disjoint_win states
+    the acceptance verdict, and the DB provenance (path, prior
+    hit/miss, committed configs, search meta) rides the line."""
+    import bench
+
+    summaries = {
+        "tuned": {"value": 0.010, "best": 0.009,
+                  "band": [0.009, 0.011], "n": 3},
+        "frozen": {"value": 0.020, "best": 0.019,
+                   "band": [0.019, 0.021], "n": 3},
+    }
+    rounds = {"tuned": [0.009, 0.010, 0.011],
+              "frozen": [0.019, 0.020, 0.021]}
+    line = bench._tuned_ab_line(
+        summaries, rounds, flops_per_iter=10 ** 12, roofline_s=0.008,
+        metric="tuned A/B: test", db_path="/tmp/tdb/tuning_db.jsonl",
+        configs={"up": {"block_m": 512}}, db_prior_hit={"up": False},
+        search_meta={"up": {"candidates": 3, "pruned": 1, "seed": 0}})
+    assert line["unit"] == "ms" and line["value"] == 10.0
+    assert line["band"] == [9.0, 11.0] and line["n"] == 3
+    assert line["vs_baseline"] == 0.8          # roofline / tuned
+    assert line["vs_baseline_frozen"] == 0.4   # roofline / frozen
+    for sub in ("tuned_ms", "frozen_ms"):
+        for k in ("value", "best", "band", "n"):
+            assert k in line[sub], (sub, k)
+    r = line["ratio_tuned_vs_frozen"]
+    assert r["n"] == 3 and r["value"] == 0.5
+    assert line["band_disjoint_win"] is True   # disjoint AND faster
+    assert line["db_path"].endswith("tuning_db.jsonl")
+    assert line["db_prior_hit"] == {"up": False}
+    assert line["configs"]["up"]["block_m"] == 512
+    assert line["search"]["up"]["candidates"] == 3
+    # an overlapping-band win is NOT band-disjoint
+    summaries2 = dict(summaries)
+    summaries2["frozen"] = {"value": 0.0105, "best": 0.010,
+                            "band": [0.010, 0.011], "n": 3}
+    line2 = bench._tuned_ab_line(
+        summaries2, rounds, flops_per_iter=10 ** 12, roofline_s=0.008,
+        metric="m", db_path="p", configs={}, db_prior_hit={},
+        search_meta={})
+    assert line2["band_disjoint_win"] is False
+    # sentinel comparability: bench.py --check picks it up as
+    # "tuned_ab" automatically
+    from dlnetbench_tpu.sentinel import is_ms_line
+    assert is_ms_line(line)

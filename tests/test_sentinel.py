@@ -353,3 +353,30 @@ def test_bench_check_lane(tmp_path):
     assert head["fault_plan"]["events"][0]["kind"] == "delay"
     assert head["attribution"]["bound"] == "faulted"
     assert float(head["value"]) > base_ms
+
+
+@pytest.mark.sentinel
+def test_tuned_ab_line_is_comparable():
+    """The tuned_ab aux line (ISSUE 9) rides the headline like every ms
+    line and the sentinel judges it band-aware lower-is-better: a tuned
+    chain that got slower past threshold with disjoint bands is a
+    regression; band-overlapping wobble is noise."""
+    def tuned_line(value, band):
+        return {"metric": "tuned A/B: fp8 fused swiglu, DB-tuned vs "
+                          "frozen", "value": value, "unit": "ms",
+                "best": band[0], "band": band, "n": 3,
+                "frozen_ms": {"value": 2 * value, "best": 2 * band[0],
+                              "band": [2 * b for b in band], "n": 3}}
+
+    assert sentinel.is_ms_line(tuned_line(10.0, [9.5, 10.5]))
+    base = {"headline": _line(10.0, [9.9, 10.1]),
+            "tuned_ab": tuned_line(10.0, [9.5, 10.5])}
+    cur = {"headline": _line(10.0, [9.9, 10.1]),
+           "tuned_ab": tuned_line(20.0, [19.5, 20.5])}
+    sent = sentinel.check(base, cur)
+    assert sent["verdict"] == "regression"
+    assert sent["regressions"] == ["tuned_ab"]
+    ok = sentinel.check(base, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "tuned_ab": tuned_line(10.3, [9.8, 10.8])})
+    assert ok["verdict"] == "clean"

@@ -206,6 +206,64 @@ def test_sharded_paged_attention_matches_unsharded(eight_devices):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_paged_attention_block_parity_and_validation(eight_devices):
+    """``pages_per_compute_block`` is a real parameter now (ISSUE 9
+    satellite — the old inline ``min(pages, 8)`` hard-code): results
+    are identical across 3 explicit block values (the knob sizes the
+    kernel grid, never the math — the gather impl computes full
+    attention regardless, and the tpu_only case below locks the Pallas
+    kernel to the same contract), it flows through
+    ``sharded_paged_attention``, and a non-divisor is refused loudly on
+    every impl."""
+    from dlnetbench_tpu.parallel.mesh import make_flat_mesh
+
+    q = jax.random.normal(jax.random.key(7), (3, 4, 8))
+    kp = jax.random.normal(jax.random.key(8), (2, 16, 4, 8))
+    vp = jax.random.normal(jax.random.key(9), (2, 16, 4, 8))
+    lengths = jnp.asarray([5, 9, 2], jnp.int32)
+    pidx = jnp.asarray(np.arange(18).reshape(3, 6) % 16, jnp.int32)
+    ref = paged_attention_decode(q, kp, vp, lengths, pidx,
+                                 impl="gather")
+    for blk in (1, 2, 6):          # 3 divisors of pages_per_seq=6
+        got = paged_attention_decode(q, kp, vp, lengths, pidx,
+                                     impl="gather",
+                                     pages_per_compute_block=blk)
+        assert jnp.array_equal(got, ref), blk
+    # flows through the sharded wrapper unchanged
+    mesh = make_flat_mesh(devices=eight_devices[:2], axis="kv")
+    got = sharded_paged_attention(mesh, impl="gather",
+                                  pages_per_compute_block=2)(
+        q, kp, vp, lengths, pidx)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    # a non-divisor fails LOUD (experiment-knob convention), every impl
+    with pytest.raises(ValueError, match="does not divide"):
+        paged_attention_decode(q, kp, vp, lengths, pidx, impl="gather",
+                               pages_per_compute_block=4)
+
+
+@pytest.mark.tpu_only
+def test_pallas_paged_attention_block_parity():
+    """On-chip: the Pallas kernel itself across 3 block values — the
+    knob moves the grid, never the numbers."""
+    q = jax.random.normal(jax.random.key(7), (4, 8, 128), jnp.float32)
+    kp = jax.random.normal(jax.random.key(8), (2, 32, 16, 128),
+                           jnp.float32)
+    vp = jax.random.normal(jax.random.key(9), (2, 32, 16, 128),
+                           jnp.float32)
+    lengths = jnp.asarray([40, 128, 16, 70], jnp.int32)
+    pidx = jnp.asarray(np.arange(4 * 8).reshape(4, 8) % 32, jnp.int32)
+    ref = paged_attention_decode(q, kp, vp, lengths, pidx,
+                                 impl="pallas",
+                                 pages_per_compute_block=8)
+    for blk in (1, 2, 4):
+        got = paged_attention_decode(q, kp, vp, lengths, pidx,
+                                     impl="pallas",
+                                     pages_per_compute_block=blk)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
 @pytest.mark.tpu_only
 def test_pallas_paged_attention_matches_gather():
     """On-chip: the Pallas paged_attention kernel against the gather
