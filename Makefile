@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test check-bench check-resilience check-serving check-tuning \
-	sentinel-scan
+	check-longcontext sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -59,6 +59,20 @@ check-tuning:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
 	    tests/test_bench_aux.py::test_tuned_ab_line_schema_locked \
 	    tests/test_sentinel.py::test_tuned_ab_line_is_comparable
+
+# the long-context lane (docs/PERF.md r13 "Block-sparse attention"):
+# mask-builder verdict tables vs brute force, splash-vs-dense kernel
+# parity (causal bit-identity + masked specs), sparse ring hop gating
+# vs the gathered reference, the windowed serving prefill parity, and
+# the longcontext_ab bench-line schema + sentinel comparability.  The
+# S=64k cases live in the slow lane (pytest -m 'longcontext and slow').
+# ~1 min wall.
+check-longcontext:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m 'longcontext and not slow' \
+	    tests/
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
+	    tests/test_bench_aux.py::test_longcontext_line_schema_locked \
+	    tests/test_sentinel.py::test_longcontext_line_is_comparable
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

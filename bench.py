@@ -528,7 +528,7 @@ def _run_bench(args, tracer) -> int:
     if args.skip_aux:
         fp8 = fp8_chain = int8 = int8_ab = fp8_ab = None
         straggler = ckpt_ab = int8_step = int8_sb = overlap_ab = None
-        serving = tuned_ab = None
+        serving = tuned_ab = longcontext = None
     else:
         fp8 = _aux("fp8 mlp matmul", _bench_fp8_mlp, card, hw_key, dev)
         fp8_chain = _aux("fp8 swiglu chain", _bench_fp8_swiglu_chain,
@@ -555,6 +555,11 @@ def _run_bench(args, tracer) -> int:
         # cheap (tiny decode engine, one compile, 3 replayed rounds):
         # the serving tier's latency line — TTFT/TPOT/e2e-p99 bands
         serving = _aux("serving decode", _bench_serving_decode)
+        # the ISSUE-10 long-context evidence: dense-vs-splash paired
+        # rounds at S=64k under causal/window/segment masks — four
+        # attention-only compiles, bounded by the shared aux deadline
+        longcontext = _aux("longcontext A/B", _bench_longcontext_ab,
+                           card, hw_key, dev)
         # LAST among the aux lines: they are the most expensive (a full
         # train-step compile+measure each) and the only ones with a
         # known backend-poisoning failure mode (the r5 composed-VJP
@@ -611,6 +616,7 @@ def _run_bench(args, tracer) -> int:
         **({"straggler_ab": straggler} if straggler else {}),
         **({"checkpoint_ab": ckpt_ab} if ckpt_ab else {}),
         **({"serving_decode": serving} if serving else {}),
+        **({"longcontext_ab": longcontext} if longcontext else {}),
         **({"spmd_overlap_ab": overlap_ab} if overlap_ab else {}),
         **({"int8_step": int8_step} if int8_step else {}),
         **({"int8_switchback_step": int8_sb} if int8_sb else {}),
@@ -1434,6 +1440,167 @@ def _bench_quant_fused_ab(card, hw_key: str, dev, fmt: str) -> dict | None:
     line = _stamp_attr(line, time_s=summaries["fused"]["value"],
                        flops=flops, nbytes=nbytes, hw=hw,
                        dtype_key=peak_key)
+    print(json.dumps(line))
+    return line
+
+
+def _longcontext_line(summaries_s: dict, round_times_s: dict, *,
+                      metric: str, mask_info: dict) -> dict:
+    """Assemble the dense-vs-splash long-context A/B JSON line (pure —
+    tests/test_bench_aux.py locks this schema).  The headline ``value``
+    is the WINDOW-masked splash median ms (the production long-context
+    recipe; lower-is-better, so the sentinel compares it like every ms
+    line); every variant ships its artifact-grade ``{value, best,
+    band, n}`` sub-object, masked variants a paired per-round ratio
+    band vs dense, and ``speedup_vs_sparsity`` states measured speedup
+    over the mask's block-accounting expectation (1.0 = the win is
+    exactly the skipped work; ``mask_info`` carries each mask's spec
+    label, sparsity_fraction and block skip stats as comparable
+    globals)."""
+    win = summaries_s["splash_window"]
+    dense_rounds = round_times_s["dense"]
+    line = {
+        "metric": metric,
+        "value": round(win["value"] * 1e3, 3),
+        "unit": "ms",
+        **_band_ms(win),
+    }
+    for name, s in summaries_s.items():
+        line[name] = {"value": round(s["value"] * 1e3, 3), **_band_ms(s)}
+    speedup_vs_sparsity = {}
+    for name, s in summaries_s.items():
+        if name == "dense":
+            continue
+        ratios = [t / d for t, d in zip(round_times_s[name],
+                                        dense_rounds) if d > 0]
+        ratio_band = stats_mod.summarize(ratios, ndigits=4)
+        line[f"ratio_{name}_vs_dense"] = ratio_band
+        info = mask_info.get(name)
+        if info and info.get("expected_speedup") and ratio_band["value"]:
+            # measured speedup from the PAIRED per-round ratio median
+            # (the r4 protocol: only adjacent-in-time comparisons
+            # cancel the tunnel drift — unpaired medians don't)
+            measured = 1.0 / ratio_band["value"]
+            speedup_vs_sparsity[name] = round(
+                measured / info["expected_speedup"], 4)
+    line["speedup_vs_sparsity"] = speedup_vs_sparsity
+    line["masks"] = mask_info
+    # band-disjoint win of the headline (window) variant vs dense: the
+    # acceptance bar (stats.bands_overlap), stated by the artifact
+    line["band_disjoint_win"] = bool(
+        win["value"] < summaries_s["dense"]["value"]
+        and stats_mod.bands_overlap(win["band"],
+                                    summaries_s["dense"]["band"])
+        is False)
+    return stats_mod.flag_low_mode(line)
+
+
+def _bench_longcontext_ab(card, hw_key: str, dev) -> dict | None:
+    """Dense-vs-splash long-context A/B (ISSUE 10 tentpole evidence):
+    B=1 attention at S=64k (env-overridable) under causal / sliding-
+    window / document-segment masks, r4 pairing protocol — per round
+    every variant runs back-to-back, so the per-round ratios cancel
+    the tunnel drift.  The dense leg is the existing causal flash
+    kernel; the splash legs consume the BlockMask (skipped blocks
+    issue no DMA/MXU work), so the measured speedup should track each
+    mask's block-level skip fraction — the line reports the ratio."""
+    import jax.numpy as jnp
+
+    import importlib
+
+    from dlnetbench_tpu.core.hardware import HARDWARE
+    from dlnetbench_tpu.ops import attention_mask as amask
+    from dlnetbench_tpu.utils.tpu_probe import env_int
+
+    # the ops package re-exports the flash_attention FUNCTION under
+    # the module's name; import the module itself for its internals
+    fa = importlib.import_module("dlnetbench_tpu.ops.flash_attention")
+
+    hw = HARDWARE[hw_key]
+    s = env_int("DLNB_BENCH_LC_SEQ", 64 * 1024)
+    hq = env_int("DLNB_BENCH_LC_HEADS", 8)
+    hkv = env_int("DLNB_BENCH_LC_KV_HEADS", max(1, hq // 4))
+    dh = 128
+    window = env_int("DLNB_BENCH_LC_WINDOW", max(1, s // 16))
+    seg_avg = env_int("DLNB_BENCH_LC_SEG", max(1, s // 8))
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+    q = jax.random.normal(jax.random.key(20), (1, s, hq, dh), dt)
+    k = jax.random.normal(jax.random.key(21), (1, s, hkv, dh), dt)
+    v = jax.random.normal(jax.random.key(22), (1, s, hkv, dh), dt)
+
+    specs = {
+        "splash_causal": amask.MaskSpec(causal=True),
+        "splash_window": amask.MaskSpec(causal=True, window=window),
+        "splash_segment": amask.MaskSpec(causal=True, seg_avg=seg_avg,
+                                         seg_seed=0),
+    }
+    bq = fa._pick_block(s, fa._BLOCK_CANDIDATES_FWD)
+    bk = bq
+    if bq is None:
+        _skipped(f"longcontext A/B ({hw_key})",
+                 f"seq {s} has no flash block candidate")
+        return None
+
+    K = env_int("DLNB_BENCH_LC_K", 4)
+
+    def chain_of(attn):
+        def chain(q0):
+            def body(qc, _):
+                out = attn(qc)
+                # feed the output back so the attention cannot be
+                # loop-hoisted (the fp8-chain feedback convention)
+                return (qc + out * 1e-6).astype(qc.dtype), ()
+            return jax.lax.scan(body, q0, None, length=K)[0]
+        return chain
+
+    progs = {"dense": _compile_chain(
+        chain_of(lambda qc: fa.flash_attention(qc, k, v, True, bq, bk)),
+        q)}
+    for name, spec in specs.items():
+        progs[name] = _compile_chain(
+            chain_of(lambda qc, _sp=spec: fa.splash_attention(
+                qc, k, v, _sp, bq, bk)), q)
+    summaries, round_times = _measure_paired(progs, K)
+
+    # block-accounting expectations: visited blocks under each mask vs
+    # the dense-causal baseline at the SAME block sizes
+    dense_stats = amask.block_mask(specs["splash_causal"], s, bq,
+                                   bk).stats()
+    dense_visited = (dense_stats["blocks_total"]
+                     - dense_stats["blocks_skipped"])
+    mask_info = {}
+    for name, spec in specs.items():
+        st = amask.block_mask(spec, s, bq, bk).stats()
+        visited = st["blocks_total"] - st["blocks_skipped"]
+        mask_info[name] = {
+            **amask.record_globals(spec, s),
+            "block_skip_fraction": st["block_skip_fraction"],
+            "expected_speedup": round(dense_visited / max(visited, 1),
+                                      4),
+        }
+
+    # dense-causal forward flops (both matmuls, triangular half)
+    flops = 2 * s * s * hq * dh
+    line = _longcontext_line(
+        summaries, round_times,
+        metric=f"longcontext A/B: dense causal flash vs block-sparse "
+               f"splash (causal / window({window}) / segment(avg="
+               f"{seg_avg}) masks; skipped blocks issue no DMA/MXU "
+               f"work; paired interleaved rounds, fwd attention only), "
+               f"B=1 S={s} Hq={hq} Hkv={hkv} Dh={dh} blocks=({bq},"
+               f"{bk}), {dev.device_kind} ({hw_key})",
+        mask_info=mask_info)
+    win_visited_frac = 1.0 - mask_info["splash_window"][
+        "block_skip_fraction"]
+    line["tflops_dense"] = round(
+        flops / summaries["dense"]["value"] / 1e12, 2)
+    line = _stamp_attr(
+        line, time_s=summaries["splash_window"]["value"],
+        flops=flops * win_visited_frac / max(
+            1.0 - dense_stats["block_skip_fraction"], 1e-9),
+        nbytes=int(jnp.dtype(dt).itemsize * s * (2 * hq + 2 * hkv)
+                   * dh), hw=hw, dtype_key="bfloat16")
     print(json.dumps(line))
     return line
 

@@ -81,9 +81,15 @@ def rope(q, k, positions, theta=10000.0):
     return rot(q), rot(k)
 
 
-def attention(q, k, v, causal: bool):
+def attention(q, k, v, causal: bool, dense_mask=None):
     """q: [B, S, Hq, Dh], k/v: [B, S, Hkv, Dh] (GQA broadcast).
-    Softmax in fp32."""
+    Softmax in fp32.
+
+    ``dense_mask`` (an [S, S] bool, True = attend — built by
+    ops/attention_mask.dense_mask) replaces the causal tril when given:
+    it already encodes the causal half, so the two are never composed.
+    This is the reference path the block-sparse kernels are
+    parity-tested against — it pays the full S x S grid by design."""
     b, s, hq, dh = q.shape
     hkv = k.shape[2]
     group = hq // hkv
@@ -91,7 +97,10 @@ def attention(q, k, v, causal: bool):
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k,
                         preferred_element_type=_F32)
     scores = scores / jnp.sqrt(jnp.asarray(dh, _F32))
-    if causal:
+    if dense_mask is not None:
+        mask = jnp.asarray(dense_mask, bool)
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    elif causal:
         mask = jnp.tril(jnp.ones((s, s), bool))
         scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)

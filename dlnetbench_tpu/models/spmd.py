@@ -101,6 +101,17 @@ class SpmdConfig:
     #   ulysses   all_to_all to head-sharding and back; full-sequence local
     #             attention in between (flash kernel eligible)
     sp_mode: str = "megatron"
+    # Long-context attention-mask knobs (ISSUE 10; the TransformerConfig
+    # trio mirrored): a sliding window and/or a seeded document-segment
+    # plan.  megatron/ulysses modes apply the mask on the gathered
+    # sequence (splash kernels on TPU, dense-masked reference on the
+    # CPU mesh); ring mode additionally SKIPS whole ring hops whose
+    # (my queries x remote keys) tile the mask kills — the ppermute
+    # still runs, and the skipped-hop fraction is reported via
+    # ``ring_hop_stats`` (the overlap-fraction metric's sibling).
+    attention_window: int = 0
+    attention_seg_avg: int = 0
+    attention_seg_seed: int = 0
     # How the TP-block collectives execute (megatron QKV/out projections
     # and the vocab-parallel head):
     #   none        blocking all_gather / psum_scatter around plain dots
@@ -184,6 +195,26 @@ class SpmdConfig:
     def jdtype(self):
         return jnp.dtype(self.dtype)
 
+    @property
+    def mask_spec(self):
+        """The attention MaskSpec these knobs declare, or None for the
+        dense-causal default (bit-identical pre-mask behavior) — the
+        shared TransformerConfig mapping (MaskSpec.from_knobs)."""
+        from dlnetbench_tpu.ops.attention_mask import MaskSpec
+        return MaskSpec.from_knobs(self.attention_window,
+                                   self.attention_seg_avg,
+                                   self.attention_seg_seed)
+
+    def ring_hop_stats(self, tp: int) -> dict:
+        """Skipped-hop accounting for sp_mode='ring' on a tp-wide ring
+        (host-side, plan-derived — the record stamps it next to the
+        mask globals; ops/attention_mask.ring_skipped_hop_fraction)."""
+        from dlnetbench_tpu.ops import attention_mask as amask
+        frac = amask.ring_skipped_hop_fraction(self.mask_spec,
+                                               self.seq_len, tp)
+        return {"ring_hops": tp * tp,
+                "ring_skipped_hop_fraction": round(frac, 6)}
+
     def validate(self, dp: int, pp: int, tp: int) -> None:
         # ring keeps all heads local, so head divisibility only binds the
         # modes that shard heads over tp (megatron statically, ulysses via
@@ -200,6 +231,8 @@ class SpmdConfig:
              f"unknown grad_sync {self.grad_sync!r}"),
             (self.grad_bucket_layers is None or
              self.grad_bucket_layers >= 1, "grad_bucket_layers < 1"),
+            (self.attention_window >= 0, "attention_window < 0"),
+            (self.attention_seg_avg >= 0, "attention_seg_avg < 0"),
             (self.num_layers % pp == 0, "layers % pp != 0"),
             (self.batch % (dp * self.num_microbatches) == 0,
              "batch % (dp*microbatches) != 0"),
@@ -409,7 +442,8 @@ def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions, comm_on=True,
         if compute_on:
             q, k = Lyr.rope(q, k, positions)
             att = ops.attention(q, k, v, causal=True,
-                                impl=cfg.attention_impl).reshape(
+                                impl=cfg.attention_impl,
+                                mask=cfg.mask_spec).reshape(
                 mb, s_full, d // tp)
         else:
             att = CM.comm_stub((mb, s_full, d // tp), q.dtype, q, k, v)
@@ -441,13 +475,16 @@ def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions, comm_on=True,
         v = jnp.dot(y, lp["wv"]).reshape(mb, s_loc, cfg.num_kv_heads, dh)
         q, k = Lyr.rope(q, k, positions)
         if tp > 1 and cfg.sp_mode == "ring":
-            att = SP.ring_attention(q, k, v, AXIS_TP, causal=True)
+            att = SP.ring_attention(q, k, v, AXIS_TP, causal=True,
+                                    spec=cfg.mask_spec)
         elif tp > 1 and cfg.sp_mode == "ulysses":
             att = SP.ulysses_attention(q, k, v, AXIS_TP, causal=True,
-                                       impl=cfg.attention_impl)
+                                       impl=cfg.attention_impl,
+                                       spec=cfg.mask_spec)
         else:   # tp == 1: plain local attention
             att = ops.attention(q, k, v, causal=True,
-                                impl=cfg.attention_impl)
+                                impl=cfg.attention_impl,
+                                mask=cfg.mask_spec)
         out = jnp.dot(att.reshape(mb, s_loc, d), lp["wo"])
     x = x + out
 

@@ -56,6 +56,19 @@ class TransformerConfig:
                              # no-remat OOM source), at the price of
                              # recomputing 3 MLP matmuls per layer
     attention_impl: str = "auto"   # ops.attention dispatch: auto | flash | xla
+    attention_window: int = 0      # sliding-window attention: each token
+                             # attends its W most recent tokens (itself
+                             # included); 0 = full causal.  Dispatches
+                             # the block-sparse splash kernels on TPU
+                             # (ops/attention_mask.py MaskSpec) and the
+                             # dense-masked reference on the CPU mesh
+    attention_seg_avg: int = 0     # document-segment masking: tokens are
+                             # partitioned into documents by the seeded
+                             # segment plan (splitmix64 lengths around
+                             # this average); attention never crosses a
+                             # document boundary.  0 = off
+    attention_seg_seed: int = 0    # the segment plan's seed (a plan IS
+                             # (seed, avg): replayable, committable)
     scan_layers: bool = True       # lax.scan over the layer stack (O(1)
                              # compile time in depth); False unrolls the
                              # Python loop — measured ~5% faster at 4 layers
@@ -122,6 +135,11 @@ class TransformerConfig:
                              # XLA schedule is already at the wall
 
     def __post_init__(self):
+        if self.attention_window < 0 or self.attention_seg_avg < 0:
+            raise ValueError(
+                f"attention_window={self.attention_window} / "
+                f"attention_seg_avg={self.attention_seg_avg} must be "
+                f">= 0 (0 = off)")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}; "
                              f"expected 'full' or 'dots'")
@@ -214,6 +232,16 @@ class TransformerConfig:
     @property
     def jdtype(self):
         return jnp.dtype(self.dtype)
+
+    @property
+    def mask_spec(self):
+        """The attention ``MaskSpec`` these knobs declare, or ``None``
+        for the dense-causal default (ops.attention's mask=None path —
+        bit-identical to the pre-mask harness)."""
+        from dlnetbench_tpu.ops.attention_mask import MaskSpec
+        return MaskSpec.from_knobs(self.attention_window,
+                                   self.attention_seg_avg,
+                                   self.attention_seg_seed)
 
 
 
@@ -309,8 +337,8 @@ def _block(cfg: TransformerConfig, x, lp, positions, qs_row=None):
     v = jnp.dot(y, lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
     if not cfg.max_positions:  # RoPE family
         q, k = L.rope(q, k, positions)
-    att = ops.attention(q, k, v, causal=True,
-                        impl=cfg.attention_impl).reshape(b, s, d)
+    att = ops.attention(q, k, v, causal=True, impl=cfg.attention_impl,
+                        mask=cfg.mask_spec).reshape(b, s, d)
     x = x + jnp.dot(att, lp["wo"])
 
     if cfg.gated:

@@ -17,12 +17,16 @@ from __future__ import annotations
 import jax
 
 from dlnetbench_tpu.models import layers as _L
+from dlnetbench_tpu.ops import attention_mask as _M
 from dlnetbench_tpu.ops.flash_attention import (
+    LONG_SEQ,
     flash_attention,
     flash_supported,
+    splash_attention,
 )
 
-__all__ = ["attention", "flash_attention", "flash_supported"]
+__all__ = ["attention", "flash_attention", "flash_supported",
+           "splash_attention"]
 
 # Measured on a v5e chip (llama3_8b-shaped 4-layer train step, remat on):
 # flash loses ~2% at S=1024 (attention is a sliver of the step and the
@@ -31,20 +35,64 @@ __all__ = ["attention", "flash_attention", "flash_supported"]
 _AUTO_MIN_SEQ = 2048
 
 
-def attention(q, k, v, causal: bool, impl: str = "auto"):
+def _dense_mask_np(spec: _M.MaskSpec, s: int):
+    """Host-side dense mask for the reference path.  Deliberately NOT
+    cached: jit tracing already folds it into the compiled computation
+    once per shape, and pinning [S, S] bool arrays for the process
+    lifetime would only duplicate XLA's copy (the underlying row
+    intervals ARE cached — rebuilding is one O(S^2) broadcast)."""
+    return _M.dense_mask(spec, s)
+
+
+def attention(q, k, v, causal: bool, impl: str = "auto", mask=None):
     """q: [B, S, Hq, Dh], k/v: [B, S, Hkv, Dh] -> [B, S, Hq, Dh].
 
     impl: "flash" (Pallas kernel, error if unsupported shape),
     "xla" (einsum reference), or "auto" (flash on TPU when the shape
     qualifies, xla otherwise — CPU interpret-mode flash is for tests).
+
+    ``mask`` (a ``MaskSpec``, ops/attention_mask.py) turns on the
+    block-sparse path: "flash" dispatches the splash kernels (skipped
+    blocks cost no DMA/MXU work), "xla" applies the SAME mask densely
+    (the CPU-mesh reference the sparse paths are parity-tested
+    against).  The spec's ``causal`` must agree with the ``causal``
+    argument — a silent disagreement would A/B two different maths.
     """
+    s = q.shape[1]
+    if mask is not None:
+        if mask.causal != causal:
+            raise ValueError(
+                f"attention: mask spec {mask.label()!r} has "
+                f"causal={mask.causal} but the call says causal={causal}")
+        if mask.is_plain_causal:
+            mask = None   # the dense-causal default IS this mask
     if impl == "xla":
+        if mask is not None:
+            return _L.attention(q, k, v, causal=causal,
+                                dense_mask=_dense_mask_np(mask, s))
         return _L.attention(q, k, v, causal=causal)
     if impl == "flash":
+        if mask is not None:
+            return splash_attention(q, k, v, mask)
         return flash_attention(q, k, v, causal=causal)
     if impl != "auto":
         raise ValueError(f"unknown attention impl {impl!r}")
-    if (jax.default_backend() == "tpu" and q.shape[1] >= _AUTO_MIN_SEQ
-            and flash_supported(q, k, v)):
+    supported = flash_supported(q, k, v)   # raises at S>=64k w/o blocks
+    if (jax.default_backend() == "tpu" and s >= _AUTO_MIN_SEQ
+            and supported):
+        if mask is not None:
+            return splash_attention(q, k, v, mask)
         return flash_attention(q, k, v, causal=causal)
+    if s >= LONG_SEQ:
+        # the dense fallback at 64k+ materializes the S^2 score matrix
+        # — never a sane degradation (ISSUE 10 satellite: fail loud,
+        # naming the length; impl="xla" stays available explicitly)
+        raise ValueError(
+            f"attention: impl='auto' refuses the dense fallback at "
+            f"seq_len {s} >= {LONG_SEQ} (the S^2 score matrix would "
+            f"materialize); use the flash/splash path on TPU or pass "
+            f"impl='xla' explicitly")
+    if mask is not None:
+        return _L.attention(q, k, v, causal=causal,
+                            dense_mask=_dense_mask_np(mask, s))
     return _L.attention(q, k, v, causal=causal)

@@ -37,10 +37,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dlnetbench_tpu.ops import attention_mask as amask
 from dlnetbench_tpu.ops import pallas_common
 
 _F32 = pallas_common.F32
-_LANES = 128                 # TPU lane width; head dim padded to this
+_LANES = pallas_common.LANES  # TPU lane width; head dim padded to this
 _SUBLANES = 8                # fp32 sublane tile: row vectors (lse, D) are
                              # stored (B, H, 8, S) so blocks are (8, block_q)
 _NEG_INF = -1e30             # finite "-inf": keeps masked rows NaN-free
@@ -76,10 +77,25 @@ def _compiler_params():
         vmem_limit_mb=64)
 
 
+# At and beyond this length the dense-attention fallback materializes a
+# >= 4-billion-entry score matrix — the silent degradation is ALWAYS a
+# bug, so block resolution fails loud instead of returning "unsupported"
+# (ops/__init__.py's auto dispatcher would otherwise quietly hand a 64k
+# sequence to the einsum path; pallas_common.fit_block has the same
+# guard for its matmul-family callers).
+LONG_SEQ = 64 * 1024
+
+
 def _pick_block(seq_len: int, candidates=_BLOCK_CANDIDATES) -> int | None:
     for b in candidates:
         if seq_len % b == 0 and seq_len >= b:
             return b
+    if seq_len >= LONG_SEQ:
+        raise ValueError(
+            f"flash/splash attention: no block candidate in {candidates} "
+            f"divides seq_len {seq_len}, and at S >= {LONG_SEQ} the dense "
+            f"fallback would materialize the S^2 score matrix — pad the "
+            f"sequence to a multiple of {min(candidates)}")
     return None
 
 
@@ -587,3 +603,437 @@ def _flash_bwd(causal, block_q, block_k, res, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------- splash (block-sparse)
+# The masked generalization of the kernels above (ISSUE 10): a host-
+# precomputed BlockMask (ops/attention_mask.py) drives the grid through
+# scalar-prefetch arrays —
+#   * SKIP blocks issue no MXU work (``pl.when`` off) and no DMA (the
+#     BlockSpec index maps clamp into the visit range, so out-of-range
+#     grid steps revisit the previous block and copy nothing — the same
+#     trick the causal kernels use for the fully-masked tail),
+#   * FULL blocks skip the in-register mask apply,
+#   * PARTIAL blocks mask against the row intervals [lo[q], hi[q]]
+#     (two compares — causal, window and segment semantics all reduce
+#     to the interval form).
+# With the plain-causal spec the visit set, the mask booleans and every
+# arithmetic op match the dense kernels exactly, so splash is
+# bit-identical to ``flash_attention(causal=True)`` — locked by
+# tests/test_flash_attention.py.
+
+def _splash_prefetch(bm):
+    """The 4 per-q-block int32 prefetch arrays of a BlockMask (fwd/dq
+    grids): visit range + FULL-detection bounds."""
+    return (jnp.asarray(bm.q_first_k), jnp.asarray(bm.q_last_k),
+            jnp.asarray(bm.blk_lo_max), jnp.asarray(bm.blk_hi_min))
+
+
+def _row_i32(arr, s: int):
+    """[S] int32 -> the kernels' (SUBLANES, S) row-vector layout."""
+    return jnp.broadcast_to(jnp.asarray(arr, jnp.int32)[None, :],
+                            (_SUBLANES, s))
+
+
+def _interval_mask(s, lo, hi, j, block_q: int, block_k: int):
+    """Mask score block ``s`` against the row intervals: key column k
+    allowed iff lo[q] <= k <= hi[q].  ``lo``/``hi``: [bq] int32 (this
+    q block's rows)."""
+    ki = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    keep = (ki >= lo[:, None]) & (ki <= hi[:, None])
+    return jnp.where(keep, s, _NEG_INF)
+
+
+def _splash_fwd_kernel(first_ref, last_ref, lomax_ref, himin_ref,
+                       q_ref, k_ref, v_ref, lo_ref, hi_ref,
+                       o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                       *, scale: float, block_q: int, block_k: int):
+    i = pl.program_id(2)      # q block
+    j = pl.program_id(3)      # kv block
+    fj, lj = first_ref[i], last_ref[i]
+
+    @pl.when(j == fj)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    work = (j >= fj) & (j <= lj)
+    full = ((lomax_ref[i] <= j * block_k)
+            & (himin_ref[i] >= (j + 1) * block_k - 1))
+
+    def _step(masked: bool):
+        q = q_ref[0].astype(_F32) * (scale * _LOG2E)      # [bq, dh]
+        k = k_ref[0]                                      # [bk, dh]
+        s = jax.lax.dot_general(
+            q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=_F32)                  # [bq, bk]
+        if masked:
+            s = _interval_mask(s, lo_ref[0], hi_ref[0], j,
+                               block_q, block_k)
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=_F32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    # FULL blocks skip the in-register mask apply; the two bodies are
+    # otherwise the same code (identical float results when the mask is
+    # all-true, which is what keeps causal-spec splash bit-identical)
+    pl.when(work & full)(lambda: _step(False))
+    pl.when(work & ~full)(lambda: _step(True))
+
+    @pl.when(j == lj)
+    def _emit():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse = (m_ref[:, 0] + jnp.log2(l[:, 0])) / _LOG2E
+        lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[2:])
+
+
+def _splash_fwd(q, k, v, spec, *, block_q: int, block_k: int):
+    b, s, hq, dh = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    scale = 1.0 / (dh ** 0.5)
+    dh_p = _LANES
+    bm = amask.block_mask(spec, s, block_q, block_k)
+
+    qt, kt, vt = (_to_bsf(x, dh_p) for x in (q, k, v))
+    nq, nk = s // block_q, s // block_k
+
+    def kv_index(bi, h, i, j, first_ref, last_ref, lomax_ref, himin_ref):
+        # clamp into the visit range: out-of-range steps revisit the
+        # nearest visited block, so skipped KV copies no bytes
+        j = jnp.clip(j, first_ref[i], last_ref[i])
+        return (bi, j, h // group)
+
+    def q_index(bi, h, i, j, *_refs):
+        return (bi, i, h)
+
+    def row_index(bi, h, i, j, *_refs):
+        return (0, i)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh_p), q_index),
+            pl.BlockSpec((1, block_k, dh_p), kv_index),
+            pl.BlockSpec((1, block_k, dh_p), kv_index),
+            pl.BlockSpec((_SUBLANES, block_q), row_index),
+            pl.BlockSpec((_SUBLANES, block_q), row_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dh_p), q_index),
+            pl.BlockSpec((1, 1, _SUBLANES, block_q),
+                         lambda bi, h, i, j, *_r: (bi, h, 0, i)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, dh_p), _F32),
+            pltpu.VMEM((block_q, _LANES), _F32),
+            pltpu.VMEM((block_q, _LANES), _F32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        functools.partial(_splash_fwd_kernel, scale=scale,
+                          block_q=block_q, block_k=block_k),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, hq * dh_p), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, _SUBLANES, s), _F32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(*_splash_prefetch(bm), qt, kt, vt,
+      _row_i32(bm.lo, s), _row_i32(bm.hi, s))
+    return _from_bsf(out, hq, dh), lse
+
+
+def _splash_dq_kernel(first_ref, last_ref, lomax_ref, himin_ref,
+                      q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
+                      lo_ref, hi_ref, dq_ref, dq_acc,
+                      *, scale: float, block_q: int, block_k: int):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    fj, lj = first_ref[i], last_ref[i]
+
+    @pl.when(j == fj)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    work = (j >= fj) & (j <= lj)
+    full = ((lomax_ref[i] <= j * block_k)
+            & (himin_ref[i] >= (j + 1) * block_k - 1))
+
+    def _step(masked: bool):
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            (q_ref[0].astype(_F32) * scale).astype(k.dtype), k,
+            (((1,), (1,)), ((), ())), preferred_element_type=_F32)
+        if masked:
+            s = _interval_mask(s, lo_ref[0], hi_ref[0], j,
+                               block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0, 0][:, None])
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=_F32)
+        ds = p * (dp - dcap_ref[0, 0, 0][:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=_F32)
+
+    pl.when(work & full)(lambda: _step(False))
+    pl.when(work & ~full)(lambda: _step(True))
+
+    @pl.when(j == lj)
+    def _emit():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _splash_dkv_kernel(firsti_ref, lasti_ref, lomax_ref, himin_ref,
+                       q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
+                       lo_ref, hi_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                       *, scale: float, block_q: int, block_k: int):
+    j = pl.program_id(2)      # kv block (outer)
+    i = pl.program_id(3)      # q block (inner / minor)
+    fi, li = firsti_ref[j], lasti_ref[j]
+
+    @pl.when(i == fi)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    work = (i >= fi) & (i <= li)
+    full = ((lomax_ref[i] <= j * block_k)
+            & (himin_ref[i] >= (j + 1) * block_k - 1))
+
+    def _step(masked: bool):
+        k = k_ref[0]
+        q = q_ref[0]
+        s = jax.lax.dot_general(
+            (q.astype(_F32) * scale).astype(k.dtype), k,
+            (((1,), (1,)), ((), ())), preferred_element_type=_F32)
+        if masked:
+            s = _interval_mask(s, lo_ref[0], hi_ref[0], j,
+                               block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0, 0][:, None])
+        do = do_ref[0]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=_F32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=_F32)
+        ds = p * (dp - dcap_ref[0, 0, 0][:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=_F32)
+
+    pl.when(work & full)(lambda: _step(False))
+    pl.when(work & ~full)(lambda: _step(True))
+
+    @pl.when(i == li)
+    def _emit():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _splash_bwd_impl(q, k, v, out, lse, do, spec, *,
+                     block_q: int, block_k: int, override_blocks=None,
+                     consult_db: bool = True):
+    (bq_dq, bk_dq), (bq_dkv, bk_dkv) = (
+        override_blocks if override_blocks is not None
+        else _resolve_splash_bwd_blocks(q, k, spec, block_q, block_k,
+                                        consult_db=consult_db))
+    b, s, hq, dh = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    scale = 1.0 / (dh ** 0.5)
+    dh_p = _LANES
+
+    qt, kt, vt = (_to_bsf(x, dh_p) for x in (q, k, v))
+    dot = _to_bsf(do, dh_p)
+    ot = _to_bsf(out, dh_p)
+    dcap = jnp.sum((dot.astype(_F32) * ot.astype(_F32))
+                   .reshape(b, s, hq, dh_p), axis=-1)
+    dcap = jnp.broadcast_to(jnp.swapaxes(dcap, 1, 2)[:, :, None, :],
+                            (b, hq, _SUBLANES, s))
+
+    # dq kernel: per-q-block visit ranges at ITS block shape
+    bm_dq = amask.block_mask(spec, s, bq_dq, bk_dq)
+    nq, nk = s // bq_dq, s // bk_dq
+
+    def kv_index(bi, h, i, j, first_ref, last_ref, *_r):
+        j = jnp.clip(j, first_ref[i], last_ref[i])
+        return (bi, j, h // group)
+
+    def q_index(bi, h, i, j, *_r):
+        return (bi, i, h)
+
+    def row_index(bi, h, i, j, *_r):
+        return (bi, h, 0, i)
+
+    def mrow_index(bi, h, i, j, *_r):
+        return (0, i)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq_dq, dh_p), q_index),
+            pl.BlockSpec((1, bk_dq, dh_p), kv_index),
+            pl.BlockSpec((1, bk_dq, dh_p), kv_index),
+            pl.BlockSpec((1, bq_dq, dh_p), q_index),
+            pl.BlockSpec((1, 1, _SUBLANES, bq_dq), row_index),
+            pl.BlockSpec((1, 1, _SUBLANES, bq_dq), row_index),
+            pl.BlockSpec((_SUBLANES, bq_dq), mrow_index),
+            pl.BlockSpec((_SUBLANES, bq_dq), mrow_index),
+        ],
+        out_specs=pl.BlockSpec((1, bq_dq, dh_p), q_index),
+        scratch_shapes=[pltpu.VMEM((bq_dq, dh_p), _F32)],
+    )
+    dq = pl.pallas_call(
+        functools.partial(_splash_dq_kernel, scale=scale,
+                          block_q=bq_dq, block_k=bk_dq),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, hq * dh_p), q.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(*_splash_prefetch(bm_dq), qt, kt, vt, dot, lse, dcap,
+      _row_i32(bm_dq.lo, s), _row_i32(bm_dq.hi, s))
+
+    # dk/dv kernel: transposed visit ranges (per-kv-block q range) at
+    # its own block shape; the minor grid axis walks q blocks
+    bm_t = amask.block_mask(spec, s, bq_dkv, bk_dkv)
+    nq_t, nk_t = s // bq_dkv, s // bk_dkv
+
+    def i_clamped(j, i, firsti_ref, lasti_ref):
+        return jnp.clip(i, firsti_ref[j], lasti_ref[j])
+
+    def q_index_t(bi, h, j, i, firsti_ref, lasti_ref, *_r):
+        return (bi, i_clamped(j, i, firsti_ref, lasti_ref), h)
+
+    def kv_index_t(bi, h, j, i, *_r):
+        return (bi, j, h // group)
+
+    def kv_out_t(bi, h, j, i, *_r):
+        return (bi, j, h)
+
+    def row_index_t(bi, h, j, i, firsti_ref, lasti_ref, *_r):
+        return (bi, h, 0, i_clamped(j, i, firsti_ref, lasti_ref))
+
+    def mrow_index_t(bi, h, j, i, firsti_ref, lasti_ref, *_r):
+        return (0, i_clamped(j, i, firsti_ref, lasti_ref))
+
+    grid_spec_t = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, hq, nk_t, nq_t),
+        in_specs=[
+            pl.BlockSpec((1, bq_dkv, dh_p), q_index_t),
+            pl.BlockSpec((1, bk_dkv, dh_p), kv_index_t),
+            pl.BlockSpec((1, bk_dkv, dh_p), kv_index_t),
+            pl.BlockSpec((1, bq_dkv, dh_p), q_index_t),
+            pl.BlockSpec((1, 1, _SUBLANES, bq_dkv), row_index_t),
+            pl.BlockSpec((1, 1, _SUBLANES, bq_dkv), row_index_t),
+            pl.BlockSpec((_SUBLANES, bq_dkv), mrow_index_t),
+            pl.BlockSpec((_SUBLANES, bq_dkv), mrow_index_t),
+        ],
+        out_specs=[pl.BlockSpec((1, bk_dkv, dh_p), kv_out_t),
+                   pl.BlockSpec((1, bk_dkv, dh_p), kv_out_t)],
+        scratch_shapes=[pltpu.VMEM((bk_dkv, dh_p), _F32),
+                        pltpu.VMEM((bk_dkv, dh_p), _F32)],
+    )
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_splash_dkv_kernel, scale=scale,
+                          block_q=bq_dkv, block_k=bk_dkv),
+        grid_spec=grid_spec_t,
+        out_shape=[jax.ShapeDtypeStruct((b, s, hq * dh_p), k.dtype),
+                   jax.ShapeDtypeStruct((b, s, hq * dh_p), v.dtype)],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(jnp.asarray(bm_t.kv_first_q), jnp.asarray(bm_t.kv_last_q),
+      jnp.asarray(bm_t.blk_lo_max), jnp.asarray(bm_t.blk_hi_min),
+      qt, kt, vt, dot, lse, dcap,
+      _row_i32(bm_t.lo, s), _row_i32(bm_t.hi, s))
+
+    dk = dk_h.reshape(b, s, hkv, group, dh_p).sum(axis=3)
+    dv = dv_h.reshape(b, s, hkv, group, dh_p).sum(axis=3)
+    return (_from_bsf(dq, hq, dh),
+            dk[..., :dh].astype(k.dtype),
+            dv[..., :dh].astype(v.dtype))
+
+
+def _resolve_splash_bwd_blocks(q, k, spec, bq: int, bk: int,
+                               consult_db: bool = True):
+    """Splash backward per-kernel blocks, same precedence as the dense
+    path (``_resolve_bwd_blocks``): the frozen env knob first, then —
+    only for all-default calls — the tuning DB under the MASK-labeled
+    ``splash_bwd`` key (sparsity changes the live set, so splash and
+    dense optima are distinct records), then (bq, bk) for both."""
+    b, s, hq, _ = q.shape
+    env = _bwd_blocks_override(bq, bk, s)
+    if env is not None:
+        return env
+    if not consult_db:
+        return (bq, bk), (bq, bk)
+    from dlnetbench_tpu import tuning
+    cfg = tuning.consult(
+        "splash_bwd",
+        tuning.params.splash_key(b, s, hq, k.shape[2], q.shape[3],
+                                 spec.label(), q.dtype),
+        {"bq_dq": bq, "bk_dq": bk, "bq_dkv": bq, "bk_dkv": bk},
+        validate=_validate_blocks(s, "splash_attention backward"))
+    return ((cfg["bq_dq"], cfg["bk_dq"]), (cfg["bq_dkv"], cfg["bk_dkv"]))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def splash_attention(q, k, v, spec, block_q: int | None = None,
+                     block_k: int | None = None):
+    """Block-sparse masked attention; same tensor contract as
+    ``flash_attention``, with a static ``MaskSpec``
+    (ops/attention_mask.py) instead of the ``causal`` flag.  The
+    plain-causal spec is bit-identical (fwd and grads) to
+    ``flash_attention(causal=True)``."""
+    out, _ = _splash_vjp_fwd(q, k, v, spec, block_q, block_k)
+    return out
+
+
+def _splash_vjp_fwd(q, k, v, spec, block_q, block_k):
+    bq, bk = _resolve_blocks(q, k, block_q, block_k,
+                             candidates=_BLOCK_CANDIDATES_FWD)
+    if block_q is None and block_k is None:
+        # all-default call: the tuning DB may answer (splash blocks are
+        # their own PR-9 site, keyed per shape x mask label — the mask
+        # changes which blocks even run, so dense records never answer)
+        from dlnetbench_tpu import tuning
+        b, s, hq, dh = q.shape
+        cfg = tuning.consult(
+            "splash_fwd",
+            tuning.params.splash_key(b, s, hq, k.shape[2], dh,
+                                     spec.label(), q.dtype),
+            {"block_q": bq, "block_k": bk},
+            validate=_validate_blocks(s, "splash_attention forward"))
+        bq, bk = cfg["block_q"], cfg["block_k"]
+    out, lse = _splash_fwd(q, k, v, spec, block_q=bq, block_k=bk)
+    return out, (q, k, v, out, lse)
+
+
+def _splash_vjp_bwd(spec, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    bq, bk = _resolve_blocks(q, k, block_q, block_k,
+                             candidates=_BLOCK_CANDIDATES_BWD)
+    return _splash_bwd_impl(q, k, v, out, lse, g, spec,
+                            block_q=bq, block_k=bk,
+                            consult_db=block_q is None and block_k is None)
+
+
+splash_attention.defvjp(_splash_vjp_fwd, _splash_vjp_bwd)

@@ -54,13 +54,34 @@ def compiler_params(dimension_semantics,
         vmem_limit_bytes=vmem_limit_mb * 1024 * 1024)
 
 
+# At and beyond this size a degenerate block choice stops being a perf
+# wrinkle and becomes a pathology: a 64k+ dim tiled below one lane width
+# means a >= 512-program grid of sub-MXU blocks (or, for the attention
+# dispatcher, a silent fall-through to an S^2 dense path).  Mirrors
+# flash_attention.LONG_SEQ — ISSUE 10 satellite.
+LONG_DIM = 64 * 1024
+
+# TPU lane width: the smallest block that still fills an MXU/VPU lane
+# tile (flash_attention._LANES is this same constant)
+LANES = 128
+
+
 def fit_block(dim: int, block: int) -> int:
     """Largest power-of-two-halving of ``block`` that divides ``dim`` —
     the block-shrinking idiom every matmul-family wrapper used inline
     (``while dim % block: block //= 2``).  Raises if even block=1 does
-    not divide (dim <= 0)."""
+    not divide (dim <= 0), and refuses a long dim (>= 64k) whose only
+    fitting blocks are sub-lane-width: at that size the degenerate grid
+    is always a config bug, not a fallback (ISSUE 10 satellite — name
+    the dim instead of silently degrading)."""
     if dim <= 0:
         raise ValueError(f"fit_block: non-positive dim {dim}")
     while dim % block:
         block //= 2
+    if dim >= LONG_DIM and block < LANES:
+        raise ValueError(
+            f"fit_block: dim {dim} >= {LONG_DIM} admits no block "
+            f">= the {LANES}-wide lane tile (best fit {block}) — a "
+            f"sub-lane grid at this size is a config bug; pad the dim "
+            f"to a multiple of {LANES}")
     return block

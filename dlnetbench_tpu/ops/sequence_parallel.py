@@ -11,8 +11,15 @@ running inside ``shard_map`` on a mesh axis that shards the sequence:
   ICI torus) while fp32 accumulators (running max / sum / output) merge one
   KV block per step.  The full S x S score matrix and the full-sequence KV
   never exist on any device: HBM stays O(S/n) per device, which is the
-  whole point at 32k+ tokens.  Causality skips nothing (every ring step is
-  a collective) but masks blocks from future shards to zero contribution.
+  whole point at 32k+ tokens.  Hops whose entire (my queries x remote
+  keys) tile is masked — strictly-future shards under causality, keys
+  beyond the sliding window, foreign document segments — SKIP the
+  compute leg via the host-precomputed hop-verdict table
+  (ops/attention_mask.ring_hop_work); the ppermute still runs every
+  hop, so the collective schedule is identical to the dense ring and
+  the skip is pure recovered FLOPs.  (Pre-ISSUE-10 this file said
+  "causality skips nothing": every hop merged a provably-zero
+  contribution through a full ``_block_scores`` — the fixed bug.)
 * ``ulysses_attention`` — two ``lax.all_to_all`` reshards per call
   (sequence-sharded -> head-sharded and back); between them every device
   holds the FULL sequence for its head subset, so the local attention can
@@ -46,18 +53,53 @@ def _block_scores(q, k, scale):
                       preferred_element_type=_F32)
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   spec=None):
     """Ring attention inside ``shard_map``; all inputs sequence-sharded.
 
     q: [B, S/n, Hq, Dh], k/v: [B, S/n, Hkv, Dh] — this device's shard of
     the sequence, all heads resident.  Returns [B, S/n, Hq, Dh].
+
+    ``spec`` (a ``MaskSpec``, ops/attention_mask.py) turns on
+    block-sparse hop skipping: the host-precomputed hop-verdict table
+    says which (me, src) tiles contain any allowed pair, and hops whose
+    whole tile is masked run NO compute leg — the ``lax.cond`` identity
+    branch — while the ppermute rotation still runs unconditionally
+    (identical collective schedule; skipped-hop accounting:
+    ``attention_mask.ring_skipped_hop_fraction``).  Plain causal
+    (spec=None, causal=True) gets the same gating from the causal
+    verdict table — strictly-future hops used to pay a full
+    ``_block_scores`` for a provably-zero merge.  The skipped merge is
+    exactly the f32 identity (masked scores underflow to p == 0.0 after
+    the first diagonal hop), so gating is numerics-preserving by
+    construction and regression-tested against the gathered reference.
     """
+    from dlnetbench_tpu.ops import attention_mask as amask
+
     b, s_loc, hq, dh = q.shape
     hkv = k.shape[2]
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     scale = 1.0 / (dh ** 0.5)
+    s_full = n * s_loc
     q_pos = me * s_loc + jnp.arange(s_loc)                  # global rows
+
+    if spec is not None and spec.causal != causal:
+        raise ValueError(
+            f"ring_attention: mask spec {spec.label()!r} has "
+            f"causal={spec.causal} but the call says causal={causal}")
+    # host-side hop verdicts: [n, n] bool, work[me, src].  None when no
+    # hop can be skipped (non-causal, unmasked) — gating elided.
+    work_tbl = None
+    if spec is not None or causal:
+        work_tbl = jnp.asarray(
+            amask.ring_hop_work(spec if spec is not None
+                                and not spec.is_plain_causal else None,
+                                s_full, n))
+    seg_ids = None
+    if spec is not None and spec.seg_avg:
+        seg_ids = jnp.asarray(
+            amask.segment_ids(spec.seg_seed, spec.seg_avg, s_full))
 
     # fp32 online-softmax state, grouped layout [B, Hkv, G, Sq(, Dh)]
     g = hq // hkv
@@ -71,8 +113,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
         the online-softmax state."""
         src = (me - t) % n                                  # shard origin
         s = _block_scores(q, k_cur, scale)                  # [B,Hkv,G,Sq,Sk]
-        if causal:
-            k_pos = src * s_loc + jnp.arange(s_loc)
+        k_pos = src * s_loc + jnp.arange(s_loc)
+        if spec is not None and not spec.is_plain_causal:
+            mask = amask.allowed(spec, q_pos[:, None], k_pos[None, :],
+                                 seg_ids=seg_ids)           # [Sq, Sk]
+            s = jnp.where(mask[None, None, None], s, _NEG_INF)
+        elif causal:
             mask = q_pos[:, None] >= k_pos[None, :]         # [Sq, Sk]
             s = jnp.where(mask[None, None, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -83,11 +129,24 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
                         preferred_element_type=_F32)
         return m_new, l, acc * alpha[..., None] + pv
 
+    def gated_merge(k_cur, v_cur, m, l, acc, t):
+        """The hop's compute leg, behind its verdict: a fully-masked
+        tile runs the identity branch (no scores, no MXU work)."""
+        if work_tbl is None:
+            return merge_block(k_cur, v_cur, m, l, acc, t)
+        src = (me - t) % n
+        return lax.cond(
+            work_tbl[me, src],
+            lambda args: merge_block(*args),
+            lambda args: (args[2], args[3], args[4]),
+            (k_cur, v_cur, m, l, acc, t))
+
     def body(carry, t):
         k_cur, v_cur, m, l, acc = carry
-        m, l, acc = merge_block(k_cur, v_cur, m, l, acc, t)
-        # rotate KV one hop around the ring (overlappable with the next
-        # block's compute by XLA's async collective scheduling)
+        m, l, acc = gated_merge(k_cur, v_cur, m, l, acc, t)
+        # rotate KV one hop around the ring UNCONDITIONALLY — gating
+        # must never perturb the collective schedule (overlappable with
+        # the next block's compute by XLA's async collective scheduling)
         k_nxt = lax.ppermute(k_cur, axis_name, shift)
         v_nxt = lax.ppermute(v_cur, axis_name, shift)
         return (k_nxt, v_nxt, m, l, acc), None
@@ -96,20 +155,22 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     # nth hop would only feed a discarded carry (pure wasted ICI traffic)
     (k_last, v_last, m, l, acc), _ = lax.scan(
         body, (k, v, m0, l0, acc0), jnp.arange(n - 1))
-    m, l, acc = merge_block(k_last, v_last, m, l, acc, n - 1)
+    m, l, acc = gated_merge(k_last, v_last, m, l, acc, n - 1)
     out = acc / l[..., None]                                # [B,Hkv,G,Sq,Dh]
     return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(
         b, s_loc, hq, dh).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
-                      impl: str = "auto"):
+                      impl: str = "auto", spec=None):
     """Ulysses (DeepSpeed-style) inside ``shard_map``: all-to-all from
     sequence-sharded to head-sharded, full-sequence local attention (flash
     kernel via ``impl``), all-to-all back.
 
     q: [B, S/n, Hq, Dh] -> returns [B, S/n, Hq, Dh].  Requires both head
     counts divisible by the axis size (lax.all_to_all enforces it).
+    ``spec`` (MaskSpec) rides into the local attention, which holds the
+    full sequence — the splash/dense-masked dispatch applies unchanged.
     """
     def seq_to_heads(x):
         # [B, S/n, H, Dh] -> [B, S, H/n, Dh]
@@ -121,5 +182,5 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = ops.attention(qh, kh, vh, causal=causal, impl=impl)
+    out = ops.attention(qh, kh, vh, causal=causal, impl=impl, mask=spec)
     return heads_to_seq(out)

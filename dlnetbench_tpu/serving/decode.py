@@ -39,12 +39,30 @@ from dlnetbench_tpu.serving.kv_cache import (CacheConfig,
 _F32 = jnp.float32
 
 
-def check_config(cfg: TransformerConfig) -> TransformerConfig:
+def check_config(cfg: TransformerConfig,
+                 decode: bool = False) -> TransformerConfig:
     if not cfg.gated or cfg.num_experts > 1 or cfg.max_positions:
         raise ValueError(
             "serving decode covers the dense gated (SwiGLU+RMSNorm+"
             "RoPE) family only — non-gated / MoE / learned-position "
             "configs have no decode path yet")
+    if cfg.attention_seg_avg:
+        raise ValueError(
+            "serving decode supports sliding-window attention masks "
+            "only (attention_window); document-segment masks have no "
+            "serving path — a request is one document")
+    if decode and cfg.attention_window:
+        # the decode step attends the FULL cached history (the paged
+        # kernel has no lower-bound mask), so generating under a
+        # window config would silently use different attention
+        # semantics than the windowed prefill/training — refuse until
+        # a lower-bound-aware paged kernel exists
+        raise ValueError(
+            "serving decode has no sliding-window path yet (the paged "
+            "attention kernel attends the full cache): "
+            "attention_window covers the PREFILL chunk only — decode "
+            "under a window config would silently diverge from the "
+            "training mask")
     return cfg
 
 
@@ -83,7 +101,7 @@ def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
     already cached), so attention covers ``position + 1`` tokens.
     Inactive slots write nowhere (out-of-bounds page index + ``drop``
     mode) and their next_token is garbage the engine ignores."""
-    check_config(cfg)
+    check_config(cfg, decode=True)
     scale = cfg.head_dim ** -0.5
     page_size = cache_cfg.page_size
     num_pages = cache_cfg.num_pages
@@ -141,12 +159,32 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
     ``next_token`` is the greedy continuation after the LAST valid
     token — meaningful only on the chunk that completes the prompt
     (that token IS the request's first generated token; its TTFT
-    stamp)."""
+    stamp).
+
+    With ``cfg.attention_window = W`` the prefill is SPARSE (ISSUE 10
+    satellite): the chunk's queries can only see keys in ``(q-W, q]``,
+    so the gather walks just the ``ceil((W-1+chunk)/page) + 1`` pages
+    that window can touch instead of all ``max_pages_per_seq`` — the
+    score grid shrinks from ``[C, pmax*page]`` to ``[C, pages_w*page]``
+    — and the mask comes from the SAME builder the training paths use
+    (ops/attention_mask.allowed with the equivalent MaskSpec), so a
+    sliding-window model config prefills with the training mask
+    semantics exactly (token-parity-tested against the dense path)."""
     check_config(cfg)
     scale = cfg.head_dim ** -0.5
     page_size = cache_cfg.page_size
     num_pages = cache_cfg.num_pages
     pmax = cache_cfg.max_pages_per_seq
+    window = cfg.attention_window
+    spec = None
+    pages_w = pmax
+    if window:
+        from dlnetbench_tpu.ops.attention_mask import MaskSpec
+        spec = MaskSpec(causal=True, window=window)
+        # pages the window can reach from any chunk query: the span
+        # (q-W, q] over the chunk covers W-1+chunk positions, plus one
+        # page for alignment slack
+        pages_w = min(pmax, -(-(window - 1 + chunk) // page_size) + 1)
 
     def prefill_chunk(params, k_pages, v_pages, tokens, start, n_valid,
                       block_row):
@@ -174,22 +212,41 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
                 k, mode="drop")
             v_pages = v_pages.at[li, :, w_pages, slots, :].set(
                 v, mode="drop")
-            # causal attention over cache + chunk: gather the whole
-            # sequence contiguous from the slot's pages (chunk included
-            # — just written), mask keys past each query's position
-            kseq = k_pages[li][:, block_row]   # [Hkv, Pmax, S, Dh]
-            vseq = v_pages[li][:, block_row]
-            hkv, _, _, dh = kseq.shape
-            t = pmax * page_size
+            # causal attention over cache + chunk: gather the pages the
+            # mask can reach (ALL of them when no window; just the
+            # window span otherwise — pages beyond it are provably
+            # masked, so their DMA and score columns are skipped),
+            # chunk included (just written), mask per key position
+            if window:
+                first_page = jnp.maximum(
+                    start - (window - 1), 0) // page_size
+                pcols = first_page + jnp.arange(pages_w)
+                # clamp the LOOKUP only: an overshooting column's key
+                # positions exceed every query (causal-masked), so the
+                # duplicated page it reads contributes nothing
+                rows = block_row[jnp.clip(pcols, 0, pmax - 1)]
+                k_pos = (pcols[:, None] * page_size
+                         + jnp.arange(page_size)[None, :]).reshape(-1)
+            else:
+                rows = block_row
+                k_pos = jnp.arange(pmax * page_size)
+            kseq = k_pages[li][:, rows]   # [Hkv, pages_w, page, Dh]
+            vseq = v_pages[li][:, rows]
+            hkv, npg, _, dh = kseq.shape
+            t = npg * page_size
             kseq = kseq.reshape(hkv, t, dh).astype(_F32)
             vseq = vseq.reshape(hkv, t, dh).astype(_F32)
             g = cfg.num_heads // hkv
             qg = (q * scale).reshape(chunk, hkv, g, dh).astype(_F32)
             scores = jnp.einsum("chgd,htd->hgct", qg, kseq)
-            causal = (jnp.arange(t)[None, :]
-                      <= positions[:, None])               # [C, T]
+            if spec is not None:
+                from dlnetbench_tpu.ops.attention_mask import allowed
+                keep = allowed(spec, positions[:, None],
+                               k_pos[None, :])             # [C, T]
+            else:
+                keep = k_pos[None, :] <= positions[:, None]
             from dlnetbench_tpu.serving.kv_cache import MASK_VALUE
-            scores = jnp.where(causal[None, None], scores, MASK_VALUE)
+            scores = jnp.where(keep[None, None], scores, MASK_VALUE)
             p = jax.nn.softmax(scores, axis=-1)
             att = jnp.einsum("hgct,htd->chgd", p, vseq)
             att = att.reshape(chunk, cfg.embed_dim).astype(x.dtype)

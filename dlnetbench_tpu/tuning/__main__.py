@@ -16,7 +16,9 @@
 
 Ops: ``quantized_matmul`` (fused Pallas grid blocks),
 ``flash_fwd`` / ``flash_bwd`` (flash-attention block shapes),
-``paged_attention`` (``pages_per_compute_block``),
+``splash_fwd`` / ``splash_bwd`` (block-sparse masked attention blocks —
+``--window``/``--seg_avg``/``--seg_seed`` pick the mask, which rides
+in the key), ``paged_attention`` (``pages_per_compute_block``),
 ``tp_overlap_chunks`` (collective-matmul ring grain, needs >= 2
 devices), ``grad_bucket_layers`` (bucketed DP grad sync, needs >= 2
 devices).  Every op measures with the K-chained fence timing the bench
@@ -34,8 +36,9 @@ from dlnetbench_tpu.tuning import params as tparams
 from dlnetbench_tpu.tuning.db import TuningDB
 from dlnetbench_tpu.tuning.search import tune_and_commit
 
-OPS = ("quantized_matmul", "flash_fwd", "flash_bwd", "paged_attention",
-       "tp_overlap_chunks", "grad_bucket_layers")
+OPS = ("quantized_matmul", "flash_fwd", "flash_bwd", "splash_fwd",
+       "splash_bwd", "paged_attention", "tp_overlap_chunks",
+       "grad_bucket_layers")
 
 
 def _parse_candidates(spec: str | None, arity: int,
@@ -152,6 +155,62 @@ def _tune_flash(args, direction: str):
     return "flash_bwd", key, cands, measure_cfg
 
 
+def _tune_splash(args, direction: str):
+    """Block-sparse (splash) attention blocks — the masked sibling of
+    ``_tune_flash``; the MASK rides in both the measured kernel and
+    the committed key (``--window`` / ``--seg_avg`` / ``--seg_seed``
+    build the MaskSpec), so a window-mask optimum can never answer a
+    segment-mask consult."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlnetbench_tpu.ops.attention_mask import MaskSpec
+
+    fa = importlib.import_module("dlnetbench_tpu.ops.flash_attention")
+    spec = MaskSpec(causal=True, window=args.window,
+                    seg_avg=args.seg_avg, seg_seed=args.seg_seed)
+
+    b, s = args.batch, args.seq
+    hq, hkv, dh = args.heads, args.kv_heads, args.head_dim
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    q = jax.random.normal(jax.random.key(0), (b, s, hq, dh), dt)
+    k = jax.random.normal(jax.random.key(1), (b, s, hkv, dh), dt)
+    v = jax.random.normal(jax.random.key(2), (b, s, hkv, dh), dt)
+    key = tparams.splash_key(b, s, hq, hkv, dh, spec.label(), q.dtype)
+
+    if direction == "fwd":
+        cands = _parse_candidates(args.candidates, 2,
+                                  ("block_q", "block_k")) or [
+            {"block_q": bq, "block_k": bk}
+            for bq in (2048, 1024, 512) for bk in (2048, 1024, 512)
+            if s % bq == 0 and s % bk == 0 and s >= bq and s >= bk]
+
+        def measure_cfg(cfg):
+            return _chain(lambda qq, kk, vv: fa.splash_attention(
+                qq, kk, vv, spec, cfg["block_q"], cfg["block_k"]),
+                (q, k, v), args.k)
+        return "splash_fwd", key, cands, measure_cfg
+
+    cands = _parse_candidates(args.candidates, 4,
+                              ("bq_dq", "bk_dq", "bq_dkv", "bk_dkv")) or [
+        {"bq_dq": bb, "bk_dq": bb, "bq_dkv": bb, "bk_dkv": bb}
+        for bb in (1024, 512, 256) if s % bb == 0 and s >= bb]
+    out, lse = fa._splash_fwd(q, k, v, spec,
+                              block_q=fa._pick_block(s),
+                              block_k=fa._pick_block(s))
+    do = jax.random.normal(jax.random.key(3), q.shape, dt)
+
+    def measure_cfg(cfg):
+        blocks = ((cfg["bq_dq"], cfg["bk_dq"]),
+                  (cfg["bq_dkv"], cfg["bk_dkv"]))
+        return _chain(lambda *a: fa._splash_bwd_impl(
+            *a, spec, block_q=blocks[0][0], block_k=blocks[0][1],
+            override_blocks=blocks), (q, k, v, out, lse, do), args.k)
+    return "splash_bwd", key, cands, measure_cfg
+
+
 def _tune_paged_attention(args):
     import jax
     import jax.numpy as jnp
@@ -260,6 +319,8 @@ def _run_tune(args) -> int:
         "quantized_matmul": lambda: _tune_quantized_matmul(args),
         "flash_fwd": lambda: _tune_flash(args, "fwd"),
         "flash_bwd": lambda: _tune_flash(args, "bwd"),
+        "splash_fwd": lambda: _tune_splash(args, "fwd"),
+        "splash_bwd": lambda: _tune_splash(args, "bwd"),
         "paged_attention": lambda: _tune_paged_attention(args),
         "tp_overlap_chunks": lambda: _tune_tp_overlap_chunks(args),
         "grad_bucket_layers": lambda: _tune_grad_bucket_layers(args),
@@ -344,6 +405,12 @@ def main(argv: list[str] | None = None) -> int:
     t.add_argument("--head_dim", type=int, default=128)
     t.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    t.add_argument("--window", type=int, default=0,
+                   help="splash ops: sliding-window width (0 = off)")
+    t.add_argument("--seg_avg", type=int, default=0,
+                   help="splash ops: seeded segment plan's average "
+                        "document length (0 = off)")
+    t.add_argument("--seg_seed", type=int, default=0)
     t.add_argument("--pages", type=int, default=8)
     t.add_argument("--page_size", type=int, default=8)
     t.add_argument("--layers", type=int, default=4)

@@ -162,6 +162,17 @@ def flash_bwd_key(b: int, s: int, hq: int, hkv: int, dh: int,
     return flash_fwd_key(b, s, hq, hkv, dh, causal, dtype)
 
 
+def splash_key(b: int, s: int, hq: int, hkv: int, dh: int,
+               mask_label: str, dtype) -> str:
+    """Block-sparse (splash) attention blocks — ops "splash_fwd" and
+    "splash_bwd" share the key shape.  The MASK rides in the key (the
+    ``MaskSpec.label()`` spelling): sparsity changes which blocks even
+    run, so a window(1024) optimum must never answer a segment-mask
+    consult, and neither may a dense flash record."""
+    return canonical_key(b=b, s=s, hq=hq, hkv=hkv, dh=dh,
+                         mask=mask_label, dtype=str(dtype))
+
+
 def paged_attention_key(pages_per_seq: int, page_size: int, b: int,
                         hq: int, hkv: int, dh: int) -> str:
     return canonical_key(pages_per_seq=pages_per_seq,

@@ -407,3 +407,69 @@ def test_tuned_ab_line_schema_locked():
     # "tuned_ab" automatically
     from dlnetbench_tpu.sentinel import is_ms_line
     assert is_ms_line(line)
+
+
+def test_longcontext_line_schema_locked():
+    """bench.py's dense-vs-splash long-context A/B line (ISSUE 10):
+    headline value = the WINDOW-masked splash median ms with {value,
+    best, band, n}, every variant a sub-object, masked variants a
+    paired per-round ratio band vs dense, speedup_vs_sparsity the
+    measured-over-expected consistency ratio, and the mask specs +
+    sparsity riding as comparable globals."""
+    import bench
+
+    summaries = {
+        "dense": {"value": 0.020, "best": 0.019,
+                  "band": [0.019, 0.021], "n": 3},
+        "splash_causal": {"value": 0.019, "best": 0.018,
+                          "band": [0.018, 0.020], "n": 3},
+        "splash_window": {"value": 0.005, "best": 0.0045,
+                          "band": [0.0045, 0.0055], "n": 3},
+        "splash_segment": {"value": 0.010, "best": 0.009,
+                           "band": [0.009, 0.011], "n": 3},
+    }
+    rounds = {
+        "dense": [0.019, 0.020, 0.021],
+        "splash_causal": [0.018, 0.019, 0.020],
+        "splash_window": [0.0045, 0.005, 0.0055],
+        "splash_segment": [0.009, 0.010, 0.011],
+    }
+    mask_info = {
+        "splash_causal": {"attention_mask": "causal",
+                          "mask_sparsity": 0.499,
+                          "block_skip_fraction": 0.48,
+                          "expected_speedup": 1.0},
+        "splash_window": {"attention_mask": "causal&window(4096)",
+                          "mask_sparsity": 0.94,
+                          "block_skip_fraction": 0.87,
+                          "expected_speedup": 4.0},
+        "splash_segment": {"attention_mask": "causal&seg(avg=8192,seed=0)",
+                           "mask_sparsity": 0.9,
+                           "block_skip_fraction": 0.8,
+                           "expected_speedup": 2.0},
+    }
+    line = bench._longcontext_line(summaries, rounds,
+                                   metric="longcontext A/B: test",
+                                   mask_info=mask_info)
+    assert line["unit"] == "ms" and line["value"] == 5.0
+    assert line["band"] == [4.5, 5.5] and line["n"] == 3
+    for sub in ("dense", "splash_causal", "splash_window",
+                "splash_segment"):
+        for k in ("value", "best", "band", "n"):
+            assert k in line[sub], (sub, k)
+    r = line["ratio_splash_window_vs_dense"]
+    assert r["n"] == 3 and r["value"] == 0.25
+    # measured speedup 4.0 vs expected 4.0 -> consistency ratio 1.0
+    assert line["speedup_vs_sparsity"]["splash_window"] == 1.0
+    assert line["masks"]["splash_window"]["attention_mask"] \
+        == "causal&window(4096)"
+    assert line["band_disjoint_win"] is True
+    from dlnetbench_tpu.sentinel import is_ms_line
+    assert is_ms_line(line)
+    # an overlapping-band "win" is not band-disjoint
+    summaries2 = dict(summaries)
+    summaries2["splash_window"] = {"value": 0.0195, "best": 0.019,
+                                   "band": [0.019, 0.020], "n": 3}
+    line2 = bench._longcontext_line(summaries2, rounds, metric="m",
+                                    mask_info=mask_info)
+    assert line2["band_disjoint_win"] is False

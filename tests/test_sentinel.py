@@ -380,3 +380,33 @@ def test_tuned_ab_line_is_comparable():
         "headline": _line(10.0, [9.9, 10.1]),
         "tuned_ab": tuned_line(10.3, [9.8, 10.8])})
     assert ok["verdict"] == "clean"
+
+
+def test_longcontext_line_is_comparable():
+    """The longcontext_ab aux line (ISSUE 10) rides the headline like
+    every ms line and the sentinel judges it band-aware
+    lower-is-better: a splash chain that got slower past threshold
+    with disjoint bands is a regression; band-overlapping wobble is
+    noise."""
+    def lc_line(value, band):
+        return {"metric": "longcontext A/B: dense vs splash",
+                "value": value, "unit": "ms",
+                "best": band[0], "band": band, "n": 3,
+                "dense": {"value": 4 * value, "best": 4 * band[0],
+                          "band": [4 * b for b in band], "n": 3},
+                "masks": {"splash_window": {
+                    "attention_mask": "causal&window(4096)",
+                    "mask_sparsity": 0.94}}}
+
+    assert sentinel.is_ms_line(lc_line(10.0, [9.5, 10.5]))
+    base = {"headline": _line(10.0, [9.9, 10.1]),
+            "longcontext_ab": lc_line(10.0, [9.5, 10.5])}
+    cur = {"headline": _line(10.0, [9.9, 10.1]),
+           "longcontext_ab": lc_line(20.0, [19.5, 20.5])}
+    sent = sentinel.check(base, cur)
+    assert sent["verdict"] == "regression"
+    assert sent["regressions"] == ["longcontext_ab"]
+    ok = sentinel.check(base, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "longcontext_ab": lc_line(10.3, [9.8, 10.8])})
+    assert ok["verdict"] == "clean"

@@ -668,3 +668,97 @@ def test_percentiles_and_slo_goodput():
     assert tl[0]["completed"] == 1 and tl[0]["goodput_frac"] == 1.0
     assert tl[1]["completed"] == 0 and tl[1]["goodput_frac"] is None
     assert tl[-1]["completed"] == 1 and tl[-1]["goodput_frac"] == 0.0
+
+
+# ------------------------------- windowed sparse prefill (ISSUE 10)
+
+longcontext = pytest.mark.longcontext
+
+
+def _run_prefill(cfg, cc, prompt, chunk=3):
+    from dlnetbench_tpu.serving import decode as D
+    params = tfm.init_params(jax.random.key(0), cfg)
+    cache = PagedKVCache(cc)
+    k, v = device_buffers(cc)
+    cache.allocate(0, len(prompt) + 1)
+    prefill = jax.jit(D.make_prefill_chunk(cfg, cc, chunk))
+    row = jnp.asarray(cache.block_tables[0])
+    pos, nxt = 0, None
+    while pos < len(prompt):
+        n = min(chunk, len(prompt) - pos)
+        ch = np.zeros(chunk, np.int32)
+        ch[:n] = prompt[pos:pos + n]
+        k, v, nxt = prefill(params, k, v, jnp.asarray(ch),
+                            jnp.int32(pos), jnp.int32(n), row)
+        pos += n
+    return int(nxt)
+
+
+@longcontext
+def test_windowed_prefill_token_parity_with_dense():
+    """ISSUE 10 satellite: the sliding-window prefill gathers only the
+    window's pages, yet (a) with a window covering the whole prompt it
+    reproduces the dense path's token exactly, and (b) with a NARROW
+    window it reproduces the windowed full forward (the dense-masked
+    reference) — same mask builders, same semantics."""
+    import dataclasses
+    cc = CacheConfig(num_layers=2, num_kv_heads=2, head_dim=8,
+                     num_pages=16, page_size=4, max_seqs=2,
+                     max_pages_per_seq=6)
+    prompt = np.asarray([5, 9, 3, 11, 7, 2, 13, 1, 8, 4, 10, 6,
+                         12, 14], np.int32)
+    cfg = tiny_model()
+    dense_tok = _run_prefill(cfg, cc, prompt)
+    big = dataclasses.replace(cfg, attention_window=cc.max_seq_len)
+    assert _run_prefill(big, cc, prompt) == dense_tok
+
+    win = dataclasses.replace(cfg, attention_window=6)
+    got = _run_prefill(win, cc, prompt)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    ref_cfg = dataclasses.replace(win, seq_len=len(prompt),
+                                  attention_impl="xla")
+    logits = tfm.forward(params, jnp.asarray(prompt)[None], ref_cfg)
+    assert got == int(jnp.argmax(logits[0, -1]))
+
+
+@longcontext
+def test_windowed_prefill_single_chunk_and_page_aligned_window():
+    """Window edge shapes: a window equal to one page and a chunk
+    larger than the remaining prompt (padding tail) still match the
+    dense-masked reference."""
+    import dataclasses
+    cc = CacheConfig(num_layers=2, num_kv_heads=2, head_dim=8,
+                     num_pages=16, page_size=4, max_seqs=2,
+                     max_pages_per_seq=6)
+    prompt = np.asarray([3, 1, 4, 1, 5, 9, 2, 6, 5, 3], np.int32)
+    win = tiny_model(attention_window=4)
+    got = _run_prefill(win, cc, prompt, chunk=8)
+    params = tfm.init_params(jax.random.key(0), win)
+    ref_cfg = dataclasses.replace(win, seq_len=len(prompt),
+                                  attention_impl="xla")
+    logits = tfm.forward(params, jnp.asarray(prompt)[None], ref_cfg)
+    assert got == int(jnp.argmax(logits[0, -1]))
+
+
+@longcontext
+def test_serving_rejects_segment_masks():
+    from dlnetbench_tpu.serving.decode import check_config
+    with pytest.raises(ValueError, match="segment"):
+        check_config(tiny_model(attention_seg_avg=16))
+
+
+@longcontext
+def test_decode_step_refuses_window_configs():
+    """The decode step attends the FULL cache (the paged kernel has no
+    lower-bound mask): a window config must fail loud at construction
+    instead of silently generating under different attention semantics
+    than the windowed prefill/training path."""
+    from dlnetbench_tpu.serving import decode as D
+    cc = CacheConfig(num_layers=2, num_kv_heads=2, head_dim=8,
+                     num_pages=16, page_size=4, max_seqs=2,
+                     max_pages_per_seq=6)
+    cfg = tiny_model(attention_window=6)
+    with pytest.raises(ValueError, match="window"):
+        D.make_decode_step(cfg, cc)
+    # the prefill side stays windowed (the ISSUE 10 satellite)
+    D.make_prefill_chunk(cfg, cc, chunk=4)

@@ -748,3 +748,68 @@ def test_flash_bwd_db_consulted_without_env(monkeypatch, tmp_path):
     blocks = fa._resolve_bwd_blocks(q, q, True, 256, 256)
     assert blocks == ((64, 128), (128, 64))
     assert tuning.provenance()["hits"] == 1
+
+
+@pytest.mark.longcontext
+def test_splash_blocks_empty_db_bit_identical(monkeypatch, tmp_path):
+    """Splash attention fwd+grad on an empty enabled DB is bit-identical
+    to the disabled path, and the consult logs under the mask-labeled
+    splash keys (ISSUE 10: splash blocks are their own tuning site —
+    dense flash records must never answer)."""
+    import importlib
+    fa = importlib.import_module("dlnetbench_tpu.ops.flash_attention")
+    from dlnetbench_tpu.ops.attention_mask import MaskSpec
+
+    spec = MaskSpec(causal=True, window=64)
+    q = jax.random.normal(jax.random.key(0), (1, 256, 2, 128),
+                          jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (1, 256, 2, 128),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (1, 256, 2, 128),
+                          jnp.float32)
+
+    def loss(q_, k_, v_):
+        return fa.splash_attention(q_, k_, v_,
+                                   spec).astype(jnp.float32).sum()
+
+    base, base_grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+    _enable(monkeypatch, tmp_path)
+    got, got_grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert jnp.array_equal(base, got)
+    for b, g in zip(base_grads, got_grads):
+        assert jnp.array_equal(b, g)
+    prov = tuning.provenance()
+    assert prov and prov["hits"] == 0
+    splash_sites = [s for s in prov["sites"]
+                    if s.startswith(("splash_fwd|", "splash_bwd|"))]
+    assert len(splash_sites) == 2
+    assert all("mask=causal&window(64)" in s for s in splash_sites)
+
+
+@pytest.mark.longcontext
+def test_splash_tuned_blocks_hit_and_divide_validation(monkeypatch,
+                                                       tmp_path):
+    """A committed splash record is consulted (numerics unchanged —
+    block sizes never change the math) and an inapplicable one fails
+    loud at the site."""
+    import importlib
+    fa = importlib.import_module("dlnetbench_tpu.ops.flash_attention")
+    from dlnetbench_tpu.ops.attention_mask import MaskSpec
+
+    spec = MaskSpec(causal=True, window=64)
+    q = jax.random.normal(jax.random.key(0), (1, 256, 2, 128),
+                          jnp.float32)
+    want = fa.splash_attention(q, q, q, spec, 128, 128)
+    root = _enable(monkeypatch, tmp_path)
+    key = tuning.params.splash_key(1, 256, 2, 2, 128, spec.label(),
+                                   q.dtype)
+    TuningDB(root).put("splash_fwd", key, tuning.hw_key(),
+                       {"block_q": 128, "block_k": 128})
+    got = fa.splash_attention(q, q, q, spec)
+    assert jnp.array_equal(want, got)
+    assert tuning.provenance()["hits"] == 1
+    tuning.reset()
+    TuningDB(root).put("splash_fwd", key, tuning.hw_key(),
+                       {"block_q": 96, "block_k": 128})
+    with pytest.raises(ValueError, match="does not divide"):
+        fa.splash_attention(q, q, q, spec)
