@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test check-bench check-resilience check-serving check-tuning \
-	check-longcontext sentinel-scan
+	check-longcontext check-decode sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -73,6 +73,22 @@ check-longcontext:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
 	    tests/test_bench_aux.py::test_longcontext_line_schema_locked \
 	    tests/test_sentinel.py::test_longcontext_line_is_comparable
+
+# the decode-loop lane (docs/SERVING.md "The multi-step loop"): fused
+# N-step-vs-1-step token parity, speculative greedy parity (both
+# drafters), the verify pass, the host/device state split's sync
+# contract + round-trip property, adaptive-N policy + TTFT guard,
+# config guards, CompiledLoop, the record/attribution pathway, and the
+# serving A/B line schema + sentinel comparability.  The full
+# 3-engine bench e2e rides the slow lane (pytest -m 'decode and
+# slow').  ~1 min wall.
+check-decode:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m 'decode and not slow' \
+	    tests/
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
+	    tests/test_bench_aux.py::test_serving_decode_line_schema_locked \
+	    tests/test_bench_aux.py::test_serving_decode_ab_schema_locked \
+	    tests/test_sentinel.py::test_decode_ab_line_is_comparable
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

@@ -736,13 +736,107 @@ def _recommended_step(bf16_summary_s: dict, bf16_loss: float,
     }
 
 
-def _serving_decode_line(rounds: list[dict], suffix: str = "") -> dict:
+def _serving_variant_block(base_rounds: list[dict],
+                           rounds: list[dict]) -> dict:
+    """Per-variant A/B sub-object for the serving_decode line: the
+    serving figures with bands, the dispatch decomposition, and the
+    paired per-round speedup over the 1-step baseline (r4 pairing —
+    adjacent measurement cancels drift)."""
+    dl = [r.get("decode_loop") or {} for r in rounds]
+    block = {
+        "tokens_per_s": stats_mod.summarize(
+            [r["tokens_per_s"] for r in rounds], ndigits=2),
+        "tpot_p50_ms": stats_mod.summarize(
+            [r["tpot_ms"]["p50"] for r in rounds], ndigits=3),
+        "e2e_p99_ms": stats_mod.summarize(
+            [r["e2e_ms"]["p99"] for r in rounds], ndigits=3),
+        "speedup_tokens_per_s": stats_mod.summarize(
+            [r["tokens_per_s"] / b["tokens_per_s"]
+             for b, r in zip(base_rounds, rounds)
+             if b["tokens_per_s"] > 0], ndigits=3),
+        "steps_per_dispatch": stats_mod.summarize(
+            [d.get("steps_per_dispatch", 0.0) for d in dl], ndigits=3),
+        "tokens_per_sync": stats_mod.summarize(
+            [d.get("tokens_per_sync", 0.0) for d in dl], ndigits=3),
+        "multi_step_n": (dl[0] or {}).get("multi_step_n"),
+    }
+    spec = (dl[0] or {}).get("spec")
+    if isinstance(spec, dict):
+        block["spec"] = {
+            "k": spec.get("k"), "drafter": spec.get("drafter"),
+            "acceptance_rate": stats_mod.summarize(
+                [(d.get("spec") or {}).get("acceptance_rate", 0.0)
+                 for d in dl], ndigits=4),
+        }
+    return block
+
+
+def _serving_host_frac_ab(base_rounds: list[dict],
+                          multi_rounds: list[dict],
+                          spec_rounds: list[dict] | None
+                          ) -> dict | None:
+    """The attribution-flip evidence (ISSUE 11 acceptance): per-round
+    host fractions with the measured per-dispatch floor folded in
+    (analysis/attribution.dispatch_decomposition — the paired 1-step
+    vs N-step rounds ARE the two-point measurement of dispatch cost),
+    banded per variant, plus the band-disjoint verdict for the
+    1-step -> N-step drop.  On a TPU platform the serving record's own
+    attribution block flips the BOUND off host; on the CPU mesh (where
+    a measured-compute verdict can never read mxu) THIS drop is the
+    committed evidence."""
+    from dlnetbench_tpu.analysis import attribution as A
+    floors: list[float] = []
+    fracs: dict[str, list[float]] = {}
+    variants = {"one_step": base_rounds, "multi_step": multi_rounds}
+    if spec_rounds:
+        variants["speculative"] = spec_rounds
+    for i, (b, m) in enumerate(zip(base_rounds, multi_rounds)):
+        dec = A.dispatch_decomposition(b.get("decode_loop") or {},
+                                       m.get("decode_loop") or {})
+        if dec is None:
+            return None
+        floors.append(dec["dispatch_us"])
+        for name, rnds in variants.items():
+            r = rnds[i]
+            host = A.serving_host_us(r.get("decode_loop") or {},
+                                     dec["dispatch_us"])
+            fracs.setdefault(name, []).append(
+                host / (r["wall_s"] * 1e6))
+    one = stats_mod.summarize(fracs["one_step"], ndigits=4)
+    multi = stats_mod.summarize(fracs["multi_step"], ndigits=4)
+    disjoint = (stats_mod.bands_overlap(one["band"], multi["band"])
+                is False and multi["value"] < one["value"])
+    out = {
+        "dispatch_us": stats_mod.summarize(floors, ndigits=1),
+        "one_step_host_frac": one,
+        "multi_step_host_frac": multi,
+        "band_disjoint_drop": disjoint,
+        "verdict": ("host fraction dropped, bands disjoint — the "
+                    "fused loop amortizes the measured per-dispatch "
+                    "floor" if disjoint else
+                    "host-fraction bands overlap — no flip at this "
+                    "scale/noise"),
+    }
+    if spec_rounds:
+        out["speculative_host_frac"] = stats_mod.summarize(
+            fracs["speculative"], ndigits=4)
+    return out
+
+
+def _serving_decode_line(rounds: list[dict], suffix: str = "", *,
+                         multi_rounds: list[dict] | None = None,
+                         spec_rounds: list[dict] | None = None,
+                         token_parity: bool | None = None) -> dict:
     """Assemble the serving_decode aux line from per-round ``serving``
     blocks (pure — tests/test_bench_aux.py locks this schema).  The
-    headline ``value`` is the round-median e2e p99 in ms (lower is
-    better, so the sentinel compares it like every latency line), and
-    TTFT/TPOT/p99 each ship their own artifact-grade
-    ``{value, best, band, n}`` over the rounds."""
+    headline ``value`` is the 1-step engine's round-median e2e p99 in
+    ms (lower is better, so the sentinel compares it like every
+    latency line), and TTFT/TPOT/p99 each ship their own
+    artifact-grade ``{value, best, band, n}`` over the rounds.  With
+    ``multi_rounds``/``spec_rounds`` (ISSUE 11) the line grows the
+    paired A/B: per-variant tokens/s + TPOT bands with speedups, the
+    dispatch decomposition, the host-fraction drop with its
+    band-disjoint verdict, and the token-parity lock."""
     p99 = [r["e2e_ms"]["p99"] for r in rounds]
     summary = stats_mod.summarize(p99, ndigits=3)
     line = {
@@ -766,20 +860,37 @@ def _serving_decode_line(rounds: list[dict], suffix: str = "") -> dict:
         "requests": rounds[0]["completed"],
         "offered_rps": rounds[0]["offered_rps"],
     }
+    if multi_rounds:
+        line["multi_step"] = _serving_variant_block(rounds,
+                                                    multi_rounds)
+        if spec_rounds:
+            line["speculative"] = _serving_variant_block(rounds,
+                                                         spec_rounds)
+        flip = _serving_host_frac_ab(rounds, multi_rounds, spec_rounds)
+        if flip is not None:
+            line["attribution_flip"] = flip
+        if token_parity is not None:
+            line["token_parity"] = bool(token_parity)
     return stats_mod.flag_low_mode(line)
 
 
 def _bench_serving_decode() -> dict | None:
-    """The serving-tier aux line (ISSUE 8): a tiny paged-KV
-    continuous-batching engine (serving/scheduler.py) replays the SAME
-    seeded poisson plan for 3 rounds — one engine, compiled once (AOT
-    via core/executor.CompiledStep), fresh cache per round — and the
-    line reports the round bands of TTFT p50, TPOT p50 and e2e p99.
-    Latency is wall-clock through the real engine loop (admission,
-    paged attention, eviction), so a decode-path regression or an
-    engine-loop overhead regression both move it; the sentinel treats
-    it like every ms line (lower-is-better median, band-aware)."""
-    from dlnetbench_tpu.models.transformer import TransformerConfig
+    """The serving-tier A/B line (ISSUE 8 base + ISSUE 11 tentpole):
+    THREE engines over the same weights — the classic 1-step engine,
+    the device-resident N-step fused loop, and the fused loop with
+    self-drafting speculative decode — replay the SAME seeded
+    saturating poisson plan, interleaved per round (the r4 pairing
+    protocol: adjacent measurement cancels drift).  Each engine is
+    compiled once (AOT via core/executor.CompiledStep/CompiledLoop),
+    warm round discarded.  The line keeps the ISSUE 8 schema (value =
+    1-step e2e p99, sentinel-comparable) and adds the paired
+    tokens/s + TPOT A/B, the measured dispatch decomposition, the
+    host-fraction drop verdict, and the token-parity lock (the N-step
+    and speculative greedy streams must EQUAL the 1-step stream)."""
+    import dataclasses
+
+    from dlnetbench_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
     from dlnetbench_tpu.serving import metrics as smetrics
     from dlnetbench_tpu.serving.arrivals import ArrivalPlan
     from dlnetbench_tpu.serving.scheduler import Engine, ServingConfig
@@ -788,28 +899,60 @@ def _bench_serving_decode() -> dict | None:
         vocab_size=256, embed_dim=64, num_heads=4, num_kv_heads=2,
         ff_dim=128, num_layers=2, seq_len=64, gated=True,
         max_positions=0, dtype="float32")
-    sc = ServingConfig(slots=4, page_size=8, num_pages=48,
-                       max_seq_len=64, slo_ttft_ms=250.0,
-                       slo_tpot_ms=100.0)
-    plan = ArrivalPlan(kind="poisson", rate_rps=100.0, num_requests=16,
-                       seed=0, prompt_len=[8, 16], output_len=[4, 8])
-    engine = Engine(mc, sc)
+    # attn_impl pinned to the gather math on EVERY backend: the A/B
+    # measures dispatch structure (steps per host round-trip), and the
+    # token-parity lock demands all three engines share one attention
+    # basis — the speculative verify pass runs dense-gather (the
+    # Pallas decode kernel is single-query), so an auto-Pallas 1-step
+    # engine on chip would only agree to kernel tolerance, flaking the
+    # exact-equality lock on precisely the platform that matters
+    base = ServingConfig(slots=4, page_size=8, num_pages=48,
+                         max_seq_len=40, slo_ttft_ms=250.0,
+                         slo_tpot_ms=100.0, attn_impl="gather")
+    n_fused = 16
+    variants = {
+        "one_step": base,
+        "multi_step": dataclasses.replace(base, multi_step_n=n_fused),
+        "speculative": dataclasses.replace(
+            base, multi_step_n=n_fused, speculative=True, spec_k=4,
+            drafter="ngram"),
+    }
+    # saturating plan (arrivals land ~immediately): the wall is busy
+    # time, so host fractions measure dispatch, not queue idle; long
+    # outputs give the fused loop room to amortize
+    plan = ArrivalPlan(kind="poisson", rate_rps=5000.0,
+                       num_requests=8, seed=0, prompt_len=[8, 16],
+                       output_len=[16, 24])
+    params = init_params(jax.random.key(0), mc)
     requests = plan.sample()
-    engine.run(requests)  # warm round (first-dispatch costs), discarded
-    rounds = []
+    engines = {name: Engine(mc, cfg, params=params)
+               for name, cfg in variants.items()}
+    streams: dict[str, dict] = {}
+    for name, eng in engines.items():
+        eng.run(requests)   # warm round (first-dispatch), discarded
+    rounds: dict[str, list] = {name: [] for name in engines}
     for _ in range(3):
-        completed, wall = engine.run(requests)
-        rounds.append(smetrics.serving_block(
-            completed, plan, slo_ttft_ms=sc.slo_ttft_ms,
-            slo_tpot_ms=sc.slo_tpot_ms, wall_s=wall,
-            engine_steps=engine.engine_steps,
-            cache_stats=engine.cache.stats(),
-            queue_depth_max=engine.queue_depth_max,
-            batch_occupancy_mean=engine.batch_occupancy_mean()))
+        for name, eng in engines.items():
+            completed, wall = eng.run(requests)
+            streams[name] = dict(eng.token_streams)
+            rounds[name].append(smetrics.serving_block(
+                completed, plan, slo_ttft_ms=base.slo_ttft_ms,
+                slo_tpot_ms=base.slo_tpot_ms, wall_s=wall,
+                engine_steps=eng.engine_steps,
+                cache_stats=eng.cache.stats(),
+                queue_depth_max=eng.queue_depth_max,
+                batch_occupancy_mean=eng.batch_occupancy_mean(),
+                decode_loop=eng.decode_loop_block()))
+    parity = all(streams[name] == streams["one_step"]
+                 for name in engines)
     dev = jax.devices()[0]
     line = _serving_decode_line(
-        rounds, suffix=f", {len(requests)} req slots={sc.slots} "
-                       f"page={sc.page_size}, {dev.device_kind}")
+        rounds["one_step"],
+        suffix=f", {len(requests)} req slots={base.slots} "
+               f"page={base.page_size} vs fused N={n_fused} vs "
+               f"N={n_fused}+spec, {dev.device_kind}",
+        multi_rounds=rounds["multi_step"],
+        spec_rounds=rounds["speculative"], token_parity=parity)
     print(json.dumps(line))
     return line
 

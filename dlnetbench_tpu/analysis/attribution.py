@@ -307,6 +307,100 @@ def attribute_decomposition(full_s: list[float], compute_s: list[float],
                      on_accelerator=on_accelerator)
 
 
+# -- serving decode-loop dispatch decomposition (ISSUE 11) -------------
+
+def serving_host_us(decode_loop: dict,
+                    dispatch_floor_us: float = 0.0) -> float:
+    """The host side of a serving run's wall from its priced
+    crossings: per-dispatch host overhead + both sync directions,
+    plus ``dispatches * dispatch_floor_us`` when a measured per-
+    dispatch floor is available (``dispatch_decomposition``) — the
+    fold that makes decode steps-per-dispatch a first-class host-
+    fraction lever: N fused steps pay ONE floor."""
+    h = float((decode_loop.get("host_dispatch_us") or {})
+              .get("total", 0.0))
+    h += float((decode_loop.get("sync_h2d_us") or {}).get("total", 0.0))
+    h += float((decode_loop.get("sync_d2h_us") or {}).get("total", 0.0))
+    return h + float(decode_loop.get("dispatches", 0)) \
+        * dispatch_floor_us
+
+
+def dispatch_decomposition(one_step: dict,
+                           multi_step: dict) -> dict | None:
+    """Solve the per-dispatch overhead out of a PAIRED 1-step vs
+    N-step measurement (the serving A/B's two-point system):
+    per-device-step wall in 1-step mode is ``silicon + floor``, in
+    fused mode ``silicon + floor / steps_per_dispatch`` — the fused
+    loop IS the measurement instrument for dispatch cost (the same
+    idea as the r6 chained-fence timing, applied to serving).
+    Returns ``{dispatch_us, silicon_us_per_step, steps_per_dispatch}``
+    or None when the pair is degenerate (no fused amortization, or
+    missing fields).  Divides by the DECODE-only device leg
+    (``decode_device_us``) so prefill calls — device time but not
+    decode steps — cannot inflate the solve; ``device_us`` (which
+    includes prefill) is the fallback for blocks that predate the
+    split.  Caveat: on an ASYNC backend, inline-mode prefill chunks
+    are dispatch-acknowledged, not fenced (scheduler._prefill_one), so
+    their queued compute can complete inside the next decode window —
+    feed this solver separate-prefill rounds (the bench A/B does)."""
+    def _per_step(block: dict) -> float:
+        dev = block.get("decode_device_us") or block["device_us"]
+        return float(dev["total"]) / block["device_steps"]
+
+    try:
+        d1 = _per_step(one_step)
+        dn = _per_step(multi_step)
+        spd = float(multi_step["steps_per_dispatch"])
+    except (KeyError, TypeError, ZeroDivisionError):
+        return None
+    if spd <= 1.0:
+        return None
+    floor = max(0.0, (d1 - dn) / (1.0 - 1.0 / spd))
+    return {"dispatch_us": round(floor, 1),
+            "silicon_us_per_step": round(max(0.0, d1 - floor), 1),
+            "steps_per_dispatch": round(spd, 3)}
+
+
+def attribute_serving(rec: dict) -> dict | None:
+    """Attribution for a serving record from its own dispatch
+    decomposition (ISSUE 11): the engine prices every host<->device
+    crossing — per-dispatch host overhead (``host_dispatch_us``, wall
+    minus the compiled-call leg) and the admission syncs — and
+    measures the device-program leg, so ``compute`` is the measured
+    device share of the wall and the residual (dispatch overhead,
+    syncs, admission bookkeeping, queue idle) is ``host``.  The
+    compute basis is MEASURED: a virtual/CPU mesh can never verdict
+    ``mxu`` (``on_accelerator`` only on a TPU platform), which is why
+    the CPU-mesh A/B evidence is the host-fraction drop, not a bound
+    flip.  Single records carry no dispatch floor; the paired A/B
+    (bench.py) folds ``dispatch_decomposition`` in on top."""
+    g = rec.get("global", {})
+    srv = g.get("serving") or {}
+    dl = srv.get("decode_loop")
+    wall_s = srv.get("wall_s")
+    if not isinstance(dl, dict) or not wall_s:
+        return None
+    T = float(wall_s) * 1e6
+    host_us = serving_host_us(dl)
+    dev_us = float((dl.get("device_us") or {}).get("total", 0.0))
+    inputs = {"source": "serving_dispatch",
+              "multi_step_n": dl.get("multi_step_n"),
+              "dispatches": dl.get("dispatches"),
+              "steps_per_dispatch": dl.get("steps_per_dispatch"),
+              "tokens_per_sync": dl.get("tokens_per_sync"),
+              "host_dispatch_us": round(host_us, 1)}
+    spec = dl.get("spec")
+    if isinstance(spec, dict):
+        inputs["spec_acceptance_rate"] = spec.get("acceptance_rate")
+    faulted = bool((g.get("fault_plan") or {}).get("events"))
+    mesh = rec.get("mesh", {})
+    return _assemble(time_us=T, mxu_us=None, hbm_us=None, comm_us=0.0,
+                     measured_compute_us=dev_us, transport=None,
+                     faulted=faulted, achieved=None, top_ops=None,
+                     inputs=inputs,
+                     on_accelerator=mesh.get("platform") == "tpu")
+
+
 # -- proxy / sweep / native records ------------------------------------
 
 def _pooled(rows: list[dict], timer: str) -> list[float]:
@@ -324,8 +418,12 @@ def attribute_record(rec: dict) -> dict | None:
     the mesh names one, the measured decomposition timers, the declared
     ``comm_model`` bytes against the transport's peak, and the device-
     trace occupancy when ``--profile`` captured one.  Returns None when
-    the record carries no usable runtime samples."""
+    the record carries no usable runtime samples.  Serving records
+    (ISSUE 11) attribute from their dispatch decomposition instead —
+    their per-rank timers are request latencies, not step runtimes."""
     g = rec.get("global", {})
+    if isinstance(g.get("serving"), dict):
+        return attribute_serving(rec)
     rows = rec.get("ranks") or []
     runtimes = _pooled(rows, "runtimes")
     if not runtimes:

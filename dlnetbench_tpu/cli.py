@@ -447,6 +447,33 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
                    help="decode attention path: Pallas paged_attention "
                         "kernel (TPU) vs dense gather fallback; auto "
                         "picks by backend")
+    p.add_argument("--multi_step_n", type=int, default=1,
+                   help="decode steps fused per host dispatch "
+                        "(ISSUE 11): >1 runs a device-resident "
+                        "lax.while_loop with slot state on device, "
+                        "host sync at admission boundaries only; 1 = "
+                        "the classic per-token engine (docs/SERVING.md "
+                        "'The multi-step loop')")
+    p.add_argument("--no_adaptive_n", action="store_true",
+                   help="disable the adaptive trip-count cap "
+                        "(shortest-remaining-output + queue pressure "
+                        "— the TTFT guard); the fused loop then "
+                        "always runs the full N")
+    p.add_argument("--speculative", action="store_true",
+                   help="self-drafting speculative decode inside the "
+                        "fused loop: draft k, verify in one batched "
+                        "target pass, accept on device — lossless "
+                        "under greedy; acceptance rate rides the "
+                        "record")
+    p.add_argument("--spec_k", type=int, default=4,
+                   help="draft tokens per verify round")
+    p.add_argument("--drafter", default="ngram",
+                   choices=["ngram", "truncated"],
+                   help="ngram: per-slot bigram table on device; "
+                        "truncated: first --drafter_layers layers of "
+                        "the target + shared head")
+    p.add_argument("--drafter_layers", type=int, default=1,
+                   help="truncated drafter depth (< --layers)")
     # decode-model shape (tiny CPU-feasible defaults; a real study on
     # chip raises these)
     p.add_argument("--embed", type=int, default=64)
@@ -520,9 +547,21 @@ def _run_serve(args, parser) -> int:
         prefill=args.prefill, prefill_chunk=args.prefill_chunk,
         slo_ttft_ms=args.slo_ttft_ms, slo_tpot_ms=args.slo_tpot_ms,
         world=args.world, kv_shard=args.kv_shard,
-        attn_impl=args.attn_impl)
+        attn_impl=args.attn_impl, multi_step_n=args.multi_step_n,
+        adaptive_n=not args.no_adaptive_n,
+        speculative=args.speculative, spec_k=args.spec_k,
+        drafter=args.drafter, drafter_layers=args.drafter_layers)
     try:
         srv_cfg.validate()
+        if srv_cfg.speculative:
+            # the model-shape half of the speculative guard (a
+            # full-depth truncated drafter) fails HERE as a tidy usage
+            # error, not as a traceback from the engine build
+            from dlnetbench_tpu.serving.speculative import \
+                check_spec_config
+            check_spec_config(model_cfg, spec_k=srv_cfg.spec_k,
+                              drafter=srv_cfg.drafter,
+                              drafter_layers=srv_cfg.drafter_layers)
     except ValueError as e:
         parser.error(str(e))
 

@@ -239,6 +239,10 @@ class CompiledStep:
             lowered = jax.jit(fn, donate_argnums=donate).lower(
                 *example_args)
             self._compiled = lowered.compile(compiler_options)
+        # abstract output leaves (shape/dtype), kept so subclasses can
+        # validate structural contracts (CompiledLoop's carry check)
+        # without re-tracing
+        self.out_info = lowered.out_info
         self.stats = {"compile_ms": round(
             (time.perf_counter() - t0) * 1e3, 3),
             "donated_argnums": list(donate)}
@@ -254,6 +258,63 @@ class CompiledStep:
 
     def __call__(self, *args):
         return self._compiled(*args)
+
+
+class CompiledLoop(CompiledStep):
+    """The FOURTH executor shape (ISSUE 11): a device-resident
+    multi-step program whose donated arguments are LOOP CARRIES.
+
+    A fused N-step decode program carries slot state (last tokens,
+    positions, active flags, remaining budgets) and the KV page pools
+    through every in-loop step and hands them back to the caller only
+    at sync boundaries.  Those buffers are donated (``carry_argnums``)
+    so XLA updates them in place across the N steps, and the caller
+    rebinds each carry from the program's outputs before the next
+    call — which only works if the program actually RETURNS its
+    carries as the LEADING outputs, in argument order, shape/dtype
+    matched.  ``CompiledStep`` leaves a donation without a matching
+    output to an XLA warning; for a loop program that mistake hands
+    the caller a dead buffer at the second sync, so construction here
+    validates the carry contract and fails loud.
+
+    ``num_carry_outputs`` is the split point: ``outs[:n]`` are the
+    updated carries (rebind them), ``outs[n:]`` the per-sync results
+    (token blocks, counts, loop-trip stats)."""
+
+    def __init__(self, fn: Callable, example_args: tuple,
+                 carry_argnums: tuple,
+                 compiler_options: dict | None = None):
+        super().__init__(fn, example_args,
+                         donate_argnums=tuple(carry_argnums),
+                         compiler_options=compiler_options)
+        self.carry_argnums = tuple(carry_argnums)
+        out_leaves = jax.tree.leaves(self.out_info)
+        pos = 0
+        for argnum in self.carry_argnums:
+            for leaf in jax.tree.leaves(example_args[argnum]):
+                if pos >= len(out_leaves):
+                    raise ValueError(
+                        f"CompiledLoop: carry argnum {argnum} has no "
+                        f"output to rebind from — the loop program "
+                        f"must return its carries first, in argument "
+                        f"order ({len(out_leaves)} outputs total)")
+                o = out_leaves[pos]
+                if o.shape != leaf.shape or o.dtype != leaf.dtype:
+                    raise ValueError(
+                        f"CompiledLoop: carry argnum {argnum} "
+                        f"(leaf {leaf.shape}/{leaf.dtype}) does not "
+                        f"match leading output {pos} "
+                        f"({o.shape}/{o.dtype}) — a donated carry "
+                        f"without a structurally matching output "
+                        f"would be a dead buffer at the next sync")
+                pos += 1
+        self.num_carry_outputs = pos
+
+    def split(self, outs: tuple) -> tuple[tuple, tuple]:
+        """(updated carries, per-sync results) from one call's
+        outputs."""
+        return (tuple(outs[:self.num_carry_outputs]),
+                tuple(outs[self.num_carry_outputs:]))
 
 
 def _clone(tree):

@@ -173,6 +173,24 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                                 if p in pcts:
                                     row[f"serving_{base[:-3]}_{p}_ms"] \
                                         = pcts[p]
+                    # the ISSUE 11 dispatch decomposition: how many
+                    # device decode steps each host dispatch amortized
+                    # and what a crossing cost — the columns the
+                    # N-step A/B grids by
+                    dl = srv.get("decode_loop")
+                    if isinstance(dl, dict):
+                        row["serving_steps_per_dispatch"] = \
+                            dl.get("steps_per_dispatch")
+                        row["serving_tokens_per_sync"] = \
+                            dl.get("tokens_per_sync")
+                        hd = dl.get("host_dispatch_us")
+                        if isinstance(hd, dict):
+                            row["serving_host_dispatch_us_p50"] = \
+                                hd.get("p50")
+                        spec = dl.get("spec")
+                        if isinstance(spec, dict):
+                            row["serving_spec_acceptance"] = \
+                                spec.get("acceptance_rate")
                 for tname, tvals in timers.items():
                     if run < len(tvals):
                         # singular column names a la reference ('runtime')

@@ -19,7 +19,14 @@ Modules:
   GQA KV heads.
 * ``decode``    — the decode-path transformer: one AOT-compiled
   single-token decode step + one chunked prefill program, sharing
-  ``models/transformer`` weights.
+  ``models/transformer`` weights — and the ISSUE 11 fused loop:
+  ``make_multi_step_decode`` runs N decode steps inside ONE compiled
+  ``lax.while_loop`` with slot state device-resident.
+* ``speculative`` — self-drafting speculative decode inside the fused
+  loop (ngram-table or truncated-layer drafter, one batched verify
+  pass, on-device greedy acceptance — lossless, parity-locked).
+* ``device_state`` — the host/device state split: packed device slot
+  state with a priced, loudly-guarded host<->device sync contract.
 * ``scheduler`` — the continuous-batching engine loop (admit from the
   queue into free decode slots each step, evict on finish, prefill
   inline-chunked or as a separate phase) plus the fault-composed run

@@ -19,6 +19,17 @@ programs cover serving:
   into the slot's pages and attends causally over cache + chunk.
   ``scheduler`` drives it either to completion at admit time (separate
   prefill phase) or one chunk per engine step (inline-chunked).
+* ``make_multi_step_decode`` — ISSUE 11's tentpole: N decode steps
+  fused into ONE compiled program via ``lax.while_loop``, slot state
+  (last tokens, positions, active flags, per-slot remaining budgets)
+  carried ON DEVICE between steps, so the host pays one dispatch per N
+  tokens instead of one per token.  The loop body is the SAME
+  ``_step_tokens`` math the single-step program runs (token parity
+  with the 1-step engine is a locked test), the trip count is dynamic
+  (``n_steps`` operand + all-slots-done early exit), and a slot that
+  exhausts its budget mid-loop deactivates itself without a host
+  round-trip.  ``serving/speculative.py`` builds the draft/verify loop
+  on the same body.
 
 Only the dense gated (SwiGLU + RMSNorm + RoPE) config is supported —
 the same subset every low-precision path in this repo covers first.
@@ -29,6 +40,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from dlnetbench_tpu.models import layers as L
 from dlnetbench_tpu.models.transformer import TransformerConfig
@@ -91,6 +103,62 @@ def _attn_fn(cache_cfg: CacheConfig, attn_impl: str, mesh):
     return functools.partial(paged_attention_decode, impl=attn_impl)
 
 
+def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
+                 params, k_pages, v_pages, tokens, positions, write_ok,
+                 block_tables, *, layers: int | None = None):
+    """ONE batched single-token step over the paged cache — the math
+    both the single-step program and the fused multi-step loop body run
+    (sharing the definition is what makes N-step-vs-1-step token parity
+    a structural property, not a numerics hope).
+
+    ``write_ok`` [B] gates the k/v cache write (inactive slots write
+    nowhere: out-of-bounds page index + ``drop`` mode; their
+    next_token is garbage the caller masks).  Attention covers
+    ``positions + 1`` tokens (write-then-read: the fed token's k/v
+    land first).  ``layers`` truncates the stack — the speculative
+    TRUNCATED drafter is literally the first ``layers`` layers of the
+    target plus the shared final-norm/head (serving/speculative.py);
+    ``None`` runs the full depth."""
+    b = tokens.shape[0]
+    scale = cfg.head_dim ** -0.5
+    page_size = cache_cfg.page_size
+    num_pages = cache_cfg.num_pages
+    x = params["embed"][tokens]                      # [B, D]
+    page_col = positions // page_size
+    page_id = jnp.take_along_axis(block_tables, page_col[:, None],
+                                  axis=1)[:, 0]
+    w_pages = jnp.where(write_ok, page_id, num_pages)  # OOB -> drop
+    slots = positions % page_size
+    att_lengths = positions + 1
+    depth = cfg.num_layers if layers is None else layers
+    for li in range(depth):
+        lp = jax.tree.map(lambda a: a[li], params["layers"])
+        y = L.rmsnorm(x, lp["norm1"])
+        q = jnp.dot(y, lp["wq"]).reshape(b, cfg.num_heads,
+                                         cfg.head_dim)
+        k = jnp.dot(y, lp["wk"]).reshape(b, cfg.num_kv_heads,
+                                         cfg.head_dim)
+        v = jnp.dot(y, lp["wv"]).reshape(b, cfg.num_kv_heads,
+                                         cfg.head_dim)
+        q, k = _rope_decode(q, k, positions)
+        # write-then-read: the new token's k/v land in the page pool
+        # first, so attention covers it like every cached token
+        k_pages = k_pages.at[li, :, w_pages, slots, :].set(
+            k, mode="drop")
+        v_pages = v_pages.at[li, :, w_pages, slots, :].set(
+            v, mode="drop")
+        att = attn(q * scale, k_pages[li], v_pages[li], att_lengths,
+                   block_tables)
+        x = x + jnp.dot(att.reshape(b, cfg.embed_dim), lp["wo"])
+        y = L.rmsnorm(x, lp["norm2"])
+        x = x + L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
+    x = L.rmsnorm(x, params["final_norm"])
+    head = params["embed"].T if cfg.tied_embeddings else params["head"]
+    logits = jnp.dot(x, head, preferred_element_type=_F32)
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return k_pages, v_pages, next_tokens
+
+
 def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
                      *, attn_impl: str = "auto", mesh=None):
     """``decode_step(params, k_pages, v_pages, tokens, positions,
@@ -102,49 +170,96 @@ def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
     Inactive slots write nowhere (out-of-bounds page index + ``drop``
     mode) and their next_token is garbage the engine ignores."""
     check_config(cfg, decode=True)
-    scale = cfg.head_dim ** -0.5
-    page_size = cache_cfg.page_size
-    num_pages = cache_cfg.num_pages
     attn = _attn_fn(cache_cfg, attn_impl, mesh)
 
     def decode_step(params, k_pages, v_pages, tokens, positions,
                     block_tables, active):
-        b = tokens.shape[0]
-        x = params["embed"][tokens]                      # [B, D]
-        page_col = positions // page_size
-        page_id = jnp.take_along_axis(block_tables, page_col[:, None],
-                                      axis=1)[:, 0]
-        w_pages = jnp.where(active, page_id, num_pages)  # OOB -> drop
-        slots = positions % page_size
-        att_lengths = positions + 1
-        for li in range(cfg.num_layers):
-            lp = jax.tree.map(lambda a: a[li], params["layers"])
-            y = L.rmsnorm(x, lp["norm1"])
-            q = jnp.dot(y, lp["wq"]).reshape(b, cfg.num_heads,
-                                             cfg.head_dim)
-            k = jnp.dot(y, lp["wk"]).reshape(b, cfg.num_kv_heads,
-                                             cfg.head_dim)
-            v = jnp.dot(y, lp["wv"]).reshape(b, cfg.num_kv_heads,
-                                             cfg.head_dim)
-            q, k = _rope_decode(q, k, positions)
-            # write-then-read: the new token's k/v land in the page pool
-            # first, so attention covers it like every cached token
-            k_pages = k_pages.at[li, :, w_pages, slots, :].set(
-                k, mode="drop")
-            v_pages = v_pages.at[li, :, w_pages, slots, :].set(
-                v, mode="drop")
-            att = attn(q * scale, k_pages[li], v_pages[li], att_lengths,
-                       block_tables)
-            x = x + jnp.dot(att.reshape(b, cfg.embed_dim), lp["wo"])
-            y = L.rmsnorm(x, lp["norm2"])
-            x = x + L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
-        x = L.rmsnorm(x, params["final_norm"])
-        head = params["embed"].T if cfg.tied_embeddings else params["head"]
-        logits = jnp.dot(x, head, preferred_element_type=_F32)
-        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return k_pages, v_pages, next_tokens
+        return _step_tokens(cfg, cache_cfg, attn, params, k_pages,
+                            v_pages, tokens, positions, active,
+                            block_tables)
 
     return decode_step
+
+
+# rows of the packed device slot-state carry ([4, slots] int32 — ONE
+# array crosses the host<->device boundary per sync direction, not
+# four; device_state.py mirrors the same layout)
+STATE_LAST, STATE_POS, STATE_REM, STATE_LIMIT = 0, 1, 2, 3
+STATE_ROWS = 4
+
+
+def make_multi_step_decode(cfg: TransformerConfig,
+                           cache_cfg: CacheConfig, n_max: int, *,
+                           attn_impl: str = "auto", mesh=None):
+    """The device-resident fused decode loop (ISSUE 11 tentpole).
+
+    ``multi_step(params, k_pages, v_pages, state, block_tables,
+    n_steps) -> (k_pages, v_pages, state, tokens_out, counts,
+    steps_run)``.
+
+    Runs up to ``min(n_steps, n_max)`` decode steps inside ONE compiled
+    program (``lax.while_loop`` — dynamic trip count, so an adaptive
+    ``n_steps`` needs no recompile and the loop exits early the moment
+    every slot is done).  Slot state lives in the packed ``state``
+    carry (``[4, slots]`` int32 — rows ``STATE_LAST`` the token each
+    slot feeds next, ``STATE_POS`` the cache write index = tokens
+    cached, ``STATE_REM`` output tokens still owed, ``STATE_LIMIT``
+    the prompt+output reservation cap).  ``remaining > 0`` IS the
+    active/done bit: a slot whose budget hits 0 deactivates itself
+    in-loop, stops writing the cache, and waits for the host to evict
+    it at the next sync.  ``tokens_out[b, j]`` holds slot ``b``'s j-th
+    generated token of this call, ``counts[b]`` how many are valid,
+    and ``steps_run`` the loop trips actually executed (the host's
+    steps-per-dispatch metric).  Per step each active slot feeds one
+    token and generates one, so ``positions`` advances exactly
+    ``counts`` — the host-side page-table ``append`` is one batched
+    call per SYNC, not per token.
+
+    The loop body is ``_step_tokens`` — the same math
+    ``make_decode_step`` runs — so the N-step greedy token stream
+    equals the 1-step engine's exactly (locked by test)."""
+    check_config(cfg, decode=True)
+    if n_max < 1:
+        raise ValueError(f"multi_step_decode: n_max must be >= 1, "
+                         f"got {n_max}")
+    attn = _attn_fn(cache_cfg, attn_impl, mesh)
+
+    def multi_step(params, k_pages, v_pages, state, block_tables,
+                   n_steps):
+        b = state.shape[1]
+        n = jnp.minimum(n_steps.astype(jnp.int32), n_max)
+        out0 = jnp.zeros((b, n_max), jnp.int32)
+        counts0 = jnp.zeros((b,), jnp.int32)
+
+        def cond(carry):
+            i, _, _, st, _, _ = carry
+            return (i < n) & jnp.any(st[STATE_REM] > 0)
+
+        def body(carry):
+            i, kp, vp, st, out, cnt = carry
+            last, pos, rem = (st[STATE_LAST], st[STATE_POS],
+                              st[STATE_REM])
+            act = rem > 0
+            kp, vp, nxt = _step_tokens(cfg, cache_cfg, attn, params,
+                                       kp, vp, last, pos, act,
+                                       block_tables)
+            # append each active slot's token at its own count index;
+            # inactive slots aim past the buffer edge and drop
+            idx = jnp.where(act, cnt, n_max)
+            out = out.at[jnp.arange(b), idx].set(nxt, mode="drop")
+            step = act.astype(jnp.int32)
+            st = st.at[STATE_LAST].set(jnp.where(act, nxt, last))
+            st = st.at[STATE_POS].set(pos + step)
+            st = st.at[STATE_REM].set(rem - step)
+            cnt = cnt + step
+            return (i + 1, kp, vp, st, out, cnt)
+
+        i, kp, vp, st, out, cnt = lax.while_loop(
+            cond, body,
+            (jnp.int32(0), k_pages, v_pages, state, out0, counts0))
+        return kp, vp, st, out, cnt, i
+
+    return multi_step
 
 
 def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,

@@ -1,0 +1,202 @@
+"""Device-resident decode-loop state + the priced host<->device sync.
+
+The ISSUE 11 engine split: ``scheduler.Engine`` keeps HOST-side
+scheduling state (the arrival queue, pending list, page free-list,
+per-request bookkeeping) while everything the fused decode loop needs
+per step lives HERE, on device, between syncs — packed into ONE
+``[4, slots]`` int32 carry (``decode.STATE_*`` rows: last token,
+position, remaining budget, reservation limit; ``remaining > 0`` IS
+the active/done bit) plus the block tables and, when speculative, the
+per-slot ngram table.  Packing matters: a sync crosses the boundary
+as one transfer per array, and the first draft of this module moved
+six tiny arrays per direction — the sync cost rivaled the dispatch
+cost the loop exists to amortize.
+
+The sync contract (every crossing is a recorded timer):
+
+* ``flush()``  — host -> device: upload the host mirrors.  Happens at
+  ADMISSION BOUNDARIES only (a new slot entering decode phase, a
+  drain) — never per token.  Priced into ``sync_h2d_us``.
+* ``rebind()`` — the fused program returned updated carries: the
+  device refs move forward and the host mirrors go STALE.  Free (no
+  transfer) — the whole point of the device-resident loop.
+* ``pull()``   — device -> host: refresh the mirrors from the device
+  carries.  Required before mutating a stale mirror (``admit``/
+  ``evict`` on stale state raise ``SyncContractError`` — the loud
+  guard that keeps a scheduler change from silently clobbering
+  device-advanced slots with stale host values).  Priced into
+  ``sync_d2h_us``.
+
+``host_view()`` round-trips losslessly with the device arrays under
+any interleaving of admit/evict/flush/advance/pull — the property test
+in tests/test_decode_loop.py drives exactly that.
+"""
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from dlnetbench_tpu.serving.decode import (STATE_LAST, STATE_LIMIT,
+                                           STATE_POS, STATE_REM,
+                                           STATE_ROWS)
+
+
+class SyncContractError(RuntimeError):
+    """A host mirror was mutated while stale — the engine must
+    ``pull()`` at the sync boundary before admitting/evicting, or the
+    flush would overwrite device-advanced slot state with stale host
+    values (silent token corruption, not a crash — hence the loud
+    guard)."""
+
+
+class DeviceDecodeState:
+    """Slot state for the fused decode loop, mirrored host-side.
+
+    Carries (loop-carried, donated by the executor, rebound from the
+    program's leading outputs): the packed ``state`` array — plus
+    ``ngram_table`` when speculative.  Operand (pushed at flush,
+    read-only inside the loop): ``block_tables``.
+    """
+
+    def __init__(self, slots: int, max_pages_per_seq: int,
+                 vocab: int | None = None):
+        self.slots = slots
+        self.vocab = vocab
+        self.state = np.zeros((STATE_ROWS, slots), np.int32)
+        self.block_tables = np.zeros((slots, max_pages_per_seq),
+                                     np.int32)
+        self.ngram_table = (np.zeros((slots, vocab), np.int32)
+                            if vocab else None)
+        self._dev: dict[str, object] = {}
+        # per-FIELD dirty tracking: an evict touches one int of the
+        # packed state, and re-uploading the block tables (or a
+        # [slots, vocab] ngram table) alongside it would put exactly
+        # the sync cost this module exists to minimize back on the
+        # admission path.  (Finer still — row-granular device-side
+        # updates — is a future optimization; admission itself does
+        # rewrite whole rows.)
+        self._dirty: set = set(self.carry_fields) | {"block_tables"}
+        self.stale = False
+        self.sync_h2d_us: list[float] = []
+        self.sync_d2h_us: list[float] = []
+
+    # the loop-carried fields, in the loop programs' argument order
+    @property
+    def carry_fields(self) -> tuple[str, ...]:
+        return (("state", "ngram_table")
+                if self.ngram_table is not None else ("state",))
+
+    # ---- host-side mutation (admission boundaries) -------------------
+    def _require_fresh(self, what: str) -> None:
+        if self.stale:
+            raise SyncContractError(
+                f"device_state: {what} on STALE host mirrors — the "
+                f"device advanced since the last pull(); sync first or "
+                f"the next flush clobbers device state")
+
+    def admit(self, slot: int, *, last_token: int, position: int,
+              remaining: int, seq_limit: int, block_row,
+              ngram_row=None) -> None:
+        """A slot enters the decode phase (prefill just completed):
+        seed its device-visible state.  ``remaining`` is the output
+        budget still owed (``remaining > 0`` is the active bit);
+        ``seq_limit`` the prompt+output page reservation the loop must
+        never write past."""
+        self._require_fresh("admit")
+        self.state[STATE_LAST, slot] = last_token
+        self.state[STATE_POS, slot] = position
+        self.state[STATE_REM, slot] = remaining
+        self.state[STATE_LIMIT, slot] = seq_limit
+        self.block_tables[slot, :] = block_row
+        self._dirty |= {"state", "block_tables"}
+        if self.ngram_table is not None:
+            self.ngram_table[slot, :] = (
+                0 if ngram_row is None else ngram_row)
+            self._dirty.add("ngram_table")
+
+    def evict(self, slot: int) -> None:
+        """Host-forced removal (drain, crash segmentation).  A slot
+        that ran its budget down deactivated ITSELF on device (the
+        in-loop done bit) and needs no evict call."""
+        self._require_fresh("evict")
+        self.state[STATE_REM, slot] = 0
+        self._dirty.add("state")
+
+    # ---- the sync points (each priced) -------------------------------
+    def flush(self) -> None:
+        """Host -> device, priced.  No-op when nothing changed; only
+        the fields a host mutation actually touched are uploaded."""
+        if not self._dirty:
+            return
+        t0 = time.perf_counter()
+        for name in sorted(self._dirty):
+            self._dev[name] = jnp.asarray(getattr(self, name))
+        self.sync_h2d_us.append((time.perf_counter() - t0) * 1e6)
+        self._dirty = set()
+        self.stale = False
+
+    def carries(self) -> tuple:
+        """The loop-carried device arrays, flushing first if a host
+        mutation is pending."""
+        self.flush()
+        return tuple(self._dev[name] for name in self.carry_fields)
+
+    def block_tables_device(self):
+        self.flush()
+        return self._dev["block_tables"]
+
+    def rebind(self, new_carries: tuple) -> None:
+        """Adopt the fused program's updated carries (device refs only
+        — no transfer); host mirrors go stale until the next pull."""
+        names = self.carry_fields
+        if len(new_carries) != len(names):
+            raise ValueError(
+                f"device_state: rebind got {len(new_carries)} carries, "
+                f"expected {len(names)} ({names})")
+        for name, arr in zip(names, new_carries):
+            self._dev[name] = arr
+        self.stale = True
+
+    def pull(self) -> None:
+        """Device -> host, priced: refresh the mirrors so the host may
+        mutate again."""
+        if not self.stale:
+            return
+        t0 = time.perf_counter()
+        for name in self.carry_fields:
+            np.copyto(getattr(self, name), np.asarray(self._dev[name]))
+        self.sync_d2h_us.append((time.perf_counter() - t0) * 1e6)
+        self.stale = False
+
+    def sync_total_us(self) -> float:
+        """Both channels' running total — the engine samples it around
+        a step so in-step sync time can be EXCLUDED from
+        host_dispatch_us (each crossing must be priced exactly once;
+        serving_host_us sums the channels back together)."""
+        return sum(self.sync_h2d_us) + sum(self.sync_d2h_us)
+
+    # ---- inspection ---------------------------------------------------
+    def host_view(self) -> dict:
+        """Copies of the host mirrors (pull() first if stale to see
+        device truth) — the property-test surface."""
+        out = {
+            "last_tokens": self.state[STATE_LAST].copy(),
+            "positions": self.state[STATE_POS].copy(),
+            "remaining": self.state[STATE_REM].copy(),
+            "seq_limits": self.state[STATE_LIMIT].copy(),
+            "active": (self.state[STATE_REM] > 0).copy(),
+            "block_tables": self.block_tables.copy(),
+        }
+        if self.ngram_table is not None:
+            out["ngram_table"] = self.ngram_table.copy()
+        return out
+
+    def sync_stats(self) -> dict:
+        return {
+            "sync_h2d_us": {"total": round(sum(self.sync_h2d_us), 1),
+                            "n": len(self.sync_h2d_us)},
+            "sync_d2h_us": {"total": round(sum(self.sync_d2h_us), 1),
+                            "n": len(self.sync_d2h_us)},
+        }

@@ -128,9 +128,14 @@ def serving_block(completed: list[Completed], plan: ArrivalPlan, *,
                   wall_s: float, engine_steps: int,
                   cache_stats: dict | None = None,
                   queue_depth_max: int = 0,
-                  batch_occupancy_mean: float = 0.0) -> dict:
+                  batch_occupancy_mean: float = 0.0,
+                  decode_loop: dict | None = None) -> dict:
     """The record's ``serving`` global: aggregate latency percentiles,
-    throughput, and goodput-at-SLO for one run."""
+    throughput, and goodput-at-SLO for one run.  ``decode_loop``
+    (ISSUE 11, ``Engine.decode_loop_block``) adds the dispatch
+    decomposition — steps/tokens per host sync, priced host crossings,
+    speculative acceptance — the attribution engine folds into the
+    host fraction (analysis/attribution.attribute_serving)."""
     ttft = [c.ttft_ms for c in completed]
     tpot = [c.tpot_ms for c in completed]
     e2e = [c.e2e_ms for c in completed]
@@ -143,6 +148,7 @@ def serving_block(completed: list[Completed], plan: ArrivalPlan, *,
         "measured_rps": round(len(completed) / wall_s, 4) if wall_s > 0
         else 0.0,
         "tokens_per_s": round(tokens / wall_s, 4) if wall_s > 0 else 0.0,
+        "wall_s": round(wall_s, 4),
         "engine_steps": engine_steps,
         "ttft_ms": latency_summary(ttft),
         "tpot_ms": latency_summary(tpot),
@@ -158,6 +164,8 @@ def serving_block(completed: list[Completed], plan: ArrivalPlan, *,
     }
     if cache_stats:
         block["kv_cache"] = cache_stats
+    if decode_loop:
+        block["decode_loop"] = decode_loop
     return block
 
 

@@ -10,6 +10,18 @@ slot per step over the paged KV cache, and evict on completion.  A
 saturated engine builds a queue; TTFT p99 blows up — the knee
 ``examples/pod_study.py --serving`` sweeps for.
 
+The host/device state split (ISSUE 11): the engine keeps HOST-side
+scheduling state — the arrival queue, pending list, page free-list,
+per-request stamps — while decode-phase slot state (last tokens,
+positions, active/done bits, remaining budgets, block tables) lives on
+DEVICE between syncs (``serving/device_state.py``) whenever
+``multi_step_n > 1`` or speculative decode is on: one fused
+``lax.while_loop`` program runs up to N decode steps (or draft/verify
+rounds) per host dispatch, and the host crosses the boundary only at
+admission points, every crossing a recorded timer.  ``multi_step_n=1``
+without speculation keeps the classic one-dispatch-per-token engine
+bit-identically (the loop program is not even built — locked by test).
+
 Fault composition (the payoff of riding the existing record schema):
 ``run_serving`` takes the SAME fault plan the training tier uses —
 ``delay``/``jitter`` events sleep at engine-step boundaries inside the
@@ -62,6 +74,34 @@ class ServingConfig:
     attn_impl: str = "auto"     # kv_cache.paged_attention_decode impl
     kv_shard: int = 1           # >1: shard_map along GQA KV heads over
                                 # the first kv_shard devices
+    multi_step_n: int = 1       # decode steps fused per host dispatch
+                                # (ISSUE 11): 1 = the classic one-
+                                # dispatch-per-token engine, BIT-
+                                # identical by construction (the loop
+                                # program is not even built); >1 runs
+                                # up to N steps inside one compiled
+                                # lax.while_loop with slot state on
+                                # device, host sync at admission
+                                # boundaries only
+    adaptive_n: bool = True     # cap N by the shortest remaining
+                                # output among active slots + queue
+                                # pressure, so a fused loop never
+                                # starves an admissible request (TTFT
+                                # guard; docs/SERVING.md)
+    speculative: bool = False   # self-drafting speculative decode
+                                # inside the fused loop: draft spec_k
+                                # tokens, verify in ONE batched target
+                                # pass, accept on device — lossless
+                                # under greedy (serving/speculative.py)
+    spec_k: int = 4             # draft tokens per verify round
+    drafter: str = "ngram"      # "ngram" (per-slot bigram table) |
+                                # "truncated" (first drafter_layers
+                                # layers of the target + shared head)
+    drafter_layers: int = 1     # truncated drafter depth (must be
+                                # < num_layers; checked at build)
+    sampling: str = "greedy"    # greedy only today; speculative +
+                                # non-greedy is refused LOUDLY until
+                                # sampling-aware acceptance lands
     warmup_requests: int = 8    # run_serving drives this many synthetic
                                 # requests through the engine BEFORE the
                                 # measured run (0 disables): first-call
@@ -91,6 +131,27 @@ class ServingConfig:
         if self.slots % self.world:
             raise ValueError("serving: slots must divide evenly across "
                              "world ranks (the fault-shrink unit)")
+        if self.multi_step_n < 1:
+            raise ValueError(f"serving: multi_step_n must be >= 1, "
+                             f"got {self.multi_step_n}")
+        if self.sampling != "greedy":
+            if self.speculative:
+                raise ValueError(
+                    f"serving: speculative decode is lossless under "
+                    f"GREEDY acceptance only — speculative + "
+                    f"sampling={self.sampling!r} is refused until "
+                    f"sampling-aware acceptance lands")
+            raise ValueError(f"serving: unknown sampling "
+                             f"{self.sampling!r} (greedy only)")
+        if self.speculative:
+            from dlnetbench_tpu.serving.speculative import DRAFTERS
+            if self.spec_k < 1:
+                raise ValueError(f"serving: spec_k must be >= 1, got "
+                                 f"{self.spec_k}")
+            if self.drafter not in DRAFTERS:
+                raise ValueError(
+                    f"serving: unknown drafter {self.drafter!r} "
+                    f"(one of {DRAFTERS})")
         return self
 
 
@@ -161,21 +222,55 @@ class Engine:
         self.params = params if params is not None else init_params(
             jax.random.key(0), model_cfg)
         self.meta: dict = {}
+        # the host/device state split (ISSUE 11): multi_step_n == 1 and
+        # no speculation keeps the CLASSIC engine — same single-step
+        # program, same per-token dispatch, bit-identical by
+        # construction (the loop program is not even built); otherwise
+        # the decode path is ONE fused program (lax.while_loop) with
+        # slot state device-resident between admission syncs
+        self._loop_mode = cfg.multi_step_n > 1 or cfg.speculative
+        self._decode = self._loop = None
         with spans.span("build", what="serving engine"):
-            self._decode = executor.CompiledStep(
-                D.make_decode_step(model_cfg, self.cache_cfg,
-                                   attn_impl=cfg.attn_impl, mesh=mesh),
-                self._decode_example_args(), donate_argnums=(1, 2))
+            if self._loop_mode:
+                if cfg.speculative:
+                    from dlnetbench_tpu.serving import speculative as S
+                    S.check_spec_config(
+                        model_cfg, spec_k=cfg.spec_k,
+                        drafter=cfg.drafter,
+                        drafter_layers=cfg.drafter_layers)
+                    loop_fn = S.make_spec_decode_loop(
+                        model_cfg, self.cache_cfg, cfg.multi_step_n,
+                        spec_k=cfg.spec_k, drafter=cfg.drafter,
+                        drafter_layers=cfg.drafter_layers,
+                        attn_impl=cfg.attn_impl, mesh=mesh)
+                    carries = (1, 2, 3, 4)  # pools + packed state +
+                    #                          ngram table
+                else:
+                    loop_fn = D.make_multi_step_decode(
+                        model_cfg, self.cache_cfg, cfg.multi_step_n,
+                        attn_impl=cfg.attn_impl, mesh=mesh)
+                    carries = (1, 2, 3)     # pools + packed state
+                self._loop = executor.CompiledLoop(
+                    loop_fn, self._loop_example_args(),
+                    carry_argnums=carries)
+            else:
+                self._decode = executor.CompiledStep(
+                    D.make_decode_step(model_cfg, self.cache_cfg,
+                                       attn_impl=cfg.attn_impl,
+                                       mesh=mesh),
+                    self._decode_example_args(), donate_argnums=(1, 2))
             self._prefill = executor.CompiledStep(
                 D.make_prefill_chunk(model_cfg, self.cache_cfg,
                                      cfg.prefill_chunk),
                 self._prefill_example_args(), donate_argnums=(1, 2))
+        decode_prog = self._loop if self._loop_mode else self._decode
+        decode_name = "decode_loop" if self._loop_mode else "decode_step"
         self.meta["compile_ms"] = {
-            "decode_step": self._decode.stats["compile_ms"],
+            decode_name: decode_prog.stats["compile_ms"],
             "prefill_chunk": self._prefill.stats["compile_ms"]}
         self.meta["aot"] = {
-            "decode_step": {k: v for k, v in self._decode.stats.items()
-                            if k != "compile_ms"},
+            decode_name: {k: v for k, v in decode_prog.stats.items()
+                          if k != "compile_ms"},
             "prefill_chunk": {k: v for k, v in self._prefill.stats.items()
                               if k != "compile_ms"}}
         self._reset_state()
@@ -233,6 +328,22 @@ class Engine:
                 jnp.int32(0), jnp.int32(0),
                 jnp.zeros((cc.max_pages_per_seq,), jnp.int32))
 
+    def _loop_example_args(self):
+        """Abstract args for the fused decode-loop program (the
+        CompiledLoop contract: pools + slot-state carries lead, then
+        the read-only block tables, then the dynamic trip count)."""
+        cc = self.cache_cfg
+        k, v = self._pool_avals()
+        b = cc.max_seqs
+        args = (self.params, k, v,
+                jnp.zeros((D.STATE_ROWS, b), jnp.int32))  # packed state
+        if self.cfg.speculative:
+            args += (jnp.zeros((b, self.model_cfg.vocab_size),
+                               jnp.int32),)   # ngram table
+        args += (jnp.zeros((b, cc.max_pages_per_seq), jnp.int32),
+                 jnp.int32(1))                # n_steps / n_rounds
+        return args
+
     def _reset_state(self):
         self.cache = PagedKVCache(self.cache_cfg)
         self.k_pages, self.v_pages = self._pools()
@@ -243,6 +354,31 @@ class Engine:
         self.engine_steps = 0
         self.queue_depth_max = 0
         self._occupancy_samples: list[int] = []
+        # ISSUE 11 instrumentation + device-resident slot state.  All
+        # host-side bookkeeping — the 1-step path's MATH is untouched.
+        self.dstate = None
+        if self._loop_mode:
+            from dlnetbench_tpu.serving.device_state import \
+                DeviceDecodeState
+            self.dstate = DeviceDecodeState(
+                self.cfg.slots, self.cache_cfg.max_pages_per_seq,
+                vocab=(self.model_cfg.vocab_size if self.cfg.speculative
+                       else None))
+        self.token_streams: dict[int, list[int]] = {}
+        self._host_dispatch_us: list[float] = []
+        self._dispatches = 0
+        self._device_steps = 0
+        self._device_time_s = 0.0    # ALL compiled-call legs (prefill
+        #                              included) — attribution's
+        #                              measured-compute basis
+        self._decode_device_s = 0.0  # decode dispatches only — the
+        #                              per-step basis the dispatch-
+        #                              floor solve divides by
+        self._tokens_emitted = 0
+        self._drafted = 0
+        self._accepted = 0
+        self._step_ewma_s = 0.0
+        self._n_scalars: dict[int, jax.Array] = {}
 
     # ---- the loop ----------------------------------------------------
     def run(self, requests: list[Request], *, injector=None,
@@ -291,10 +427,17 @@ class Engine:
         engine redoes their work and the disruption lands in their
         measured latency.  Slots and pages are freed."""
         leftovers = [s.req for s in self.slots if s is not None]
+        if self._loop_mode and any(s is not None for s in self.slots):
+            # the drain IS a sync boundary: deactivate the in-flight
+            # slots device-side too, so a reused engine's next flush
+            # starts from an all-idle carry
+            self.dstate.pull()
         for i, s in enumerate(self.slots):
             if s is not None:
                 self.cache.free(i)
                 self.slots[i] = None
+                if self._loop_mode:
+                    self.dstate.evict(i)
         leftovers += self.pending
         leftovers += list(self.queue)
         self.pending, self.queue = [], deque()
@@ -333,7 +476,21 @@ class Engine:
                         and st.prefill_done < req.prompt_len:
                     self._prefill_one(i, st)
 
-    def _prefill_one(self, slot: int, st: _SlotState) -> None:
+    def _prefill_one(self, slot: int, st: _SlotState) -> float:
+        """One prefill chunk; returns the compiled-call wall seconds
+        (the device leg of the host_dispatch_us decomposition).
+
+        Fence honesty: only the PROMPT-COMPLETING chunk fences (its
+        ``int(nxt)`` is load-bearing — the TTFT token).  Intermediate
+        chunks return dispatch-acknowledged wall only; forcing a
+        device->host fence on each would cost a full RTT per chunk on
+        a tunnel backend for timing's sake.  On an async backend their
+        queued compute therefore completes inside a LATER fenced
+        window — in separate-prefill mode that is still the admission
+        phase (the final chunk's fence), but in inline mode it can be
+        the next decode dispatch, which is why the bench A/B and the
+        dispatch-floor solve use separate-mode prefill
+        (``dispatch_decomposition`` documents the caveat)."""
         c = self.cfg.prefill_chunk
         start = st.prefill_done
         n = min(c, st.req.prompt_len - start)
@@ -343,31 +500,84 @@ class Engine:
         chunk_np[:n] = st.prompt[start:start + n]
         chunk = jnp.asarray(chunk_np)
         row = jnp.asarray(self.cache.block_tables[slot])
+        t0 = time.perf_counter()
         self.k_pages, self.v_pages, nxt = self._prefill(
             self.params, self.k_pages, self.v_pages, chunk,
             jnp.int32(start), jnp.int32(n), row)
         st.prefill_done += n
         self.cache.append(slot, n)
+        dev_s = 0.0
         if st.prefill_done >= st.req.prompt_len:
             # the chunk completing the prompt produces the request's
             # FIRST generated token — its TTFT stamp
-            st.last_token = int(nxt)
+            st.last_token = int(nxt)  # the fence: device work done here
+            dev_s = time.perf_counter() - t0
             st.generated = 1
             st.first_token_s = self._now()
+            self.token_streams.setdefault(st.req.rid, []).append(
+                st.last_token)
             self._maybe_finish(slot, st)
+            if self.slots[slot] is st:
+                # entering the decode phase: seed the device-resident
+                # slot state (loop mode's admission sync boundary)
+                self._activate_decode_slot(slot, st)
+        else:
+            dev_s = time.perf_counter() - t0
+        self._device_time_s += dev_s
+        return dev_s
+
+    def _activate_decode_slot(self, slot: int, st: _SlotState) -> None:
+        """Loop mode: a slot finished prefill — push its decode state
+        to the device mirrors (flushed, priced, at the next dispatch)."""
+        if not self._loop_mode:
+            return
+        ds = self.dstate
+        ds.pull()  # sync boundary: refresh before mutating (priced)
+        ngram_row = None
+        if ds.ngram_table is not None:
+            from dlnetbench_tpu.serving.speculative import seed_ngram_row
+            ngram_row = seed_ngram_row(st.prompt, st.last_token,
+                                       self.model_cfg.vocab_size)
+        ds.admit(slot, last_token=st.last_token,
+                 position=int(self.cache.lengths[slot]),
+                 remaining=st.req.output_len - st.generated,
+                 seq_limit=st.req.prompt_len + st.req.output_len,
+                 block_row=self.cache.block_tables[slot],
+                 ngram_row=ngram_row)
 
     def _step(self) -> None:
         """One engine step: inline prefill chunks first (one per
-        prefilling slot), then one decode token for every decode-phase
-        slot, batched."""
+        prefilling slot), then decode — one token per active slot
+        (classic mode) or up to N fused device steps (loop mode).
+        Either way ``host_dispatch_us`` records the step wall MINUS
+        the compiled-call wall: the marshalling/bookkeeping/dispatch
+        overhead the fused loop exists to amortize (ISSUE 11
+        satellite — the A/B's measured before-number)."""
+        if self._loop_mode:
+            self._step_fused()
+        else:
+            self._step_single()
+
+    def _step_preamble(self) -> tuple[list[int], float]:
+        """The per-step work BOTH decode paths share (one definition —
+        the A/B pairing depends on the baselines never desyncing):
+        inline prefill chunks, the decode-phase slot list, occupancy
+        sampling, the step count.  Returns ``(decode_ix, prefill
+        device seconds)``."""
+        dev_s = 0.0
         for i, st in enumerate(self.slots):
             if st is not None and st.prefill_done < st.req.prompt_len:
-                self._prefill_one(i, st)
+                dev_s += self._prefill_one(i, st)
         decode_ix = [i for i, st in enumerate(self.slots)
                      if st is not None
                      and st.prefill_done >= st.req.prompt_len]
         self._occupancy_samples.append(len(decode_ix))
         self.engine_steps += 1
+        return decode_ix, dev_s
+
+    def _step_single(self) -> None:
+        t_step = time.perf_counter()
+        decode_ix, dev_s = self._step_preamble()
         if not decode_ix:
             return
         b = self.cfg.slots
@@ -379,17 +589,133 @@ class Engine:
             tokens[i] = st.last_token
             positions[i] = int(self.cache.lengths[i])
             active[i] = True
+        t0 = time.perf_counter()
         self.k_pages, self.v_pages, nxt = self._decode(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(self.cache.block_tables), jnp.asarray(active))
-        nxt = np.asarray(nxt)
+        nxt = np.asarray(nxt)        # the fence rides the device leg
+        t1 = time.perf_counter()
+        dev_s += t1 - t0
+        self._device_time_s += t1 - t0
+        self._decode_device_s += t1 - t0
+        self._dispatches += 1
+        self._device_steps += 1
         for i in decode_ix:
             st = self.slots[i]
             self.cache.append(i)          # the fed token is now cached
             st.last_token = int(nxt[i])
             st.generated += 1
+            self._tokens_emitted += 1
+            self.token_streams.setdefault(st.req.rid, []).append(
+                st.last_token)
             self._maybe_finish(i, st)
+        self._host_dispatch_us.append(
+            max(0.0, (time.perf_counter() - t_step - dev_s)) * 1e6)
+
+    def _step_fused(self) -> None:
+        """Loop mode: ONE fused device program runs up to N decode
+        steps with slot state resident on device; the host syncs only
+        here — admission updates flushed in, the per-sync token block
+        pulled out, both priced (device_state.py)."""
+        t_step = time.perf_counter()
+        sync0 = self.dstate.sync_total_us()
+        decode_ix, dev_s = self._step_preamble()
+        if not decode_ix:
+            return
+        ds = self.dstate
+        n = self._pick_n_steps(decode_ix)
+        carries = ds.carries()            # flushes if dirty (priced)
+        bt = ds.block_tables_device()
+        t0 = time.perf_counter()
+        outs = self._loop(self.params, self.k_pages, self.v_pages,
+                          *carries, bt, self._n_scalar(n))
+        new_carries, extras = self._loop.split(outs)
+        self.k_pages, self.v_pages = new_carries[0], new_carries[1]
+        ds.rebind(new_carries[2:])
+        if self.cfg.speculative:
+            toks, cnts, steps, drafted, accepted = extras
+        else:
+            toks, cnts, steps = extras
+        # the per-sync results (token block, counts, stats): np.asarray
+        # is the FENCE, so [t0, t2) is the device leg as one unit —
+        # priced into device_us only (sync_d2h_us prices the mirror
+        # pull()s; pricing this interval into both channels would
+        # double-count it against the wall)
+        toks = np.asarray(toks)
+        cnts = np.asarray(cnts)
+        steps = int(steps)
+        if self.cfg.speculative:
+            self._drafted += int(drafted)
+            self._accepted += int(accepted)
+        t2 = time.perf_counter()
+        dev_s += t2 - t0
+        self._device_time_s += t2 - t0
+        self._decode_device_s += t2 - t0
+        self._dispatches += 1
+        self._device_steps += steps
+        if steps > 0:
+            per_step = (t2 - t0) / steps
+            self._step_ewma_s = (per_step if not self._step_ewma_s else
+                                 0.5 * self._step_ewma_s
+                                 + 0.5 * per_step)
+        for i in decode_ix:
+            st = self.slots[i]
+            m = int(cnts[i])
+            if m == 0:
+                continue
+            self.cache.append(i, m)   # all fed tokens, one batched call
+            stream = toks[i, :m].tolist()
+            st.generated += m
+            st.last_token = stream[-1]
+            self._tokens_emitted += m
+            self.token_streams.setdefault(st.req.rid, []).extend(stream)
+            self._maybe_finish(i, st)
+        # exclude in-step sync time: flush/pull are priced in their own
+        # channels and each crossing must count against the wall ONCE
+        # (serving_host_us sums host_dispatch + both sync channels)
+        sync_s = (self.dstate.sync_total_us() - sync0) * 1e-6
+        self._host_dispatch_us.append(
+            max(0.0, (time.perf_counter() - t_step - dev_s - sync_s))
+            * 1e6)
+
+    def _n_scalar(self, n: int):
+        """Cached device scalar for the dynamic trip count (a fresh
+        jnp.int32 per dispatch is a measurable host cost at decode
+        rates)."""
+        s = self._n_scalars.get(n)
+        if s is None:
+            s = self._n_scalars[n] = jnp.int32(n)
+        return s
+
+    def _pick_n_steps(self, decode_ix: list[int]) -> int:
+        """Adaptive N (ISSUE 11 satellite): the fused loop must never
+        starve an admissible request.  Cap the trip count by the
+        SHORTEST remaining output among active slots whenever work is
+        waiting (the loop then returns exactly when the first slot can
+        free capacity), and by the measured steps-until-next-arrival
+        when the queue's head would land mid-loop.  A slot mid-prefill
+        (inline mode) caps at 1 — the one-chunk-per-engine-step
+        interleaving contract."""
+        n = self.cfg.multi_step_n
+        if not self.cfg.adaptive_n:
+            return max(1, n)
+        if any(st is not None and st.prefill_done < st.req.prompt_len
+               for st in self.slots):
+            return 1
+        if n <= 1:
+            return max(1, n)
+        rem_min = min(self.slots[i].req.output_len
+                      - self.slots[i].generated for i in decode_ix)
+        if self.pending:
+            return max(1, min(n, rem_min))
+        if self.queue:
+            dt = self.queue[0].arrival_s - self._now()
+            est = self._step_ewma_s
+            if est > 0 and dt < n * est:
+                steps_until = max(1, int(dt / est) + 1)
+                return max(1, min(n, rem_min, steps_until))
+        return n
 
     def _maybe_finish(self, slot: int, st: _SlotState) -> None:
         if st.generated < st.req.output_len:
@@ -408,6 +734,50 @@ class Engine:
         if not self._occupancy_samples:
             return 0.0
         return sum(self._occupancy_samples) / len(self._occupancy_samples)
+
+    def decode_loop_block(self) -> dict:
+        """The record's dispatch-decomposition block (ISSUE 11): how
+        many device decode steps each host dispatch amortized, what
+        each host crossing cost, and the speculative acceptance stats.
+        Present in BOTH modes — the 1-step engine's block (steps per
+        dispatch = 1, per-step host_dispatch_us) is the measured
+        before-number the A/B flips against."""
+        d = self._dispatches
+        hd = self._host_dispatch_us
+        block = {
+            "multi_step_n": self.cfg.multi_step_n,
+            "adaptive_n": self.cfg.adaptive_n,
+            "speculative": self.cfg.speculative,
+            "dispatches": d,
+            "device_steps": self._device_steps,
+            "steps_per_dispatch": (round(self._device_steps / d, 3)
+                                   if d else 0.0),
+            "tokens_per_sync": (round(self._tokens_emitted / d, 3)
+                                if d else 0.0),
+            "device_us": {"total": round(self._device_time_s * 1e6, 1)},
+            "decode_device_us": {
+                "total": round(self._decode_device_s * 1e6, 1)},
+            "host_dispatch_us": {
+                "total": round(sum(hd), 1),
+                "p50": round(M.percentile(hd, 50), 1) if hd else 0.0,
+                "mean": round(sum(hd) / len(hd), 1) if hd else 0.0,
+                "n": len(hd)},
+        }
+        if self.dstate is not None:
+            block.update(self.dstate.sync_stats())
+        if self.cfg.speculative:
+            block["spec"] = {
+                "k": self.cfg.spec_k,
+                "drafter": self.cfg.drafter,
+                **({"drafter_layers": self.cfg.drafter_layers}
+                   if self.cfg.drafter == "truncated" else {}),
+                "drafted": self._drafted,
+                "accepted": self._accepted,
+                "acceptance_rate": (round(self._accepted
+                                          / self._drafted, 4)
+                                    if self._drafted else 0.0),
+            }
+        return block
 
     def global_meta(self, plan: ArrivalPlan) -> dict:
         from dlnetbench_tpu.parallel.mesh import (describe_mesh,
@@ -429,6 +799,11 @@ class Engine:
                 "prefill": cfg.prefill,
                 "prefill_chunk": cfg.prefill_chunk,
                 "kv_shard": cfg.kv_shard,
+                "multi_step_n": cfg.multi_step_n,
+                "adaptive_n": cfg.adaptive_n,
+                "speculative": cfg.speculative,
+                **({"spec_k": cfg.spec_k, "drafter": cfg.drafter}
+                   if cfg.speculative else {}),
             },
             "mesh": describe_mesh(make_flat_mesh(devices=self.devices)),
             **self.meta,
@@ -524,7 +899,8 @@ def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
         engine_steps=final.engine_steps,
         cache_stats=final.cache.stats(),
         queue_depth_max=final.queue_depth_max,
-        batch_occupancy_mean=final.batch_occupancy_mean())
+        batch_occupancy_mean=final.batch_occupancy_mean(),
+        decode_loop=final.decode_loop_block())
     if fault_plan is not None:
         meta["fault_plan"] = fault_plan.to_dict()
         meta["fault_policy"] = fault_plan.policy

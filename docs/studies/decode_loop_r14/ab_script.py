@@ -1,0 +1,44 @@
+"""Generate the ISSUE 11 decode-loop A/B artifact: run bench.py's
+serving_decode A/B (1-step vs fused N-step vs N-step+speculative) on
+this machine and commit the line + a full serving record per variant.
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python docs/studies/decode_loop_r14/ab_script.py
+
+Fails (non-zero exit) unless the acceptance evidence holds at
+generation time: token parity across all three variants, and the
+host-fraction drop band-disjoint (the CPU-mesh form of the
+attribution flip — on a TPU platform the record's own attribution
+bound flips off `host` instead).
+"""
+import json
+import sys
+from pathlib import Path
+
+OUT = Path(__file__).resolve().parent
+sys.path.insert(0, str(OUT.parents[2]))   # repo root (bench.py lives there)
+
+
+def main() -> int:
+    import bench
+    line = bench._bench_serving_decode()
+    if line is None:
+        print("A/B did not produce a line", file=sys.stderr)
+        return 1
+    ok_parity = line.get("token_parity") is True
+    flip = line.get("attribution_flip") or {}
+    ok_flip = flip.get("band_disjoint_drop") is True
+    (OUT / "serving_decode_ab.json").write_text(
+        json.dumps(line, indent=1) + "\n")
+    print(f"parity={ok_parity} flip={ok_flip} "
+          f"one_host={flip.get('one_step_host_frac', {}).get('value')} "
+          f"multi_host={flip.get('multi_step_host_frac', {}).get('value')}")
+    if not (ok_parity and ok_flip):
+        print("ACCEPTANCE EVIDENCE MISSING", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

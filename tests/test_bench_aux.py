@@ -5,6 +5,8 @@ from __future__ import annotations
 
 import json
 
+import pytest
+
 
 def test_aux_failure_prints_skipped_marker(capsys):
     import bench
@@ -255,6 +257,86 @@ def test_serving_decode_line_schema_locked():
     # --check picks it up as "serving_decode" automatically
     from dlnetbench_tpu.sentinel import is_ms_line
     assert is_ms_line(line)
+
+
+def _ab_round(e2e_p99, tokens_per_s, *, n=1, spd=1.0, dev_us=50000.0,
+              steps=50, disp=50, host_us=500.0, spec=None):
+    """A synthetic per-round serving block with a decode_loop section
+    (the ISSUE 11 A/B inputs)."""
+    dl = {"multi_step_n": n, "steps_per_dispatch": spd,
+          "tokens_per_sync": spd * 4, "dispatches": disp,
+          "device_steps": steps, "device_us": {"total": dev_us},
+          "decode_device_us": {"total": dev_us},
+          "host_dispatch_us": {"total": host_us, "p50": host_us / disp,
+                               "mean": host_us / disp, "n": disp},
+          "sync_h2d_us": {"total": 100.0, "n": 2},
+          "sync_d2h_us": {"total": 100.0, "n": 2}}
+    if spec:
+        dl["spec"] = spec
+    return {"e2e_ms": {"p99": e2e_p99}, "ttft_ms": {"p50": 2.0},
+            "tpot_ms": {"p50": 1.0}, "tokens_per_s": tokens_per_s,
+            "goodput_frac": 1.0, "completed": 8, "offered_rps": 80.0,
+            "wall_s": 0.1, "decode_loop": dl}
+
+
+def test_serving_decode_ab_schema_locked():
+    """The ISSUE 11 A/B extensions of the serving_decode line: paired
+    variant sub-blocks (tokens/s + TPOT bands, speedup, dispatch
+    decomposition), the host-fraction drop with its band-disjoint
+    verdict, speculative acceptance, and the token-parity lock — all
+    while the ISSUE 8 base schema (sentinel-comparable ms line) stays
+    intact."""
+    import bench
+
+    # one-step: 500us/dispatch floor hidden in dev (50 steps x 1000us);
+    # multi: 8 steps/dispatch amortize it (48*500 + 6*500 = 27000us)
+    one = [_ab_round(30.0, 4000.0, dev_us=50 * 1000.0)
+           for _ in range(3)]
+    multi = [_ab_round(15.0, 8000.0, n=8, spd=8.0,
+                       dev_us=48 * 500.0 + 6 * 500.0, steps=48, disp=6,
+                       host_us=120.0) for _ in range(3)]
+    spec = [_ab_round(14.0, 9000.0, n=8, spd=9.0, dev_us=30000.0,
+                      steps=45, disp=5, host_us=110.0,
+                      spec={"k": 4, "drafter": "ngram",
+                            "acceptance_rate": 0.4, "drafted": 100,
+                            "accepted": 40}) for _ in range(3)]
+    line = bench._serving_decode_line(one, suffix=", test",
+                                      multi_rounds=multi,
+                                      spec_rounds=spec,
+                                      token_parity=True)
+    # ISSUE 8 base schema intact
+    assert line["unit"] == "ms" and line["value"] == 30.0
+    from dlnetbench_tpu.sentinel import is_ms_line
+    assert is_ms_line(line)
+    # the A/B blocks
+    for key in ("multi_step", "speculative"):
+        blk = line[key]
+        for sub in ("tokens_per_s", "tpot_p50_ms", "e2e_p99_ms",
+                    "speedup_tokens_per_s", "steps_per_dispatch",
+                    "tokens_per_sync"):
+            for k in ("value", "best", "band", "n"):
+                assert k in blk[sub], (key, sub, k)
+        assert blk["multi_step_n"] == 8
+    assert line["multi_step"]["speedup_tokens_per_s"]["value"] == 2.0
+    assert line["speculative"]["spec"]["acceptance_rate"]["value"] \
+        == 0.4
+    # the attribution flip: per-dispatch floor solved from the pair
+    # (d1=1000, dn=562.5, spd=8 -> floor=500us), host fractions banded,
+    # drop verdict band-disjoint
+    flip = line["attribution_flip"]
+    assert flip["dispatch_us"]["value"] == pytest.approx(500.0, abs=1)
+    assert flip["one_step_host_frac"]["value"] > \
+        flip["multi_step_host_frac"]["value"]
+    assert flip["band_disjoint_drop"] is True
+    assert "speculative_host_frac" in flip
+    assert line["token_parity"] is True
+    # without the A/B inputs the line stays the ISSUE 8 shape (no
+    # accidental keys) — the schema the committed BENCH_r01-05
+    # artifacts' sentinel walk expects
+    base_line = bench._serving_decode_line(one, suffix=", test")
+    for key in ("multi_step", "speculative", "attribution_flip",
+                "token_parity"):
+        assert key not in base_line
 
 
 def test_aux_deadline_skips_instead_of_running(capsys, monkeypatch):

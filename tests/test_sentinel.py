@@ -190,6 +190,48 @@ def test_serving_latency_line_is_comparable():
     assert fast["improvements"] == ["serving_decode"]
 
 
+@pytest.mark.sentinel
+def test_decode_ab_line_is_comparable():
+    """The ISSUE 11 A/B extensions (multi_step/speculative sub-blocks,
+    attribution_flip, token_parity) ride INSIDE the serving_decode ms
+    line: the sentinel still compares it by its headline e2e p99 with
+    the same lower-is-better band-aware semantics, and the nested A/B
+    blocks never confuse the comparison."""
+    def ab_line(value, band):
+        return {"metric": "serving_decode: ... vs fused N=16 vs "
+                          "N=16+spec, cpu",
+                "value": value, "unit": "ms", "best": band[0],
+                "band": band, "n": 3,
+                "multi_step": {"tokens_per_s": {"value": 8000.0},
+                               "multi_step_n": 16},
+                "speculative": {"tokens_per_s": {"value": 9000.0}},
+                "attribution_flip": {"band_disjoint_drop": True},
+                "token_parity": True}
+
+    base = {"headline": _line(10.0, [9.9, 10.1]),
+            "serving_decode": ab_line(20.0, [19.5, 20.5])}
+    worse = sentinel.check(base, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "serving_decode": ab_line(40.0, [39.0, 41.0])})
+    assert worse["verdict"] == "regression"
+    assert worse["regressions"] == ["serving_decode"]
+    noise = sentinel.check(base, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "serving_decode": ab_line(22.0, [19.0, 24.0])})
+    assert noise["verdict"] == "clean"
+    # an OLD baseline without the A/B blocks still compares: the
+    # extensions are additive, the ms-line contract is the interface
+    old = {"headline": _line(10.0, [9.9, 10.1]),
+           "serving_decode": {"metric": "serving_decode: paged-KV",
+                              "value": 20.0, "unit": "ms",
+                              "best": 19.5, "band": [19.5, 20.5],
+                              "n": 3}}
+    sent = sentinel.check(old, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "serving_decode": ab_line(41.0, [40.0, 42.0])})
+    assert sent["verdict"] == "regression"
+
+
 def _artifact(path, value, band):
     head = _line(value, band)
     path.write_text(json.dumps({"parsed": head, "tail": ""}))

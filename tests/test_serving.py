@@ -624,15 +624,26 @@ def test_mixed_plan_merge_refused():
 
 
 @pytest.mark.slow
+@pytest.mark.decode
 def test_bench_serving_decode_runs_end_to_end():
-    """The real aux line: a compiled tiny engine, 3 replayed rounds —
-    heavier than a schema lock, so it rides the slow lane."""
+    """The real aux line: three compiled engines (1-step, fused
+    N-step, N-step + speculative), 3 interleaved rounds — heavier than
+    a schema lock, so it rides the slow lane.  The ISSUE 11 acceptance
+    pieces must be present and true: exact token parity across
+    variants, and the dispatch decomposition in the A/B blocks."""
     import bench
     line = bench._bench_serving_decode()
     assert line is not None and line["unit"] == "ms"
     assert line["n"] == 3 and line["value"] > 0
     assert line["p99_ms"]["band"][0] <= line["value"] \
         <= line["p99_ms"]["band"][1]
+    assert line["token_parity"] is True
+    assert line["multi_step"]["steps_per_dispatch"]["value"] > 1.0
+    assert line["speculative"]["spec"]["acceptance_rate"]["n"] == 3
+    flip = line["attribution_flip"]
+    assert flip["one_step_host_frac"]["n"] == 3
+    assert flip["multi_step_host_frac"]["value"] \
+        < flip["one_step_host_frac"]["value"]
 
 
 # ---------------------------------------------------------------------
