@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test check-bench check-resilience check-serving check-tuning \
-	check-longcontext check-decode sentinel-scan
+	check-longcontext check-decode check-density sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -89,6 +89,21 @@ check-decode:
 	    tests/test_bench_aux.py::test_serving_decode_line_schema_locked \
 	    tests/test_bench_aux.py::test_serving_decode_ab_schema_locked \
 	    tests/test_sentinel.py::test_decode_ab_line_is_comparable
+
+# the serving-density lane (docs/SERVING.md "Cache density"):
+# quantized paged-KV config validation + pool-bytes accounting, the
+# int8/fp8 decode-parity bars on the CPU mesh, the dequantizing Pallas
+# kernel (interpret mode; the on-chip case stays collectable via
+# tpu_only), the refcount/COW allocator property test, prefix-sharing
+# losslessness + record globals, the arrival-plan prefix knobs, and
+# the kv_density_ab bench-line schema + sentinel comparability.
+# ~1 min wall.
+check-density:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m 'density and not slow' \
+	    tests/test_kv_density.py
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
+	    tests/test_bench_aux.py::test_kv_density_line_schema_locked \
+	    tests/test_sentinel.py::test_kv_density_line_is_comparable
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

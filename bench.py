@@ -528,7 +528,7 @@ def _run_bench(args, tracer) -> int:
     if args.skip_aux:
         fp8 = fp8_chain = int8 = int8_ab = fp8_ab = None
         straggler = ckpt_ab = int8_step = int8_sb = overlap_ab = None
-        serving = tuned_ab = longcontext = None
+        serving = tuned_ab = longcontext = kv_density = None
     else:
         fp8 = _aux("fp8 mlp matmul", _bench_fp8_mlp, card, hw_key, dev)
         fp8_chain = _aux("fp8 swiglu chain", _bench_fp8_swiglu_chain,
@@ -555,6 +555,10 @@ def _run_bench(args, tracer) -> int:
         # cheap (tiny decode engine, one compile, 3 replayed rounds):
         # the serving tier's latency line — TTFT/TPOT/e2e-p99 bands
         serving = _aux("serving decode", _bench_serving_decode)
+        # the ISSUE-12 density evidence: dense vs int8 vs fp8 paged-KV
+        # engines at EQUAL pool bytes — admitted concurrency, tokens/s
+        # and the per-recipe decode-parity bars
+        kv_density = _aux("kv density A/B", _bench_kv_density)
         # the ISSUE-10 long-context evidence: dense-vs-splash paired
         # rounds at S=64k under causal/window/segment masks — four
         # attention-only compiles, bounded by the shared aux deadline
@@ -616,6 +620,7 @@ def _run_bench(args, tracer) -> int:
         **({"straggler_ab": straggler} if straggler else {}),
         **({"checkpoint_ab": ckpt_ab} if ckpt_ab else {}),
         **({"serving_decode": serving} if serving else {}),
+        **({"kv_density_ab": kv_density} if kv_density else {}),
         **({"longcontext_ab": longcontext} if longcontext else {}),
         **({"spmd_overlap_ab": overlap_ab} if overlap_ab else {}),
         **({"int8_step": int8_step} if int8_step else {}),
@@ -953,6 +958,185 @@ def _bench_serving_decode() -> dict | None:
                f"N={n_fused}+spec, {dev.device_kind}",
         multi_rounds=rounds["multi_step"],
         spec_rounds=rounds["speculative"], token_parity=parity)
+    print(json.dumps(line))
+    return line
+
+
+def _kv_parity_err(cache_dtype: str, seed: int) -> float:
+    """One seeded decode-parity probe (ISSUE 12): write the same
+    token stream into a dense and a quantized page pool (the engine's
+    own write path, ``kv_cache.quant_write_span``) and return the max
+    absolute error of the paged-attention output vs the bf16 cache —
+    the number the ``QUANT_DECODE_TOL`` bars judge."""
+    import numpy as np
+
+    from dlnetbench_tpu.serving import kv_cache as KV
+
+    base = dict(num_layers=1, num_kv_heads=2, head_dim=16, num_pages=8,
+                page_size=4, max_seqs=2, max_pages_per_seq=4)
+    cc_d = KV.CacheConfig(**base)
+    cc_q = KV.CacheConfig(**base, cache_dtype=cache_dtype)
+    kd, vd = KV.device_buffers(cc_d)
+    kq, vq, ks, vs = KV.device_buffers(cc_q)
+    bt = jnp.asarray(np.arange(8, dtype=np.int32).reshape(2, 4))
+    rng = np.random.RandomState(seed)
+    fmt = cc_q.quant_fmt
+    for t in range(10):
+        knew = jnp.asarray(rng.randn(2, 1, 2, 16).astype(np.float32))
+        vnew = jnp.asarray(rng.randn(2, 1, 2, 16).astype(np.float32))
+        pos = jnp.full((2,), t, jnp.int32)
+        ok = jnp.ones((2, 1), bool)
+        pid = jnp.take_along_axis(bt, (pos // 4)[:, None], 1)[:, 0]
+        kd = kd.at[0, :, pid, pos % 4, :].set(knew[:, 0], mode="drop")
+        vd = vd.at[0, :, pid, pos % 4, :].set(vnew[:, 0], mode="drop")
+        kq, ks = KV.quant_write_span(kq, ks, 0, knew, pos, ok, bt,
+                                     fmt=fmt, page_size=4, num_pages=8)
+        vq, vs = KV.quant_write_span(vq, vs, 0, vnew, pos, ok, bt,
+                                     fmt=fmt, page_size=4, num_pages=8)
+    q = jnp.asarray(rng.randn(2, 4, 16).astype(np.float32)) * 16**-0.5
+    lengths = jnp.asarray([10, 9], jnp.int32)
+    ref = KV.paged_attention_decode(q, kd[0], vd[0], lengths, bt,
+                                    impl="gather")
+    got = KV.paged_attention_decode(q, kq[0], vq[0], lengths, bt,
+                                    k_scale=ks[0], v_scale=vs[0],
+                                    fmt=fmt, impl="gather")
+    return float(jnp.max(jnp.abs(got - ref)))
+
+
+def _kv_density_line(rounds: dict, parity: dict, pool_bytes: int,
+                     suffix: str = "") -> dict:
+    """Assemble the kv_density_ab aux line (pure —
+    tests/test_bench_aux.py locks this schema).  ``rounds`` maps cache
+    dtype -> per-round ``serving`` blocks from engines sized to the
+    SAME pool-byte budget (scale arrays priced in); ``parity`` maps
+    quant dtype -> per-round decode-parity max errors.  The headline
+    ``value`` is the DENSE engine's round-median e2e p99 ms (lower is
+    better — sentinel-comparable like every latency line); each
+    variant ships ``{value, best, band, n}`` for admitted slots,
+    tokens/s and parity max-error, plus the capacity ratio vs dense
+    with its band."""
+    from dlnetbench_tpu.serving.kv_cache import QUANT_DECODE_TOL
+
+    base = rounds["bf16"]
+    summary = stats_mod.summarize([r["e2e_ms"]["p99"] for r in base],
+                                  ndigits=3)
+    base_adm = [r["admitted_concurrency_peak"] for r in base]
+    variants = {}
+    for name, rnds in rounds.items():
+        v = {
+            "num_pages": rnds[0]["kv_cache"]["num_pages"],
+            "pool_bytes": rnds[0]["kv_cache"]["pool_bytes"],
+            "admitted_slots": stats_mod.summarize(
+                [r["admitted_concurrency_peak"] for r in rnds],
+                ndigits=2),
+            "tokens_per_s": stats_mod.summarize(
+                [r["tokens_per_s"] for r in rnds], ndigits=2),
+            "e2e_p99_ms": stats_mod.summarize(
+                [r["e2e_ms"]["p99"] for r in rnds], ndigits=3),
+            "goodput_frac": stats_mod.summarize(
+                [r["goodput_frac"] for r in rnds], ndigits=4),
+            # goodput-at-SLO in requests/s — the axis the capacity win
+            # must be band-disjoint on (a denser cache drains the same
+            # saturating plan faster at the same SLO)
+            "goodput_rps": stats_mod.summarize(
+                [r["goodput_rps"] for r in rnds], ndigits=3),
+        }
+        if name != "bf16":
+            v["capacity_x"] = stats_mod.summarize(
+                [r["admitted_concurrency_peak"] / b
+                 for r, b in zip(rnds, base_adm) if b > 0], ndigits=3)
+            errs = parity[name]
+            tol = QUANT_DECODE_TOL[name]
+            v["parity_max_err"] = stats_mod.summarize(errs, ndigits=6)
+            v["parity_tol"] = tol
+            v["parity_ok"] = bool(max(errs) <= tol)
+        variants[name] = v
+    return stats_mod.flag_low_mode({
+        "metric": f"kv_density_ab: dense vs int8 vs fp8 paged-KV "
+                  f"decode at equal pool bytes, admitted concurrency "
+                  f"+ parity bars (serving/){suffix}",
+        "value": summary["value"],
+        "unit": "ms",
+        "best": summary["best"],
+        "band": summary["band"],
+        "n": summary["n"],
+        "pool_bytes_budget": pool_bytes,
+        "variants": variants,
+    })
+
+
+def _bench_kv_density() -> dict | None:
+    """The ISSUE 12 density A/B: three engines — dense, int8, fp8
+    paged KV — each sized to the SAME pool-byte budget (the quantized
+    pools buy ~4x the pages once their scale arrays are priced in),
+    replay one seeded saturating plan interleaved per round (r4
+    pairing).  The pool, not the slot count, is the binding resource
+    (slots > pages/request), so admitted concurrency measures cache
+    density; the decode-parity probes bound the numeric cost against
+    the stated per-recipe tolerance bars."""
+    import dataclasses
+
+    from dlnetbench_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+    from dlnetbench_tpu.serving import kv_cache as KV
+    from dlnetbench_tpu.serving import metrics as smetrics
+    from dlnetbench_tpu.serving.arrivals import ArrivalPlan
+    from dlnetbench_tpu.serving.scheduler import Engine, ServingConfig
+
+    mc = TransformerConfig(
+        vocab_size=256, embed_dim=64, num_heads=4, num_kv_heads=2,
+        ff_dim=128, num_layers=2, seq_len=64, gated=True,
+        max_positions=0, dtype="float32")
+    # slots deliberately EXCEED what any variant's pool can hold, so
+    # the page pool (the resource being densified), never the slot
+    # count, caps admitted concurrency
+    dense = ServingConfig(slots=24, page_size=8, num_pages=25,
+                          max_seq_len=40, slo_ttft_ms=400.0,
+                          slo_tpot_ms=150.0, attn_impl="gather")
+    cc_args = dict(num_layers=mc.num_layers,
+                   num_kv_heads=mc.num_kv_heads, head_dim=mc.head_dim,
+                   page_size=dense.page_size, max_seqs=dense.slots,
+                   max_pages_per_seq=dense.max_seq_len
+                   // dense.page_size, dtype=mc.dtype)
+    budget = KV.CacheConfig(**cc_args, num_pages=dense.num_pages,
+                            cache_dtype="bf16").pool_bytes
+    variants = {"bf16": dense}
+    for cd in ("int8", "fp8"):
+        pages = KV.pages_for_pool_bytes(
+            budget, KV.CacheConfig(**cc_args, num_pages=1,
+                                   cache_dtype=cd))
+        variants[cd] = dataclasses.replace(dense, cache_dtype=cd,
+                                           num_pages=pages)
+    plan = ArrivalPlan(kind="poisson", rate_rps=5000.0,
+                       num_requests=20, seed=0, prompt_len=[8, 16],
+                       output_len=[12, 20])
+    params = init_params(jax.random.key(0), mc)
+    requests = plan.sample()
+    engines = {name: Engine(mc, cfg, params=params)
+               for name, cfg in variants.items()}
+    for eng in engines.values():
+        eng.run(requests)   # warm round (first-dispatch), discarded
+    rounds: dict[str, list] = {name: [] for name in engines}
+    parity: dict[str, list] = {"int8": [], "fp8": []}
+    for rnd in range(3):
+        for name, eng in engines.items():
+            completed, wall = eng.run(requests)
+            rounds[name].append(smetrics.serving_block(
+                completed, plan, slo_ttft_ms=dense.slo_ttft_ms,
+                slo_tpot_ms=dense.slo_tpot_ms, wall_s=wall,
+                engine_steps=eng.engine_steps,
+                cache_stats=eng.cache.stats(),
+                queue_depth_max=eng.queue_depth_max,
+                batch_occupancy_mean=eng.batch_occupancy_mean(),
+                decode_loop=eng.decode_loop_block(),
+                admitted_peak=eng.concurrent_peak))
+        for cd in parity:
+            parity[cd].append(_kv_parity_err(cd, seed=rnd))
+    dev = jax.devices()[0]
+    line = _kv_density_line(
+        rounds, parity, budget,
+        suffix=f", {len(requests)} req slots={dense.slots} "
+               f"page={dense.page_size}, {dev.device_kind}")
     print(json.dumps(line))
     return line
 

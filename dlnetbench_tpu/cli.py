@@ -447,6 +447,25 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
                    help="decode attention path: Pallas paged_attention "
                         "kernel (TPU) vs dense gather fallback; auto "
                         "picks by backend")
+    p.add_argument("--cache_dtype", default="bf16",
+                   choices=["bf16", "int8", "fp8"],
+                   help="paged-KV pool storage (ISSUE 12): bf16 = "
+                        "unquantized (pools in the model dtype, the "
+                        "quant path not even built); int8/fp8 store "
+                        "quantized pages with per-page-per-head f32 "
+                        "scales — ~2x the pages per pool byte of a "
+                        "bf16 cache (~4x of the f32 CPU-mesh pools) "
+                        "at a stated decode-parity tolerance "
+                        "(docs/SERVING.md 'Cache density')")
+    p.add_argument("--prefix_sharing", action="store_true",
+                   help="cross-request prefix sharing: requests whose "
+                        "prompts share a prefix with a resident "
+                        "sequence map their block tables onto the "
+                        "same physical pages (refcounts + copy-on-"
+                        "write; admission charges only unshared "
+                        "pages, the shared prefix skips prefill); "
+                        "lossless — record stamps prefix_hit_rate/"
+                        "prefix_bytes_saved")
     p.add_argument("--multi_step_n", type=int, default=1,
                    help="decode steps fused per host dispatch "
                         "(ISSUE 11): >1 runs a device-resident "
@@ -550,7 +569,9 @@ def _run_serve(args, parser) -> int:
         attn_impl=args.attn_impl, multi_step_n=args.multi_step_n,
         adaptive_n=not args.no_adaptive_n,
         speculative=args.speculative, spec_k=args.spec_k,
-        drafter=args.drafter, drafter_layers=args.drafter_layers)
+        drafter=args.drafter, drafter_layers=args.drafter_layers,
+        cache_dtype=args.cache_dtype,
+        prefix_sharing=args.prefix_sharing)
     try:
         srv_cfg.validate()
         if srv_cfg.speculative:

@@ -69,8 +69,16 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      # process clocks its own requests; the ARRIVAL
                      # PLAN itself ("arrival_plan") stays comparable —
                      # different traffic schedules ARE different runs,
-                     # exactly like fault plans
-                     "serving",
+                     # exactly like fault plans.  So does the
+                     # ISSUE-12 "kv_cache_dtype" global (differently-
+                     # quantized caches are different runs).  The
+                     # prefix-sharing STATS are volatile: whether a
+                     # prefix owner is still resident when a later
+                     # request admits depends on wall-clock arrival
+                     # timing vs engine speed, so hit counts
+                     # legitimately differ across hosts/reruns of ONE
+                     # plan — like every other serving measurement
+                     "serving", "prefix_hit_rate", "prefix_bytes_saved",
                      # tuning provenance (ISSUE 9): each process
                      # consults its own DB on its own disk (and a host
                      # without the env set consults nothing) — per-

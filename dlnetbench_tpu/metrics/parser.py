@@ -166,6 +166,14 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                     row["serving_offered_rps"] = srv.get("offered_rps")
                     row["serving_goodput_rps"] = srv.get("goodput_rps")
                     row["serving_goodput_frac"] = srv.get("goodput_frac")
+                    # ISSUE 12 capacity axis: peak concurrent resident
+                    # sequences (the equal-pool-bytes A/B's y-axis);
+                    # pre-density records simply lack the key.  The
+                    # cache-dtype / prefix-hit globals are plain
+                    # scalars and hoist via the generic loop above.
+                    if "admitted_concurrency_peak" in srv:
+                        row["serving_admitted_peak"] = \
+                            srv["admitted_concurrency_peak"]
                     for base in ("ttft_ms", "tpot_ms", "e2e_ms"):
                         pcts = srv.get(base)
                         if isinstance(pcts, dict):

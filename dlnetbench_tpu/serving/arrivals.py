@@ -84,6 +84,12 @@ class Request:
     prompt_len: int
     output_len: int      # decode tokens to generate (EOS stand-in: the
                          # trace/production knowledge of response length)
+    prefix_id: int = -1  # shared system-prompt id (ISSUE 12): >= 0
+                         # means the first prefix_len prompt tokens
+                         # come from prefix pool entry prefix_id's
+                         # seeded stream (decode.prompt_tokens_for) —
+                         # the page-shareable prefix
+    prefix_len: int = 0
 
 
 @dataclasses.dataclass
@@ -100,6 +106,13 @@ class ArrivalPlan:
     factor: float = 4.0
     # replay: explicit trace entries {"t", "prompt_len", "output_len"}
     trace: list = dataclasses.field(default_factory=list)
+    # prefix-heavy traffic (ISSUE 12): every request's first
+    # shared_prefix_len prompt tokens come from one of prefix_pool
+    # seeded "system prompts" (seeded choice per request) — the
+    # replayable shape of shared-system-prompt production traffic, so
+    # prefix-sharing wins are a committable scenario like every other
+    shared_prefix_len: int = 0     # 0 disables (no prefix stamped)
+    prefix_pool: int = 1           # distinct system prompts to draw from
 
     def validate(self) -> "ArrivalPlan":
         if self.kind not in KINDS:
@@ -142,6 +155,31 @@ class ArrivalPlan:
                     f"arrival plan: {name} must be >= 1 (range "
                     f"[lo, hi] with lo <= hi), got "
                     f"{getattr(self, name)!r}")
+        if self.shared_prefix_len < 0:
+            raise ValueError(
+                f"arrival plan: shared_prefix_len must be >= 0, got "
+                f"{self.shared_prefix_len}")
+        if self.prefix_pool < 1:
+            raise ValueError(
+                f"arrival plan: prefix_pool must be >= 1, got "
+                f"{self.prefix_pool}")
+        if self.shared_prefix_len:
+            p_lo, _ = _len_range(self.prompt_len)
+            # replay traces may carry explicit per-entry prompt
+            # lengths that bypass the plan-level range — the guard
+            # must see the SHORTEST prompt any request can get
+            if self.kind == "replay":
+                p_lo = min([p_lo] + [int(e["prompt_len"])
+                                     for e in self.trace
+                                     if "prompt_len" in e])
+            if self.shared_prefix_len >= p_lo:
+                raise ValueError(
+                    f"arrival plan: shared_prefix_len "
+                    f"{self.shared_prefix_len} must be < the minimum "
+                    f"prompt_len {p_lo} — every request needs at "
+                    f"least one private prompt token (the final "
+                    f"prompt token always re-prefills: it produces "
+                    f"the first generated token)")
         return self
 
     # ---- serialization (the committable wire format) -----------------
@@ -157,6 +195,11 @@ class ArrivalPlan:
                        factor=self.factor)
         if self.kind == "replay":
             out["trace"] = list(self.trace)
+        if self.shared_prefix_len:
+            # absent unless set: committed pre-ISSUE-12 plan fixtures
+            # round-trip byte-identically
+            out["shared_prefix_len"] = self.shared_prefix_len
+            out["prefix_pool"] = self.prefix_pool
         return out
 
     def dumps(self) -> str:
@@ -175,6 +218,8 @@ class ArrivalPlan:
             duty=float(d.get("duty", 0.2)),
             factor=float(d.get("factor", 4.0)),
             trace=list(d.get("trace", [])),
+            shared_prefix_len=int(d.get("shared_prefix_len", 0)),
+            prefix_pool=int(d.get("prefix_pool", 1)),
         ).validate()
 
     @classmethod
@@ -195,6 +240,15 @@ class ArrivalPlan:
         rng = _Rng(self.seed)
         p_lo, p_hi = _len_range(self.prompt_len)
         o_lo, o_hi = _len_range(self.output_len)
+
+        def prefix():
+            # drawn ONLY when the knob is set, so legacy plans keep
+            # their exact pre-ISSUE-12 request streams
+            if not self.shared_prefix_len:
+                return {}
+            return {"prefix_id": rng.uniform_int(0,
+                                                 self.prefix_pool - 1),
+                    "prefix_len": self.shared_prefix_len}
         out: list[Request] = []
         if self.kind == "replay":
             for i, e in enumerate(self.trace):
@@ -203,7 +257,8 @@ class ArrivalPlan:
                     prompt_len=int(e.get("prompt_len",
                                          rng.uniform_int(p_lo, p_hi))),
                     output_len=int(e.get("output_len",
-                                         rng.uniform_int(o_lo, o_hi)))))
+                                         rng.uniform_int(o_lo, o_hi))),
+                    **prefix()))
             return out
         t = 0.0
         for i in range(self.num_requests):
@@ -215,7 +270,8 @@ class ArrivalPlan:
             t += rng.expovariate(rate)
             out.append(Request(rid=i, arrival_s=t,
                                prompt_len=rng.uniform_int(p_lo, p_hi),
-                               output_len=rng.uniform_int(o_lo, o_hi)))
+                               output_len=rng.uniform_int(o_lo, o_hi),
+                               **prefix()))
         return out
 
     def offered_rps(self) -> float:

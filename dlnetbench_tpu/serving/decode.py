@@ -45,7 +45,9 @@ from jax import lax
 from dlnetbench_tpu.models import layers as L
 from dlnetbench_tpu.models.transformer import TransformerConfig
 from dlnetbench_tpu.serving.kv_cache import (CacheConfig,
+                                             dequant_gathered,
                                              paged_attention_decode,
+                                             quant_write_span,
                                              sharded_paged_attention)
 
 _F32 = jnp.float32
@@ -98,31 +100,64 @@ def _rope_decode(q, k, positions, theta=10000.0):
 
 
 def _attn_fn(cache_cfg: CacheConfig, attn_impl: str, mesh):
+    """One uniform internal attention signature for both cache forms:
+    ``attn(q, k_l, v_l, ks_l, vs_l, lengths, block_tables)`` — the
+    scale slices are ``None`` on the dense cache (where the underlying
+    call is EXACTLY the pre-ISSUE-12 dispatch)."""
+    quant = cache_cfg.quantized
+    fmt = cache_cfg.quant_fmt
     if mesh is not None:
-        return sharded_paged_attention(mesh, impl=attn_impl)
-    return functools.partial(paged_attention_decode, impl=attn_impl)
+        sharded = sharded_paged_attention(mesh, impl=attn_impl,
+                                          quantized=quant, fmt=fmt)
+        if quant:
+            return sharded
+        return (lambda q, k, v, ks, vs, lengths, bt:
+                sharded(q, k, v, lengths, bt))
+    if quant:
+        return (lambda q, k, v, ks, vs, lengths, bt:
+                paged_attention_decode(q, k, v, lengths, bt,
+                                       k_scale=ks, v_scale=vs, fmt=fmt,
+                                       impl=attn_impl))
+    return (lambda q, k, v, ks, vs, lengths, bt:
+            paged_attention_decode(q, k, v, lengths, bt,
+                                   impl=attn_impl))
+
+
+def _split_pools(cache_cfg: CacheConfig, pools: tuple):
+    """``(k_pages, v_pages, k_scale, v_scale)`` with None scales on the
+    dense cache — the one unpacking both step bodies share."""
+    if cache_cfg.quantized:
+        return pools
+    k_pages, v_pages = pools
+    return k_pages, v_pages, None, None
 
 
 def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
-                 params, k_pages, v_pages, tokens, positions, write_ok,
+                 params, pools, tokens, positions, write_ok,
                  block_tables, *, layers: int | None = None):
     """ONE batched single-token step over the paged cache — the math
     both the single-step program and the fused multi-step loop body run
     (sharing the definition is what makes N-step-vs-1-step token parity
     a structural property, not a numerics hope).
 
-    ``write_ok`` [B] gates the k/v cache write (inactive slots write
-    nowhere: out-of-bounds page index + ``drop`` mode; their
-    next_token is garbage the caller masks).  Attention covers
-    ``positions + 1`` tokens (write-then-read: the fed token's k/v
-    land first).  ``layers`` truncates the stack — the speculative
-    TRUNCATED drafter is literally the first ``layers`` layers of the
-    target plus the shared final-norm/head (serving/speculative.py);
-    ``None`` runs the full depth."""
+    ``pools`` is ``(k_pages, v_pages)`` on the dense cache (the exact
+    pre-ISSUE-12 program) or ``(k_pages, v_pages, k_scale, v_scale)``
+    on a quantized one, where each cache write re-quantizes its page
+    against a fresh amax (``kv_cache.quant_write_span``) and the
+    attention dispatch dequantizes on read.  ``write_ok`` [B] gates the
+    k/v cache write (inactive slots write nowhere: out-of-bounds page
+    index + ``drop`` mode; their next_token is garbage the caller
+    masks).  Attention covers ``positions + 1`` tokens (write-then-
+    read: the fed token's k/v land first).  ``layers`` truncates the
+    stack — the speculative TRUNCATED drafter is literally the first
+    ``layers`` layers of the target plus the shared final-norm/head
+    (serving/speculative.py); ``None`` runs the full depth."""
     b = tokens.shape[0]
     scale = cfg.head_dim ** -0.5
     page_size = cache_cfg.page_size
     num_pages = cache_cfg.num_pages
+    quant = cache_cfg.quantized
+    k_pages, v_pages, k_scale, v_scale = _split_pools(cache_cfg, pools)
     x = params["embed"][tokens]                      # [B, D]
     page_col = positions // page_size
     page_id = jnp.take_along_axis(block_tables, page_col[:, None],
@@ -143,11 +178,25 @@ def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
         q, k = _rope_decode(q, k, positions)
         # write-then-read: the new token's k/v land in the page pool
         # first, so attention covers it like every cached token
-        k_pages = k_pages.at[li, :, w_pages, slots, :].set(
-            k, mode="drop")
-        v_pages = v_pages.at[li, :, w_pages, slots, :].set(
-            v, mode="drop")
-        att = attn(q * scale, k_pages[li], v_pages[li], att_lengths,
+        if quant:
+            k_pages, k_scale = quant_write_span(
+                k_pages, k_scale, li, k[:, None], positions,
+                write_ok[:, None], block_tables,
+                fmt=cache_cfg.quant_fmt, page_size=page_size,
+                num_pages=num_pages)
+            v_pages, v_scale = quant_write_span(
+                v_pages, v_scale, li, v[:, None], positions,
+                write_ok[:, None], block_tables,
+                fmt=cache_cfg.quant_fmt, page_size=page_size,
+                num_pages=num_pages)
+        else:
+            k_pages = k_pages.at[li, :, w_pages, slots, :].set(
+                k, mode="drop")
+            v_pages = v_pages.at[li, :, w_pages, slots, :].set(
+                v, mode="drop")
+        att = attn(q * scale, k_pages[li], v_pages[li],
+                   k_scale[li] if quant else None,
+                   v_scale[li] if quant else None, att_lengths,
                    block_tables)
         x = x + jnp.dot(att.reshape(b, cfg.embed_dim), lp["wo"])
         y = L.rmsnorm(x, lp["norm2"])
@@ -156,7 +205,9 @@ def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
     head = params["embed"].T if cfg.tied_embeddings else params["head"]
     logits = jnp.dot(x, head, preferred_element_type=_F32)
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return k_pages, v_pages, next_tokens
+    if quant:
+        return (k_pages, v_pages, k_scale, v_scale), next_tokens
+    return (k_pages, v_pages), next_tokens
 
 
 def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
@@ -168,15 +219,33 @@ def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
     ``position`` is the cache index its token is written at (= tokens
     already cached), so attention covers ``position + 1`` tokens.
     Inactive slots write nowhere (out-of-bounds page index + ``drop``
-    mode) and their next_token is garbage the engine ignores."""
+    mode) and their next_token is garbage the engine ignores.
+
+    On a QUANTIZED cache (ISSUE 12) the signature grows the scale
+    arrays after the pools — ``decode_step(params, k_pages, v_pages,
+    k_scale, v_scale, tokens, positions, block_tables, active) ->
+    (k_pages, v_pages, k_scale, v_scale, next_tokens)`` — threaded
+    functionally exactly like the pools themselves.  The dense
+    signature (and its compiled program) is untouched."""
     check_config(cfg, decode=True)
     attn = _attn_fn(cache_cfg, attn_impl, mesh)
 
+    if cache_cfg.quantized:
+        def decode_step(params, k_pages, v_pages, k_scale, v_scale,
+                        tokens, positions, block_tables, active):
+            pools, nxt = _step_tokens(
+                cfg, cache_cfg, attn, params,
+                (k_pages, v_pages, k_scale, v_scale), tokens,
+                positions, active, block_tables)
+            return (*pools, nxt)
+        return decode_step
+
     def decode_step(params, k_pages, v_pages, tokens, positions,
                     block_tables, active):
-        return _step_tokens(cfg, cache_cfg, attn, params, k_pages,
-                            v_pages, tokens, positions, active,
-                            block_tables)
+        pools, nxt = _step_tokens(cfg, cache_cfg, attn, params,
+                                  (k_pages, v_pages), tokens,
+                                  positions, active, block_tables)
+        return (*pools, nxt)
 
     return decode_step
 
@@ -217,32 +286,38 @@ def make_multi_step_decode(cfg: TransformerConfig,
 
     The loop body is ``_step_tokens`` — the same math
     ``make_decode_step`` runs — so the N-step greedy token stream
-    equals the 1-step engine's exactly (locked by test)."""
+    equals the 1-step engine's exactly (locked by test).  On a
+    QUANTIZED cache the scale arrays join the loop carry right after
+    the pools (``multi_step(params, k_pages, v_pages, k_scale,
+    v_scale, state, ...)``) — same write sequence as the 1-step
+    quantized engine, so N-step-vs-1-step parity holds per cache
+    dtype."""
     check_config(cfg, decode=True)
     if n_max < 1:
         raise ValueError(f"multi_step_decode: n_max must be >= 1, "
                          f"got {n_max}")
     attn = _attn_fn(cache_cfg, attn_impl, mesh)
+    n_pools = 4 if cache_cfg.quantized else 2
 
-    def multi_step(params, k_pages, v_pages, state, block_tables,
-                   n_steps):
+    def _multi_step(params, pools, state, block_tables, n_steps):
         b = state.shape[1]
         n = jnp.minimum(n_steps.astype(jnp.int32), n_max)
         out0 = jnp.zeros((b, n_max), jnp.int32)
         counts0 = jnp.zeros((b,), jnp.int32)
 
         def cond(carry):
-            i, _, _, st, _, _ = carry
+            i, st = carry[0], carry[1 + n_pools]
             return (i < n) & jnp.any(st[STATE_REM] > 0)
 
         def body(carry):
-            i, kp, vp, st, out, cnt = carry
+            i = carry[0]
+            pc = carry[1:1 + n_pools]
+            st, out, cnt = carry[1 + n_pools:]
             last, pos, rem = (st[STATE_LAST], st[STATE_POS],
                               st[STATE_REM])
             act = rem > 0
-            kp, vp, nxt = _step_tokens(cfg, cache_cfg, attn, params,
-                                       kp, vp, last, pos, act,
-                                       block_tables)
+            pc, nxt = _step_tokens(cfg, cache_cfg, attn, params, pc,
+                                   last, pos, act, block_tables)
             # append each active slot's token at its own count index;
             # inactive slots aim past the buffer edge and drop
             idx = jnp.where(act, cnt, n_max)
@@ -252,12 +327,28 @@ def make_multi_step_decode(cfg: TransformerConfig,
             st = st.at[STATE_POS].set(pos + step)
             st = st.at[STATE_REM].set(rem - step)
             cnt = cnt + step
-            return (i + 1, kp, vp, st, out, cnt)
+            return (i + 1, *pc, st, out, cnt)
 
-        i, kp, vp, st, out, cnt = lax.while_loop(
+        final = lax.while_loop(
             cond, body,
-            (jnp.int32(0), k_pages, v_pages, state, out0, counts0))
-        return kp, vp, st, out, cnt, i
+            (jnp.int32(0), *pools, state, out0, counts0))
+        i = final[0]
+        pc = final[1:1 + n_pools]
+        st, out, cnt = final[1 + n_pools:]
+        return (*pc, st, out, cnt, i)
+
+    if cache_cfg.quantized:
+        def multi_step(params, k_pages, v_pages, k_scale, v_scale,
+                       state, block_tables, n_steps):
+            return _multi_step(params,
+                               (k_pages, v_pages, k_scale, v_scale),
+                               state, block_tables, n_steps)
+        return multi_step
+
+    def multi_step(params, k_pages, v_pages, state, block_tables,
+                   n_steps):
+        return _multi_step(params, (k_pages, v_pages), state,
+                           block_tables, n_steps)
 
     return multi_step
 
@@ -284,12 +375,20 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
     — and the mask comes from the SAME builder the training paths use
     (ops/attention_mask.allowed with the equivalent MaskSpec), so a
     sliding-window model config prefills with the training mask
-    semantics exactly (token-parity-tested against the dense path)."""
+    semantics exactly (token-parity-tested against the dense path).
+
+    QUANTIZED caches (ISSUE 12) add the scale arrays after the pools
+    (``prefill_chunk(params, k_pages, v_pages, k_scale, v_scale,
+    ...)``): chunk writes re-quantize their pages against a fresh amax
+    (``kv_cache.quant_write_span``) and the gathered pages dequantize
+    before the score matmul; the dense signature/program is
+    untouched."""
     check_config(cfg)
     scale = cfg.head_dim ** -0.5
     page_size = cache_cfg.page_size
     num_pages = cache_cfg.num_pages
     pmax = cache_cfg.max_pages_per_seq
+    quant = cache_cfg.quantized
     window = cfg.attention_window
     spec = None
     pages_w = pmax
@@ -301,8 +400,9 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
         # page for alignment slack
         pages_w = min(pmax, -(-(window - 1 + chunk) // page_size) + 1)
 
-    def prefill_chunk(params, k_pages, v_pages, tokens, start, n_valid,
-                      block_row):
+    def _prefill(params, pools, tokens, start, n_valid, block_row):
+        k_pages, v_pages, k_scale, v_scale = _split_pools(cache_cfg,
+                                                          pools)
         positions = start + jnp.arange(chunk, dtype=jnp.int32)
         valid = jnp.arange(chunk) < n_valid
         x = params["embed"][tokens]                        # [C, D]
@@ -323,10 +423,22 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
             # layers.rope wants [B, S, H, Dh] + positions [S]
             q, k = L.rope(q[None], k[None], positions)
             q, k = q[0], k[0]
-            k_pages = k_pages.at[li, :, w_pages, slots, :].set(
-                k, mode="drop")
-            v_pages = v_pages.at[li, :, w_pages, slots, :].set(
-                v, mode="drop")
+            if quant:
+                k_pages, k_scale = quant_write_span(
+                    k_pages, k_scale, li, k[None], start[None],
+                    valid[None], block_row[None],
+                    fmt=cache_cfg.quant_fmt, page_size=page_size,
+                    num_pages=num_pages)
+                v_pages, v_scale = quant_write_span(
+                    v_pages, v_scale, li, v[None], start[None],
+                    valid[None], block_row[None],
+                    fmt=cache_cfg.quant_fmt, page_size=page_size,
+                    num_pages=num_pages)
+            else:
+                k_pages = k_pages.at[li, :, w_pages, slots, :].set(
+                    k, mode="drop")
+                v_pages = v_pages.at[li, :, w_pages, slots, :].set(
+                    v, mode="drop")
             # causal attention over cache + chunk: gather the pages the
             # mask can reach (ALL of them when no window; just the
             # window span otherwise — pages beyond it are provably
@@ -345,12 +457,18 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
             else:
                 rows = block_row
                 k_pos = jnp.arange(pmax * page_size)
-            kseq = k_pages[li][:, rows]   # [Hkv, pages_w, page, Dh]
-            vseq = v_pages[li][:, rows]
-            hkv, npg, _, dh = kseq.shape
+            if quant:
+                kseq = dequant_gathered(k_pages[li][:, rows],
+                                        k_scale[li][:, rows])
+                vseq = dequant_gathered(v_pages[li][:, rows],
+                                        v_scale[li][:, rows])
+            else:
+                kseq = k_pages[li][:, rows].astype(_F32)
+                vseq = v_pages[li][:, rows].astype(_F32)
+            hkv, npg, _, dh = kseq.shape   # [Hkv, pages_w, page, Dh]
             t = npg * page_size
-            kseq = kseq.reshape(hkv, t, dh).astype(_F32)
-            vseq = vseq.reshape(hkv, t, dh).astype(_F32)
+            kseq = kseq.reshape(hkv, t, dh)
+            vseq = vseq.reshape(hkv, t, dh)
             g = cfg.num_heads // hkv
             qg = (q * scale).reshape(chunk, hkv, g, dh).astype(_F32)
             scores = jnp.einsum("chgd,htd->hgct", qg, kseq)
@@ -372,7 +490,24 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
         head = params["embed"].T if cfg.tied_embeddings else params["head"]
         logits = jnp.dot(x[last], head, preferred_element_type=_F32)
         next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return k_pages, v_pages, next_token
+        if quant:
+            return (k_pages, v_pages, k_scale, v_scale), next_token
+        return (k_pages, v_pages), next_token
+
+    if quant:
+        def prefill_chunk(params, k_pages, v_pages, k_scale, v_scale,
+                          tokens, start, n_valid, block_row):
+            pools, nxt = _prefill(
+                params, (k_pages, v_pages, k_scale, v_scale), tokens,
+                start, n_valid, block_row)
+            return (*pools, nxt)
+        return prefill_chunk
+
+    def prefill_chunk(params, k_pages, v_pages, tokens, start, n_valid,
+                      block_row):
+        pools, nxt = _prefill(params, (k_pages, v_pages), tokens,
+                              start, n_valid, block_row)
+        return (*pools, nxt)
 
     return prefill_chunk
 
@@ -390,3 +525,25 @@ def prompt_tokens(rid: int, prompt_len: int, vocab_size: int):
     return np.fromiter((rng.uniform_int(0, vocab_size - 1)
                         for _ in range(prompt_len)),
                        dtype=np.int32, count=prompt_len)
+
+
+def prompt_tokens_for(req, vocab_size: int):
+    """The request's full prompt: when the arrival plan stamped a
+    shared system-prompt prefix (``Request.prefix_id``/``prefix_len``,
+    serving/arrivals.py — ISSUE 12), the first ``prefix_len`` tokens
+    come from the PREFIX POOL's seeded stream (the same tokens for
+    every request drawing that prefix — which is what makes them
+    page-shareable), the tail from the request's own ``rid`` stream.
+    Without a prefix this is exactly ``prompt_tokens``."""
+    import numpy as np
+
+    from dlnetbench_tpu.serving.arrivals import _Rng
+    if getattr(req, "prefix_id", -1) < 0 or req.prefix_len <= 0:
+        return prompt_tokens(req.rid, req.prompt_len, vocab_size)
+    n_pre = min(req.prefix_len, req.prompt_len)
+    rng = _Rng((req.prefix_id + 1) * 0xC2B2AE3D)
+    pre = np.fromiter((rng.uniform_int(0, vocab_size - 1)
+                       for _ in range(n_pre)),
+                      dtype=np.int32, count=n_pre)
+    tail = prompt_tokens(req.rid, req.prompt_len, vocab_size)
+    return np.concatenate([pre, tail[n_pre:]])

@@ -103,7 +103,15 @@ class DeviceDecodeState:
         seed its device-visible state.  ``remaining`` is the output
         budget still owed (``remaining > 0`` is the active bit);
         ``seq_limit`` the prompt+output page reservation the loop must
-        never write past."""
+        never write past.
+
+        Prefix sharing (ISSUE 12) rides this same sync contract:
+        ``block_row`` may map shared physical pages, and an
+        admission-time copy-on-write already rewrote the divergence
+        column host-side BEFORE this call — so the whole shared-page
+        admission (aliased columns + the COW replacement) reaches the
+        device in the ONE dirty-tracked block-table flush at the next
+        dispatch, never as an extra crossing."""
         self._require_fresh("admit")
         self.state[STATE_LAST, slot] = last_token
         self.state[STATE_POS, slot] = position

@@ -129,7 +129,8 @@ def serving_block(completed: list[Completed], plan: ArrivalPlan, *,
                   cache_stats: dict | None = None,
                   queue_depth_max: int = 0,
                   batch_occupancy_mean: float = 0.0,
-                  decode_loop: dict | None = None) -> dict:
+                  decode_loop: dict | None = None,
+                  admitted_peak: int | None = None) -> dict:
     """The record's ``serving`` global: aggregate latency percentiles,
     throughput, and goodput-at-SLO for one run.  ``decode_loop``
     (ISSUE 11, ``Engine.decode_loop_block``) adds the dispatch
@@ -162,6 +163,10 @@ def serving_block(completed: list[Completed], plan: ArrivalPlan, *,
         "goodput_timeline": goodput_timeline(completed, slo_ttft_ms,
                                              slo_tpot_ms),
     }
+    if admitted_peak is not None:
+        # peak CONCURRENT resident sequences — the capacity axis the
+        # kv-density A/B compares at equal pool bytes (ISSUE 12)
+        block["admitted_concurrency_peak"] = admitted_peak
     if cache_stats:
         block["kv_cache"] = cache_stats
     if decode_loop:

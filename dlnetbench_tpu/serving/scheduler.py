@@ -51,7 +51,8 @@ from dlnetbench_tpu.models.transformer import (TransformerConfig,
 from dlnetbench_tpu.serving import decode as D
 from dlnetbench_tpu.serving import metrics as M
 from dlnetbench_tpu.serving.arrivals import ArrivalPlan, Request
-from dlnetbench_tpu.serving.kv_cache import (CacheConfig, PagedKVCache,
+from dlnetbench_tpu.serving.kv_cache import (CACHE_DTYPES, CacheConfig,
+                                             PagedKVCache,
                                              device_buffers)
 
 PREFILL_MODES = ("separate", "inline")
@@ -102,6 +103,23 @@ class ServingConfig:
     sampling: str = "greedy"    # greedy only today; speculative +
                                 # non-greedy is refused LOUDLY until
                                 # sampling-aware acceptance lands
+    cache_dtype: str = "bf16"   # paged-KV pool storage (ISSUE 12):
+                                # "bf16" = unquantized (pools in the
+                                # model dtype — the quant path is not
+                                # even built, bit-identical engine);
+                                # "int8"/"fp8" = quantized pools with
+                                # per-page-per-head f32 scales — ~2x
+                                # the pages per pool byte of a bf16
+                                # cache (~4x of f32 CPU-mesh pools)
+    prefix_sharing: bool = False  # cross-request prefix sharing
+                                # (ISSUE 12): a radix trie over prompt
+                                # tokens maps a new request's shared
+                                # prefix onto a RESIDENT sequence's
+                                # physical pages (refcounted, copy-on-
+                                # write at the divergence page);
+                                # admission charges only unshared
+                                # pages and the shared prefix skips
+                                # prefill (the TTFT win)
     warmup_requests: int = 8    # run_serving drives this many synthetic
                                 # requests through the engine BEFORE the
                                 # measured run (0 disables): first-call
@@ -134,6 +152,16 @@ class ServingConfig:
         if self.multi_step_n < 1:
             raise ValueError(f"serving: multi_step_n must be >= 1, "
                              f"got {self.multi_step_n}")
+        if self.cache_dtype not in CACHE_DTYPES:
+            raise ValueError(f"serving: unknown cache_dtype "
+                             f"{self.cache_dtype!r} (one of "
+                             f"{CACHE_DTYPES})")
+        if self.speculative and self.cache_dtype != "bf16":
+            raise ValueError(
+                f"serving: speculative decode supports the bf16 cache "
+                f"only — cache_dtype={self.cache_dtype!r} re-quantizes "
+                f"pages on every draft/verify overwrite and has no "
+                f"stated parity bar (docs/SERVING.md 'Cache density')")
         if self.sampling != "greedy":
             if self.speculative:
                 raise ValueError(
@@ -195,7 +223,8 @@ class Engine:
             num_pages=cfg.num_pages, page_size=cfg.page_size,
             max_seqs=cfg.slots,
             max_pages_per_seq=cfg.max_seq_len // cfg.page_size,
-            dtype=model_cfg.dtype)
+            dtype=model_cfg.dtype, cache_dtype=cfg.cache_dtype)
+        self._quant = self.cache_cfg.quantized
         if mesh is None and cfg.kv_shard > 1:
             from dlnetbench_tpu.parallel.mesh import make_flat_mesh
             if model_cfg.num_kv_heads % cfg.kv_shard:
@@ -249,7 +278,10 @@ class Engine:
                     loop_fn = D.make_multi_step_decode(
                         model_cfg, self.cache_cfg, cfg.multi_step_n,
                         attn_impl=cfg.attn_impl, mesh=mesh)
-                    carries = (1, 2, 3)     # pools + packed state
+                    # pools (+ scale arrays on a quantized cache) +
+                    # packed state — all loop carries
+                    carries = (tuple(range(1, 6)) if self._quant
+                               else (1, 2, 3))
                 self._loop = executor.CompiledLoop(
                     loop_fn, self._loop_example_args(),
                     carry_argnums=carries)
@@ -258,11 +290,13 @@ class Engine:
                     D.make_decode_step(model_cfg, self.cache_cfg,
                                        attn_impl=cfg.attn_impl,
                                        mesh=mesh),
-                    self._decode_example_args(), donate_argnums=(1, 2))
+                    self._decode_example_args(),
+                    donate_argnums=self._pool_argnums)
             self._prefill = executor.CompiledStep(
                 D.make_prefill_chunk(model_cfg, self.cache_cfg,
                                      cfg.prefill_chunk),
-                self._prefill_example_args(), donate_argnums=(1, 2))
+                self._prefill_example_args(),
+                donate_argnums=self._pool_argnums)
         decode_prog = self._loop if self._loop_mode else self._decode
         decode_name = "decode_loop" if self._loop_mode else "decode_step"
         self.meta["compile_ms"] = {
@@ -276,20 +310,34 @@ class Engine:
         self._reset_state()
 
     # ---- construction helpers ----------------------------------------
+    @property
+    def _pool_argnums(self) -> tuple:
+        """Positional argnums of the pool buffers in every program
+        signature: (k, v) or (k, v, k_scale, v_scale) — the donated,
+        functionally-rebound set."""
+        return (1, 2, 3, 4) if self._quant else (1, 2)
+
     def _pools(self):
-        """Fresh zeroed page pools, pre-placed with the KV-head-sharded
-        layout when a mesh is in play: the AOT executables are lowered
-        against THESE shardings and their outputs keep them, so every
-        later call sees exactly the sharding it was compiled for (an
-        AOT program never auto-reshards — the /verify catch that
-        motivated this helper)."""
-        k, v = device_buffers(self.cache_cfg)
+        """Fresh zeroed page pools (+ scale arrays on a quantized
+        cache), pre-placed with the KV-head-sharded layout when a mesh
+        is in play: the AOT executables are lowered against THESE
+        shardings and their outputs keep them, so every later call sees
+        exactly the sharding it was compiled for (an AOT program never
+        auto-reshards — the /verify catch that motivated this
+        helper)."""
+        bufs = device_buffers(self.cache_cfg)
         if self.mesh is None:
-            return k, v
+            return bufs
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
-        s = NamedSharding(self.mesh, P(None, "kv", None, None, None))
-        return jax.device_put(k, s), jax.device_put(v, s)
+        pool_s = NamedSharding(self.mesh, P(None, "kv", None, None,
+                                            None))
+        scale_s = NamedSharding(self.mesh, P(None, "kv", None))
+        out = [jax.device_put(bufs[0], pool_s),
+               jax.device_put(bufs[1], pool_s)]
+        for sc in bufs[2:]:
+            out.append(jax.device_put(sc, scale_s))
+        return tuple(out)
 
     def _pool_avals(self):
         """Abstract stand-ins for the page pools at lowering time —
@@ -301,29 +349,50 @@ class Engine:
         cc = self.cache_cfg
         shape = (cc.num_layers, cc.num_kv_heads, cc.num_pages,
                  cc.page_size, cc.head_dim)
-        sharding = None
+        pool_s = scale_s = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
-            sharding = NamedSharding(self.mesh,
-                                     P(None, "kv", None, None, None))
-        aval = jax.ShapeDtypeStruct(shape, jnp.dtype(cc.dtype),
-                                    sharding=sharding)
-        return aval, aval
+            pool_s = NamedSharding(self.mesh,
+                                   P(None, "kv", None, None, None))
+            scale_s = NamedSharding(self.mesh, P(None, "kv", None))
+        aval = jax.ShapeDtypeStruct(shape, cc.pool_jnp_dtype,
+                                    sharding=pool_s)
+        if not self._quant:
+            return aval, aval
+        saval = jax.ShapeDtypeStruct(shape[:3], jnp.float32,
+                                     sharding=scale_s)
+        return aval, aval, saval, saval
+
+    def _pool_args(self) -> tuple:
+        """The engine's CURRENT pool buffers, in signature order."""
+        if self._quant:
+            return (self.k_pages, self.v_pages, self.k_scale,
+                    self.v_scale)
+        return (self.k_pages, self.v_pages)
+
+    def _adopt_pools(self, outs):
+        """Rebind the engine's pool references from a program's leading
+        outputs; returns the remaining outputs."""
+        n = len(self._pool_argnums)
+        if self._quant:
+            (self.k_pages, self.v_pages, self.k_scale,
+             self.v_scale) = outs[:n]
+        else:
+            self.k_pages, self.v_pages = outs[:n]
+        return outs[n:]
 
     def _decode_example_args(self):
         cc = self.cache_cfg
-        k, v = self._pool_avals()
         b = cc.max_seqs
-        return (self.params, k, v,
+        return (self.params, *self._pool_avals(),
                 jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
                 jnp.zeros((b, cc.max_pages_per_seq), jnp.int32),
                 jnp.zeros((b,), bool))
 
     def _prefill_example_args(self):
         cc = self.cache_cfg
-        k, v = self._pool_avals()
-        return (self.params, k, v,
+        return (self.params, *self._pool_avals(),
                 jnp.zeros((self.cfg.prefill_chunk,), jnp.int32),
                 jnp.int32(0), jnp.int32(0),
                 jnp.zeros((cc.max_pages_per_seq,), jnp.int32))
@@ -333,9 +402,8 @@ class Engine:
         CompiledLoop contract: pools + slot-state carries lead, then
         the read-only block tables, then the dynamic trip count)."""
         cc = self.cache_cfg
-        k, v = self._pool_avals()
         b = cc.max_seqs
-        args = (self.params, k, v,
+        args = (self.params, *self._pool_avals(),
                 jnp.zeros((D.STATE_ROWS, b), jnp.int32))  # packed state
         if self.cfg.speculative:
             args += (jnp.zeros((b, self.model_cfg.vocab_size),
@@ -346,7 +414,11 @@ class Engine:
 
     def _reset_state(self):
         self.cache = PagedKVCache(self.cache_cfg)
-        self.k_pages, self.v_pages = self._pools()
+        self.k_scale = self.v_scale = None
+        self._adopt_pools(self._pools())
+        self._cow_fns = None   # lazily-jitted page-copy programs
+        self.concurrent_peak = 0
+        self._prompt_memo: dict[int, object] = {}
         self.slots: list[_SlotState | None] = [None] * self.cfg.slots
         self.completed: list[M.Completed] = []
         self.queue: deque[Request] = deque()
@@ -458,16 +530,33 @@ class Engine:
             if self.slots[i] is not None:
                 continue
             req = self.pending[0]
+            prompt = self._prompt_of(req)
             # admission control: reserve the WORST CASE (prompt +
-            # output) so a running sequence can never OOM mid-decode
-            if not self.cache.can_fit(req.prompt_len + req.output_len):
+            # output) so a running sequence can never OOM mid-decode.
+            # With prefix sharing the plan charges only UNSHARED pages
+            # (fully-matched prefix pages map by reference; the
+            # divergence page's copy-on-write copy is pre-charged)
+            plan = self.cache.plan_admission(
+                req.prompt_len + req.output_len,
+                prompt if self.cfg.prefix_sharing else None)
+            if plan.need_pages > self.cache.free_pages:
                 break  # FIFO: do not starve the head by admitting later
             self.pending.pop(0)
-            self.cache.allocate(i, req.prompt_len + req.output_len)
+            cow_dst = self.cache.admit(i, plan)
+            if cow_dst is not None:
+                # COW resolved eagerly at the admission sync boundary:
+                # the divergence page's prefix rows are copied into the
+                # private page BEFORE any prefill/decode write lands
+                self._cow_copy(plan.cow_src, cow_dst)
             st = _SlotState(req, admitted_s=self._now())
-            st.prompt = D.prompt_tokens(req.rid, req.prompt_len,
-                                        self.model_cfg.vocab_size)
+            st.prompt = prompt
+            # the shared prefix is already cached — prefill resumes at
+            # the divergence point (the TTFT win prefix sharing buys)
+            st.prefill_done = plan.shared_tokens
             self.slots[i] = st
+            self.concurrent_peak = max(
+                self.concurrent_peak,
+                sum(1 for s in self.slots if s is not None))
             if self.cfg.prefill == "separate":
                 # drain the whole prompt now (the separate-phase mode:
                 # prefill monopolizes the engine while it runs, which
@@ -475,6 +564,34 @@ class Engine:
                 while self.slots[i] is not None \
                         and st.prefill_done < req.prompt_len:
                     self._prefill_one(i, st)
+
+    def _prompt_of(self, req: Request):
+        """Request -> prompt tokens, memoized: a blocked queue head is
+        re-planned every engine iteration and must not regenerate (or
+        re-hash) its prompt each time."""
+        toks = self._prompt_memo.get(req.rid)
+        if toks is None:
+            toks = D.prompt_tokens_for(req, self.model_cfg.vocab_size)
+            self._prompt_memo[req.rid] = toks
+        return toks
+
+    def _cow_copy(self, src: int, dst: int) -> None:
+        """Device-side page copy for an admission-time COW: the shared
+        page's rows (and, on a quantized cache, its scales) land in the
+        newly charged private page.  One tiny jitted program, traced
+        once per array rank; runs at the admission boundary, never
+        inside the compiled decode programs."""
+        if self._cow_fns is None:
+            self._cow_fns = jax.jit(
+                lambda a, s, d: a.at[:, :, d].set(a[:, :, s]),
+                donate_argnums=(0,))
+        f = self._cow_fns
+        s, d = jnp.int32(src), jnp.int32(dst)
+        self.k_pages = f(self.k_pages, s, d)
+        self.v_pages = f(self.v_pages, s, d)
+        if self._quant:
+            self.k_scale = f(self.k_scale, s, d)
+            self.v_scale = f(self.v_scale, s, d)
 
     def _prefill_one(self, slot: int, st: _SlotState) -> float:
         """One prefill chunk; returns the compiled-call wall seconds
@@ -501,9 +618,10 @@ class Engine:
         chunk = jnp.asarray(chunk_np)
         row = jnp.asarray(self.cache.block_tables[slot])
         t0 = time.perf_counter()
-        self.k_pages, self.v_pages, nxt = self._prefill(
-            self.params, self.k_pages, self.v_pages, chunk,
+        outs = self._prefill(
+            self.params, *self._pool_args(), chunk,
             jnp.int32(start), jnp.int32(n), row)
+        (nxt,) = self._adopt_pools(outs)
         st.prefill_done += n
         self.cache.append(slot, n)
         dev_s = 0.0
@@ -516,6 +634,11 @@ class Engine:
             st.first_token_s = self._now()
             self.token_streams.setdefault(st.req.rid, []).append(
                 st.last_token)
+            if self.cfg.prefix_sharing:
+                # the prompt is fully cached: publish its pages so
+                # later arrivals can share them (prompt only —
+                # generated tokens are request-specific)
+                self.cache.publish(slot, st.prompt)
             self._maybe_finish(slot, st)
             if self.slots[slot] is st:
                 # entering the decode phase: seed the device-resident
@@ -590,10 +713,11 @@ class Engine:
             positions[i] = int(self.cache.lengths[i])
             active[i] = True
         t0 = time.perf_counter()
-        self.k_pages, self.v_pages, nxt = self._decode(
-            self.params, self.k_pages, self.v_pages,
+        outs = self._decode(
+            self.params, *self._pool_args(),
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(self.cache.block_tables), jnp.asarray(active))
+        (nxt,) = self._adopt_pools(outs)
         nxt = np.asarray(nxt)        # the fence rides the device leg
         t1 = time.perf_counter()
         dev_s += t1 - t0
@@ -628,11 +752,10 @@ class Engine:
         carries = ds.carries()            # flushes if dirty (priced)
         bt = ds.block_tables_device()
         t0 = time.perf_counter()
-        outs = self._loop(self.params, self.k_pages, self.v_pages,
+        outs = self._loop(self.params, *self._pool_args(),
                           *carries, bt, self._n_scalar(n))
         new_carries, extras = self._loop.split(outs)
-        self.k_pages, self.v_pages = new_carries[0], new_carries[1]
-        ds.rebind(new_carries[2:])
+        ds.rebind(self._adopt_pools(new_carries))
         if self.cfg.speculative:
             toks, cnts, steps, drafted, accepted = extras
         else:
@@ -792,10 +915,17 @@ class Engine:
                       f"_v{self.model_cfg.vocab_size}"),
             "world_size": cfg.world,
             "arrival_plan": plan.to_dict(),
+            # comparable global (ISSUE 12): records from differently-
+            # quantized caches must never merge — metrics/merge refuses
+            # a mismatch exactly like a mismatched fault plan
+            "kv_cache_dtype": cfg.cache_dtype,
             "serving_config": {
                 "slots": cfg.slots, "page_size": cfg.page_size,
                 "num_pages": cfg.num_pages,
                 "max_seq_len": cfg.max_seq_len,
+                "pool_bytes": self.cache_cfg.pool_bytes,
+                "cache_dtype": cfg.cache_dtype,
+                "prefix_sharing": cfg.prefix_sharing,
                 "prefill": cfg.prefill,
                 "prefill_chunk": cfg.prefill_chunk,
                 "kv_shard": cfg.kv_shard,
@@ -887,6 +1017,8 @@ def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
         final.engine_steps += steps0
         final._occupancy_samples = occ0 + final._occupancy_samples
         final.queue_depth_max = max(qmax0, final.queue_depth_max)
+        final.concurrent_peak = max(engine.concurrent_peak,
+                                    final.concurrent_peak)
         meta["mesh"] = engine2.global_meta(plan)["mesh"]
         extra = {"detection_ms": round(detection_ms, 3),
                  "recovery_ms": round(recovery_ms, 3),
@@ -900,7 +1032,17 @@ def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
         cache_stats=final.cache.stats(),
         queue_depth_max=final.queue_depth_max,
         batch_occupancy_mean=final.batch_occupancy_mean(),
-        decode_loop=final.decode_loop_block())
+        decode_loop=final.decode_loop_block(),
+        admitted_peak=final.concurrent_peak)
+    if cfg.prefix_sharing:
+        # record globals (ISSUE 12 acceptance: a sharing run must
+        # stamp its measured hit rate and bytes saved).  VOLATILE in
+        # merge: residency at admission time depends on wall-clock
+        # arrival vs engine speed, so the counts can differ across
+        # hosts/reruns of one plan (metrics/merge.py)
+        pstats = final.cache.stats().get("prefix", {})
+        meta["prefix_hit_rate"] = pstats.get("hit_rate", 0.0)
+        meta["prefix_bytes_saved"] = pstats.get("bytes_saved", 0)
     if fault_plan is not None:
         meta["fault_plan"] = fault_plan.to_dict()
         meta["fault_policy"] = fault_plan.policy

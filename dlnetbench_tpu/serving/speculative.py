@@ -189,6 +189,17 @@ def make_spec_decode_loop(cfg: TransformerConfig,
     check_config(cfg, decode=True)
     check_spec_config(cfg, spec_k=spec_k, drafter=drafter,
                       drafter_layers=drafter_layers)
+    if cache_cfg.quantized:
+        # the ServingConfig-level refusal, mirrored at the builder:
+        # the verify pass overwrites drafter rows and every overwrite
+        # re-quantizes the page — a parity bar for that write cycling
+        # has not been stated, so the combination is refused loudly
+        # rather than shipped untested (docs/SERVING.md)
+        raise ValueError(
+            "speculative decode supports the bf16 cache only — "
+            f"cache_dtype={cache_cfg.cache_dtype!r} re-quantizes "
+            "pages on every draft/verify overwrite and has no stated "
+            "parity bar; run speculative on the dense cache")
     if n_max < 1:
         raise ValueError(f"spec_decode_loop: n_max must be >= 1, "
                          f"got {n_max}")
@@ -225,9 +236,10 @@ def make_spec_decode_loop(cfg: TransformerConfig,
                 prev, dpos, ds = last, pos, []
                 for _ in range(spec_k):
                     ok = act & (dpos < limits)
-                    dkp, dvp, prev = _step_tokens(
-                        cfg, cache_cfg, attn, params, dkp, dvp, prev,
-                        dpos, ok, block_tables, layers=drafter_layers)
+                    (dkp, dvp), prev = _step_tokens(
+                        cfg, cache_cfg, attn, params, (dkp, dvp),
+                        prev, dpos, ok, block_tables,
+                        layers=drafter_layers)
                     ds.append(prev)
                     dpos = dpos + 1
                 kp, vp = dkp, dvp

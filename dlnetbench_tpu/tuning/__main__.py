@@ -18,7 +18,9 @@ Ops: ``quantized_matmul`` (fused Pallas grid blocks),
 ``flash_fwd`` / ``flash_bwd`` (flash-attention block shapes),
 ``splash_fwd`` / ``splash_bwd`` (block-sparse masked attention blocks —
 ``--window``/``--seg_avg``/``--seg_seed`` pick the mask, which rides
-in the key), ``paged_attention`` (``pages_per_compute_block``),
+in the key), ``paged_attention`` / ``paged_attention_quant``
+(``pages_per_compute_block``; the quant op takes ``--fmt`` and
+measures the dequantizing kernel over int8/fp8 pools — ISSUE 12),
 ``tp_overlap_chunks`` (collective-matmul ring grain, needs >= 2
 devices), ``grad_bucket_layers`` (bucketed DP grad sync, needs >= 2
 devices).  Every op measures with the K-chained fence timing the bench
@@ -37,8 +39,8 @@ from dlnetbench_tpu.tuning.db import TuningDB
 from dlnetbench_tpu.tuning.search import tune_and_commit
 
 OPS = ("quantized_matmul", "flash_fwd", "flash_bwd", "splash_fwd",
-       "splash_bwd", "paged_attention", "tp_overlap_chunks",
-       "grad_bucket_layers")
+       "splash_bwd", "paged_attention", "paged_attention_quant",
+       "tp_overlap_chunks", "grad_bucket_layers")
 
 
 def _parse_candidates(spec: str | None, arity: int,
@@ -238,6 +240,43 @@ def _tune_paged_attention(args):
     return "paged_attention", key, cands, measure_cfg
 
 
+def _tune_paged_attention_quant(args):
+    import jax
+    import jax.numpy as jnp
+
+    from dlnetbench_tpu.serving import kv_cache as kvc
+
+    b, hq, hkv, dh = args.batch, args.heads, args.kv_heads, args.head_dim
+    pages, psz = args.pages, args.page_size
+    fmt = {"int8": "int8", "float8": "float8"}[args.fmt]
+    qdt = jnp.int8 if fmt == "int8" else jnp.float8_e4m3fn
+    q = jax.random.normal(jax.random.key(0), (b, hq, dh), jnp.float32)
+    kp = jax.random.randint(jax.random.key(1),
+                            (hkv, pages * b, psz, dh), -127,
+                            127).astype(qdt)
+    vp = jax.random.randint(jax.random.key(2), kp.shape, -127,
+                            127).astype(qdt)
+    ks = jnp.abs(jax.random.normal(jax.random.key(3),
+                                   (hkv, pages * b))) * 0.02 + 1e-4
+    vs = jnp.abs(jax.random.normal(jax.random.key(4),
+                                   (hkv, pages * b))) * 0.02 + 1e-4
+    lengths = jnp.full((b,), pages * psz, jnp.int32)
+    pidx = jnp.arange(pages * b, dtype=jnp.int32).reshape(b, pages)
+    key = tparams.paged_attention_quant_key(pages, psz, b, hq, hkv, dh,
+                                            fmt)
+    cands = _parse_candidates(args.candidates, 1,
+                              ("pages_per_compute_block",)) or [
+        {"pages_per_compute_block": c}
+        for c in (1, 2, 4, 8, 16) if c <= pages and pages % c == 0]
+
+    def measure_cfg(cfg):
+        return _chain(lambda *a: kvc.paged_attention_decode(
+            *a, k_scale=ks, v_scale=vs, fmt=fmt, impl="pallas",
+            pages_per_compute_block=cfg["pages_per_compute_block"]),
+            (q, kp, vp, lengths, pidx), args.k)
+    return "paged_attention_quant", key, cands, measure_cfg
+
+
 def _tune_tp_overlap_chunks(args):
     import jax
     import jax.numpy as jnp
@@ -322,6 +361,8 @@ def _run_tune(args) -> int:
         "splash_fwd": lambda: _tune_splash(args, "fwd"),
         "splash_bwd": lambda: _tune_splash(args, "bwd"),
         "paged_attention": lambda: _tune_paged_attention(args),
+        "paged_attention_quant":
+            lambda: _tune_paged_attention_quant(args),
         "tp_overlap_chunks": lambda: _tune_tp_overlap_chunks(args),
         "grad_bucket_layers": lambda: _tune_grad_bucket_layers(args),
     }

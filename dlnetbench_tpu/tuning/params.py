@@ -179,6 +179,20 @@ def paged_attention_key(pages_per_seq: int, page_size: int, b: int,
                          page_size=page_size, b=b, hq=hq, hkv=hkv, dh=dh)
 
 
+def paged_attention_quant_key(pages_per_seq: int, page_size: int,
+                              b: int, hq: int, hkv: int, dh: int,
+                              fmt: str) -> str:
+    """The QUANTIZED paged-attention decode kernel
+    (ops/paged_attention_quant, op name "paged_attention_quant") —
+    same geometry fields as the dense kernel plus the quant format:
+    in-prologue dequant changes the kernel's arithmetic intensity, so
+    a dense optimum must never answer a quantized consult and int8/fp8
+    optima are distinct records (ISSUE 12)."""
+    return canonical_key(pages_per_seq=pages_per_seq,
+                         page_size=page_size, b=b, hq=hq, hkv=hkv,
+                         dh=dh, fmt=fmt)
+
+
 def tp_overlap_chunks_key(embed: int, ff: int, seq: int, tp: int,
                           dtype: str) -> str:
     return canonical_key(embed=embed, ff=ff, seq=seq, tp=tp,

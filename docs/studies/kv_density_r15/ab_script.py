@@ -1,0 +1,29 @@
+"""Generate the ISSUE 12 serving-density artifact: the dense vs int8
+vs fp8 equal-pool-bytes capacity A/B (bench.py kv_density_ab) plus the
+prefix-heavy shared-system-prompt sharing A/B, committed beside this
+script.
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python docs/studies/kv_density_r15/ab_script.py
+
+Fails (non-zero exit) unless the acceptance evidence holds at
+generation time: both quant recipes inside their stated decode-parity
+bars, admitted concurrency >= 1.8x dense at the same pool bytes with a
+band-disjoint goodput-at-SLO win, prefix sharing token-lossless with
+measured hit-rate and bytes-saved > 0.
+"""
+import sys
+from pathlib import Path
+
+OUT = Path(__file__).resolve().parent
+sys.path.insert(0, str(OUT.parents[2]))   # repo root
+
+
+def main() -> int:
+    from examples.pod_study import run_kv_density_study
+    return run_kv_density_study(OUT)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

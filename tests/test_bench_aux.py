@@ -555,3 +555,56 @@ def test_longcontext_line_schema_locked():
     line2 = bench._longcontext_line(summaries2, rounds, metric="m",
                                     mask_info=mask_info)
     assert line2["band_disjoint_win"] is False
+
+
+def test_kv_density_line_schema_locked():
+    """bench.py's kv_density_ab aux line (ISSUE 12) is a pure
+    assembler: lock the stat-band schema — ms headline from the DENSE
+    engine's round-median e2e p99 (lower-is-better, sentinel-
+    comparable), per-variant {value, best, band, n} sub-objects for
+    admitted slots / tokens-per-s / goodput-at-SLO, capacity ratios
+    and the per-recipe parity bars."""
+    import bench
+
+    def srv(p99, adm, tps, grps):
+        return {"e2e_ms": {"p99": p99}, "tokens_per_s": tps,
+                "goodput_frac": 1.0, "goodput_rps": grps,
+                "admitted_concurrency_peak": adm,
+                "kv_cache": {"num_pages": 25 if adm < 10 else 96,
+                             "pool_bytes": 102400}}
+    rounds = {
+        "bf16": [srv(90.0, 7, 3000.0, 200.0), srv(95.0, 7, 2900.0,
+                                                  195.0),
+                 srv(92.0, 7, 3100.0, 205.0)],
+        "int8": [srv(55.0, 20, 5000.0, 350.0), srv(58.0, 20, 5200.0,
+                                                   360.0),
+                 srv(56.0, 20, 5100.0, 355.0)],
+        "fp8": [srv(100.0, 20, 2900.0, 190.0), srv(105.0, 20, 2800.0,
+                                                   185.0),
+                srv(102.0, 20, 2850.0, 188.0)],
+    }
+    parity = {"int8": [0.01, 0.012, 0.011], "fp8": [0.07, 0.08, 0.075]}
+    line = bench._kv_density_line(rounds, parity, 102400, suffix=", t")
+    assert line["unit"] == "ms" and line["n"] == 3
+    assert line["value"] == 92.0 and line["band"] == [90.0, 95.0]
+    assert line["pool_bytes_budget"] == 102400
+    for name in ("bf16", "int8", "fp8"):
+        v = line["variants"][name]
+        for key in ("admitted_slots", "tokens_per_s", "e2e_p99_ms",
+                    "goodput_frac", "goodput_rps"):
+            for k in ("value", "best", "band", "n"):
+                assert k in v[key], (name, key, k)
+        assert v["num_pages"] in (25, 96) and v["pool_bytes"] == 102400
+    i8 = line["variants"]["int8"]
+    assert i8["capacity_x"]["value"] == pytest.approx(20 / 7, rel=1e-3)
+    assert i8["parity_tol"] == 0.05 and i8["parity_ok"] is True
+    assert i8["parity_max_err"]["value"] == 0.011
+    # dense carries NO parity keys (it IS the reference)
+    assert "parity_ok" not in line["variants"]["bf16"]
+    # a parity excursion past the stated bar flips the verdict
+    bad = bench._kv_density_line(
+        rounds, {"int8": [0.2, 0.2, 0.2], "fp8": parity["fp8"]},
+        102400)
+    assert bad["variants"]["int8"]["parity_ok"] is False
+    from dlnetbench_tpu.sentinel import is_ms_line
+    assert is_ms_line(line)

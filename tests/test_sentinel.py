@@ -452,3 +452,30 @@ def test_longcontext_line_is_comparable():
         "headline": _line(10.0, [9.9, 10.1]),
         "longcontext_ab": lc_line(10.3, [9.8, 10.8])})
     assert ok["verdict"] == "clean"
+
+
+@pytest.mark.sentinel
+def test_kv_density_line_is_comparable():
+    """The kv_density_ab aux line (ISSUE 12) rides the headline like
+    every ms line: the sentinel compares it by the dense engine's e2e
+    p99, band-aware lower-is-better, and the nested per-variant
+    capacity/parity blocks never confuse the comparison."""
+    def density_line(value, band):
+        return {"metric": "kv_density_ab: dense vs int8 vs fp8",
+                "value": value, "unit": "ms", "best": band[0],
+                "band": band, "n": 3,
+                "variants": {"int8": {
+                    "capacity_x": {"value": 2.9, "band": [2.8, 3.0]},
+                    "parity_ok": True}}}
+
+    base = {"headline": _line(10.0, [9.9, 10.1]),
+            "kv_density_ab": density_line(90.0, [88.0, 92.0])}
+    cur = {"headline": _line(10.0, [9.9, 10.1]),
+           "kv_density_ab": density_line(180.0, [176.0, 184.0])}
+    sent = sentinel.check(base, cur)
+    assert sent["verdict"] == "regression"
+    assert sent["regressions"] == ["kv_density_ab"]
+    ok = sentinel.check(base, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "kv_density_ab": density_line(93.0, [87.0, 96.0])})
+    assert ok["verdict"] == "clean"
