@@ -4,7 +4,8 @@
 PYTHON ?= python
 
 .PHONY: test check-bench check-resilience check-serving check-tuning \
-	check-longcontext check-decode check-density sentinel-scan
+	check-longcontext check-decode check-density check-telemetry \
+	sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -104,6 +105,22 @@ check-density:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
 	    tests/test_bench_aux.py::test_kv_density_line_schema_locked \
 	    tests/test_sentinel.py::test_kv_density_line_is_comparable
+
+# the continuous-telemetry lane (docs/OBSERVABILITY.md "Continuous
+# telemetry & the flight recorder"): the flight-recorder ring + anomaly
+# engine contracts (disabled-path zero overhead, byte-identical
+# records, step-time band detection, dump cooldowns), the serving
+# SLO-breach e2e (flight_slo.json + anomalies through parser -> merge),
+# the committed record_telemetry.jsonl round trip into the bandwidth
+# blame columns, the critical-path blame validation (straggler ->
+# injected rank, clean -> no suspect), the watchdog ring-trend
+# breadcrumb, and the live-metrics line schema.  ~1 min wall.
+check-telemetry:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m 'telemetry and not slow' \
+	    tests/test_telemetry.py tests/test_critical_path.py \
+	    tests/test_watchdog.py
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
+	    tests/test_bench_aux.py::test_live_metrics_line_schema_locked
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

@@ -262,6 +262,13 @@ def _parse_args(argv=None):
                    help="measure only the headline train step (the "
                         "sentinel lane's tiny-CPU mode; aux lines emit "
                         "nothing, not even skip markers)")
+    p.add_argument("--live-metrics", "--live_metrics",
+                   dest="live_metrics", default=None, metavar="PATH",
+                   help="serving lines stream one windowed snapshot "
+                        "JSONL line per 0.5 s of engine time to PATH "
+                        "(rolling TTFT/TPOT percentiles, queue depth, "
+                        "KV occupancy — serving/metrics."
+                        "LiveMetricsWriter; ISSUE 14)")
     return p.parse_args(argv)
 
 
@@ -270,6 +277,9 @@ def main(argv=None) -> int:
     # defaults; only the __main__ path below hands over sys.argv
     args = _parse_args(argv if argv is not None else [])
     tracer = spans.enable() if args.trace_out else None
+    from dlnetbench_tpu.metrics import telemetry
+    tele_on = (not telemetry.is_enabled()
+               and telemetry.enable_from_env() is not None)
     try:
         return _run_bench(args, tracer)
     finally:
@@ -278,6 +288,8 @@ def main(argv=None) -> int:
         # calls (tests, __graft_entry__) recording into a dead tracer
         if spans.is_enabled():
             spans.disable()
+        if tele_on:
+            telemetry.disable()
 
 
 def _run_bench(args, tracer) -> int:
@@ -554,7 +566,8 @@ def _run_bench(args, tracer) -> int:
         ckpt_ab = _aux("checkpoint A/B", _bench_checkpoint_ab)
         # cheap (tiny decode engine, one compile, 3 replayed rounds):
         # the serving tier's latency line — TTFT/TPOT/e2e-p99 bands
-        serving = _aux("serving decode", _bench_serving_decode)
+        serving = _aux("serving decode", _bench_serving_decode,
+                       args.live_metrics)
         # the ISSUE-12 density evidence: dense vs int8 vs fp8 paged-KV
         # engines at EQUAL pool bytes — admitted concurrency, tokens/s
         # and the per-recipe decode-parity bars
@@ -676,10 +689,18 @@ def _run_bench(args, tracer) -> int:
     if tracer is not None:
         spans.disable()
         try:
+            extra = spans.attribution_counter_events(
+                headline_attr or {}, dur_us=step_s * 1e6)
+            from dlnetbench_tpu.metrics import telemetry
+            rec_now = telemetry.current()
+            if rec_now is not None:
+                # the flight ring as counter tracks beside the spans
+                extra = extra + spans.telemetry_counter_events(
+                    rec_now.telemetry_block(last=rec_now.capacity),
+                    rec_now.anomalies_block())
             spans.write_chrome_trace(
                 args.trace_out, tracer, device_events,
-                extra_events=spans.attribution_counter_events(
-                    headline_attr or {}, dur_us=step_s * 1e6))
+                extra_events=extra)
             print(f"merged host+device trace -> {args.trace_out}",
                   file=sys.stderr)
         except OSError as e:  # the headline already printed — keep rc 0
@@ -879,7 +900,7 @@ def _serving_decode_line(rounds: list[dict], suffix: str = "", *,
     return stats_mod.flag_low_mode(line)
 
 
-def _bench_serving_decode() -> dict | None:
+def _bench_serving_decode(live_path: str | None = None) -> dict | None:
     """The serving-tier A/B line (ISSUE 8 base + ISSUE 11 tentpole):
     THREE engines over the same weights — the classic 1-step engine,
     the device-resident N-step fused loop, and the fused loop with
@@ -932,6 +953,14 @@ def _bench_serving_decode() -> dict | None:
     requests = plan.sample()
     engines = {name: Engine(mc, cfg, params=params)
                for name, cfg in variants.items()}
+    if live_path:
+        # the --live-metrics stream (ISSUE 14 satellite): one windowed
+        # snapshot line per 0.5 s of engine time from the 1-step
+        # baseline engine (the sentinel-comparable line's engine —
+        # mixing three engines into one stream would interleave
+        # incomparable snapshots)
+        from dlnetbench_tpu.serving.metrics import LiveMetricsWriter
+        engines["one_step"].live = LiveMetricsWriter(live_path)
     streams: dict[str, dict] = {}
     for name, eng in engines.items():
         eng.run(requests)   # warm round (first-dispatch), discarded

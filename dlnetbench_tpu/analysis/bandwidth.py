@@ -220,6 +220,13 @@ def effective_bandwidth(records: list[dict]):
         tuned = (f"{int(tun.get('hits', 0))}/"
                  f"{int(tun.get('hits', 0)) + int(tun.get('misses', 0))}"
                  if isinstance(tun, dict) else "-")
+        # critical-path blame (ISSUE 14, analysis/critical_path.py):
+        # which rank's clock carried the excess, and how much of it —
+        # per-rank signal exists only on records with genuinely
+        # per-rank step series (native/merged multi-process runs);
+        # single-controller records degrade to "-"/NaN
+        from dlnetbench_tpu.analysis.critical_path import blame_columns
+        blame = blame_columns(rec)
         for rank_row in rec.get("ranks", []):
             # measured comm–compute overlap fraction (schema v2+,
             # proxies/base.py): one dimensionless sample per run, riding
@@ -321,6 +328,7 @@ def effective_bandwidth(records: list[dict]):
                         "straggler_amp": straggler_amp,
                         **ckpt_cols,
                         **attr_cols,
+                        **blame,
                     })
     return pd.DataFrame(rows)
 
@@ -396,9 +404,11 @@ def bandwidth_summary(records: list[dict]):
     if bw.empty:
         return bw
     return (bw.groupby(["section", "model", "collective", "group_size",
-                        "bound", "transport", "tuned", "attr_bound"])
+                        "bound", "transport", "tuned", "attr_bound",
+                        "blame_rank"])
             [["time_us", "msg_bytes", "algbw_GBps", "busbw_GBps",
               "overlap", "straggler_amp", "detection_ms", "recovery_ms",
               "checkpoint_ms", "restore_ms", "lost_steps", "goodput",
-              "attr_compute", "attr_hbm", "attr_comm", "attr_host"]]
+              "attr_compute", "attr_hbm", "attr_comm", "attr_host",
+              "blame_frac"]]
             .mean().reset_index())

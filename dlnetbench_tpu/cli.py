@@ -118,6 +118,37 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    choices=["auto", "orbax", "npz"],
                    help="auto prefers orbax, falls back to the pure-"
                         "numpy npz backend")
+    p.add_argument("--telemetry", action="store_true",
+                   help="continuous telemetry (metrics/telemetry.py): "
+                        "record a fixed-capacity flight ring of "
+                        "per-step samples and run the anomaly engine "
+                        "(watchdog stall / fault / SLO breach / "
+                        "band-aware step-time change); the record "
+                        "stamps telemetry + anomalies blocks and "
+                        "anomaly dumps land in --flight-dir.  Also "
+                        "enabled by DLNB_TELEMETRY=1 "
+                        "(docs/OBSERVABILITY.md)")
+    p.add_argument("--flight-dir", "--flight_dir", dest="flight_dir",
+                   default=None, metavar="DIR",
+                   help="where anomaly-triggered flight_<trigger>.json "
+                        "ring dumps land (default: DLNB_FLIGHT_DIR; "
+                        "no dir = anomalies recorded without dumps)")
+
+
+def _telemetry_enable(args) -> bool:
+    """Install the flight recorder for this run (ISSUE 14): the
+    ``--telemetry``/``--flight-dir`` flags or the ``DLNB_TELEMETRY``
+    env channel.  Returns True when THIS call enabled it (the caller
+    then owns the disable — an already-active recorder, e.g. a test
+    harness's, is never torn down here)."""
+    from dlnetbench_tpu.metrics import telemetry
+    if telemetry.is_enabled():
+        return False
+    if getattr(args, "telemetry", False) \
+            or getattr(args, "flight_dir", None):
+        telemetry.enable(dump_dir=getattr(args, "flight_dir", None))
+        return True
+    return telemetry.enable_from_env() is not None
 
 
 def _cfg(args) -> ProxyConfig:
@@ -221,7 +252,13 @@ def main(argv: list[str] | None = None) -> int:
 
     args = parser.parse_args(argv)
     if args.proxy == "serve":
-        return _run_serve(args, parser)
+        tele_on = _telemetry_enable(args)
+        try:
+            return _run_serve(args, parser)
+        finally:
+            if tele_on:
+                from dlnetbench_tpu.metrics import telemetry
+                telemetry.disable()
     cfg = _cfg(args)
 
     if getattr(args, "max_layers", 0) < 0:
@@ -275,6 +312,7 @@ def main(argv: list[str] | None = None) -> int:
     # spans), warmup, timed runs, the profiled iteration — so the merged
     # timeline answers "where did this run's wall-clock go"
     tracer = spans.enable() if args.trace_out else None
+    tele_on = _telemetry_enable(args)
     try:
         return _run_measured(args, parser, stats, cfg, devices, dtype,
                              dtype_name, variables, tracer)
@@ -284,6 +322,9 @@ def main(argv: list[str] | None = None) -> int:
         # runs in this process (sweep's in-process mode, test harnesses)
         if spans.is_enabled():
             spans.disable()
+        if tele_on:
+            from dlnetbench_tpu.metrics import telemetry
+            telemetry.disable()
 
 
 def _run_measured(args, parser, stats, cfg, devices, dtype, dtype_name,
@@ -386,7 +427,17 @@ def _run_measured(args, parser, stats, cfg, devices, dtype, dtype_name,
     if tracer is not None:
         spans.disable()
         try:
-            spans.write_chrome_trace(args.trace_out, tracer, device_events)
+            # flight-recorder counter tracks ride the same timeline
+            # (ISSUE 14): the full resident ring + anomaly instants
+            from dlnetbench_tpu.metrics import telemetry
+            rec_now = telemetry.current()
+            extra = None
+            if rec_now is not None:
+                extra = spans.telemetry_counter_events(
+                    rec_now.telemetry_block(last=rec_now.capacity),
+                    rec_now.anomalies_block())
+            spans.write_chrome_trace(args.trace_out, tracer,
+                                     device_events, extra_events=extra)
             print(f"merged host+device trace -> {args.trace_out}",
                   file=sys.stderr)
         except OSError as e:
@@ -518,6 +569,23 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
     p.add_argument("--tag", action="append", default=[],
                    metavar="KEY=VALUE")
     p.add_argument("--platform", default=None)
+    p.add_argument("--telemetry", action="store_true",
+                   help="continuous telemetry (ISSUE 14): per-engine-"
+                        "step flight ring (queue depth, occupancy, "
+                        "sync costs) + the anomaly engine (SLO breach, "
+                        "fault, step-time change); the record stamps "
+                        "telemetry/anomalies blocks")
+    p.add_argument("--flight-dir", "--flight_dir", dest="flight_dir",
+                   default=None, metavar="DIR",
+                   help="where anomaly flight_<trigger>.json ring "
+                        "dumps land (default: DLNB_FLIGHT_DIR)")
+    p.add_argument("--live-metrics", "--live_metrics",
+                   dest="live_metrics", default=None, metavar="PATH",
+                   help="stream one windowed snapshot JSONL line per "
+                        "0.5 s of engine time (rolling TTFT/TPOT "
+                        "percentiles, queue depth, occupancy) — the "
+                        "live dashboard channel "
+                        "(serving/metrics.LiveMetricsWriter)")
 
 
 def _run_serve(args, parser) -> int:
@@ -590,7 +658,8 @@ def _run_serve(args, parser) -> int:
     from dlnetbench_tpu.models.transformer import init_params
     params = init_params(jax.random.key(args.seed), model_cfg)
     result = run_serving(model_cfg, srv_cfg, plan,
-                         fault_plan=fault_plan, params=params)
+                         fault_plan=fault_plan, params=params,
+                         live_metrics=args.live_metrics)
     if variables:
         result.global_meta["variables"] = variables
     record = emit_result(result, path=args.out)

@@ -64,11 +64,22 @@ class FaultInjector:
     gates the step (collective semantics) and a crash targeting any
     rank surfaces as that rank's RankFailure.  ``iteration`` counts
     every harness step (warmup included), matching the native tier.
+
+    The single-controller default plays EVERY rank (``rank=None``).
+    ``rank=r`` scopes the injector to one rank's view — only events
+    targeting ``r`` fire — which is how a multi-controller run (one
+    process per rank, each measuring its own clock) injects: each
+    process constructs ``FaultInjector(plan, world, rank=its_rank)``
+    and the straggler's delay lands on exactly the scripted rank's
+    timeline (the per-rank step series analysis/critical_path.py
+    assigns blame from).
     """
 
-    def __init__(self, plan: FaultPlan, world: int | None = None):
+    def __init__(self, plan: FaultPlan, world: int | None = None,
+                 rank: int | None = None):
         self.plan = plan
         self.world = world  # needed to name a partition's far side
+        self.rank = rank    # None = controller plays every rank
         self.iteration = 0
         self.injected_delay_us = 0.0
         self.crash_raised_at = 0.0  # monotonic stamp for detection_ms
@@ -89,6 +100,10 @@ class FaultInjector:
         sleep_us = 0.0
         for ei, e in enumerate(self.plan.events):
             if not e.live_at(it):
+                continue
+            if self.rank is not None and not e.targets(self.rank):
+                # rank-scoped view (multi-controller emulation): this
+                # rank's timeline only carries events aimed at it
                 continue
             if e.kind == "delay" and e.where == "step":
                 sleep_us += e.magnitude_us

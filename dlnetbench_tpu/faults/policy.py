@@ -194,6 +194,13 @@ def run_faulted(name: str, bundle, cfg: ProxyConfig, plan: FaultPlan, *,
     except RankFailure as e:
         failure = e  # survive the except-block name cleanup
         detection_ms = (time.monotonic() - injector.crash_raised_at) * 1e3
+        # anomaly engine (ISSUE 14): a detected fault is a trigger —
+        # the flight ring into the crash dumps as flight_fault.json
+        from dlnetbench_tpu.metrics import telemetry
+        telemetry.trigger("fault", step=failure.iteration, detail={
+            "kind": "RankFailure", "rank": failure.rank,
+            "iteration": failure.iteration,
+            "detection_ms": round(detection_ms, 3)})
 
     remaining = cfg.runs - pre
     if plan.policy == "retry":
@@ -338,6 +345,12 @@ def _run_preempt(name: str, bundle, cfg: ProxyConfig, cfg_i: ProxyConfig,
     except RankPreempted as e:
         eviction = e
         detection_ms = (time.monotonic() - injector.crash_raised_at) * 1e3
+        from dlnetbench_tpu.metrics import telemetry
+        telemetry.trigger("fault", step=eviction.iteration, detail={
+            "kind": "RankPreempted", "rank": eviction.rank,
+            "iteration": eviction.iteration,
+            "grace_us": eviction.grace_us,
+            "detection_ms": round(detection_ms, 3)})
 
     # grace-window drain: a final save unless the measured cost says
     # the budget cannot fit it (save_now documents the refusal rule)

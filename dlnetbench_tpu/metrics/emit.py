@@ -150,6 +150,22 @@ def result_to_record(result: ProxyResult) -> dict:
         print(f"tuning provenance stamping failed "
               f"({type(e).__name__}: {e}); record unaffected",
               file=sys.stderr)
+    # continuous telemetry (ISSUE 14): ring geometry + tail and the
+    # anomaly events of the run that produced this record.  Disabled
+    # telemetry stamps NOTHING — records from an untelemetered run stay
+    # byte-identical to a pre-telemetry build's (fixture-locked).
+    # Derived data: a failure here must never cost the measurement.
+    try:
+        from dlnetbench_tpu.metrics import telemetry
+        rec_now = telemetry.current()
+        if rec_now is not None:
+            g.setdefault("telemetry", rec_now.telemetry_block())
+            anom = rec_now.anomalies_block()
+            if anom is not None:
+                g.setdefault("anomalies", anom)
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"telemetry stamping failed ({type(e).__name__}: {e}); "
+              f"record unaffected", file=sys.stderr)
     if num_procs > 1:
         g.setdefault("num_processes", num_procs)
     record = {

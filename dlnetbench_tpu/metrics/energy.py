@@ -22,6 +22,14 @@ this is a *host-side* pluggable sampler chain, best available source wins:
 ``run_proxy`` brackets each timed run with ``read_joules()`` and emits the
 per-run deltas as ``energy_consumed``, keeping the reference's record
 schema so the Pareto plots work unchanged.
+
+Continuous telemetry (ISSUE 14): the same per-chain deltas also feed
+the flight-recorder ring per step (``energy_j`` on each ``proxy``
+sample — proxies/base.py gates on ``telemetry.is_enabled()``), so
+anomaly flight dumps show the energy trend into the event and the
+critical-path report carries a per-rank energy axis
+(``analysis/critical_path.py`` sums the ``energy_consumed`` timer over
+the analysis window) wherever a sampler exists.
 """
 from __future__ import annotations
 

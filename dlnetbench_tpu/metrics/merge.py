@@ -84,7 +84,15 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      # without the env set consults nothing) — per-
                      # process warm state, not run identity.  Process
                      # 0's block survives in the merged record.
-                     "tuning"}
+                     "tuning",
+                     # continuous telemetry (ISSUE 14): each process
+                     # records its own flight ring on its own clock and
+                     # detects its own anomalies (a straggler's ring
+                     # looks different from its victims') — per-process
+                     # measurements, never run identity.  Process 0's
+                     # blocks survive in the merged record.
+                     "telemetry", "anomalies",
+                     "watchdog_stall_telemetry"}
 
 # scheduler-stamped variables that identify the PROCESS, not the run
 # (metrics.emit.scheduler_variables): they legitimately differ between
@@ -219,6 +227,27 @@ def merge_records(records: list[dict]) -> dict:
         for proc, rec in sorted(by_process.items())
     }
     validate_record(merged)
+    # anomalies pooled over the processes (ISSUE 14): each process's
+    # flight recorder detects on its own clock, and an anomaly anywhere
+    # in the fleet matters — base-process-only globals would silently
+    # drop a straggler's step_time trigger recorded on another host.
+    # Events keep their origin via a "process" tag; the telemetry RING
+    # stays per-process (process 0's block) like every other volatile.
+    pooled_events, pooled_counts = [], {}
+    for proc, rec in sorted(by_process.items()):
+        anom = rec["global"].get("anomalies")
+        if not isinstance(anom, dict):
+            continue
+        for k, v in (anom.get("triggers") or {}).items():
+            pooled_counts[k] = pooled_counts.get(k, 0) + int(v)
+        for ev in anom.get("events") or []:
+            pooled_events.append({**ev, "process": proc})
+    if pooled_counts:
+        merged["global"] = dict(merged["global"])
+        merged["global"]["anomalies"] = {
+            "count": sum(pooled_counts.values()),
+            "triggers": pooled_counts,
+            "events": pooled_events[-16:]}
     # attribution over the POOLED per-process rows (each input record's
     # block covered only its own clocks).  This is also where NATIVE
     # records — whose C++ emitter stamps no attribution — get theirs

@@ -148,6 +148,14 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                 attr = g.get("attribution")
                 if isinstance(attr, dict) and attr.get("bound"):
                     row["attr_bound"] = attr["bound"]
+                # anomaly engine (a dict global, skipped above): the
+                # groupby-grade count rides as a plain column — "did
+                # this run trip its flight recorder" is the first
+                # question a sweep post-mortem asks.  Clean/untelemetered
+                # records simply lack the block (column absent/NaN).
+                anom = g.get("anomalies")
+                if isinstance(anom, dict) and anom.get("count"):
+                    row["anomaly_count"] = int(anom["count"])
                 # tuning provenance (a dict global, skipped above): the
                 # groupby-grade summary — "hits/consults" — rides as a
                 # plain column; untuned/v1 records simply lack it

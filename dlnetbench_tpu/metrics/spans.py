@@ -304,6 +304,7 @@ def write_chrome_trace(path: str | Path, tracer: Tracer | None,
 # ``metrics.merge --trace-out``) is useful for native runs too.
 
 ATTRIBUTION_PID = 50       # attribution counter track
+TELEMETRY_PID = 60         # flight-recorder counter tracks (ISSUE 14)
 _RECORD_PID_BASE = 100     # per-rank record tracks start here
 
 
@@ -327,6 +328,42 @@ def attribution_counter_events(attr: dict, *, dur_us: float = 1.0,
         events.append({"ph": "C", "pid": pid, "name": "fractions",
                        "ts": ts, "args": {k: round(float(v), 4)
                                           for k, v in fractions.items()}})
+    return events
+
+
+def telemetry_counter_events(block: dict, anomalies: dict | None = None,
+                             *, pid: int = TELEMETRY_PID) -> list[dict]:
+    """Flight-recorder samples -> Perfetto counter tracks: every
+    numeric field of the telemetry samples becomes one 'C' series over
+    the samples' own ``t_s`` clock (us on the trace timeline), and each
+    anomaly event lands as a global instant ('i', scope process) at its
+    trigger time, named by its trigger kind.  Accepts a record's
+    ``global.telemetry`` block (tail samples), a flight dump payload
+    (full ring), or a live ``FlightRecorder.telemetry_block()``."""
+    samples = (block or {}).get("samples") or (block or {}).get("last") \
+        or []
+    events: list[dict] = []
+    if samples:
+        events += [
+            {"ph": "M", "pid": pid, "name": "process_name",
+             "args": {"name": "telemetry (flight recorder)"}},
+            {"ph": "M", "pid": pid, "name": "process_sort_index",
+             "args": {"sort_index": 45}},
+        ]
+        for s in samples:
+            ts = float(s.get("t_s", 0.0)) * 1e6
+            for k, v in s.items():
+                if k in ("t_s", "source", "step") \
+                        or not isinstance(v, (int, float)):
+                    continue
+                events.append({"ph": "C", "pid": pid, "name": k,
+                               "ts": ts, "args": {"value": float(v)}})
+    for ev in ((anomalies or {}).get("events") or []):
+        events.append({"ph": "i", "pid": pid, "tid": 0, "s": "p",
+                       "name": f"anomaly: {ev.get('trigger', '?')}",
+                       "ts": float(ev.get("t_s", 0.0)) * 1e6,
+                       "args": {k: v for k, v in ev.items()
+                                if k != "detail"}})
     return events
 
 
@@ -386,4 +423,8 @@ def record_track_events(record: dict,
     attr = (record.get("global") or {}).get("attribution")
     if attr:
         events.extend(attribution_counter_events(attr, dur_us=max_end))
+    tele = (record.get("global") or {}).get("telemetry")
+    anom = (record.get("global") or {}).get("anomalies")
+    if tele or anom:
+        events.extend(telemetry_counter_events(tele or {}, anom))
     return events

@@ -42,7 +42,7 @@ from collections.abc import Callable
 
 import jax
 
-from dlnetbench_tpu.metrics import spans
+from dlnetbench_tpu.metrics import spans, telemetry
 from dlnetbench_tpu.utils.timing import time_callable, time_chain
 
 DEFAULT_WARMUP = 3   # reference dp.cpp:65
@@ -161,6 +161,17 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
         warmup_s = time_callable(full_step, reps=max(cfg.warmup, 1))
     if wd is not None:
         wd.beat("warmup")
+    if telemetry.is_enabled():
+        # flight-recorder context (ISSUE 14): warmup samples give the
+        # anomaly dumps a pre-measurement baseline.  Step indices count
+        # every harness step warmup included — the fault plan's units.
+        # A fresh run over a live recorder re-baselines the step-time
+        # detector (an in-process sweep's next config is not an anomaly
+        # against the previous config's walls).
+        telemetry.current().reset_walls("proxy")
+        for w, t in enumerate(warmup_s):
+            telemetry.record_step("proxy", step=w, phase="warmup",
+                                  step_wall_us=round(t * 1e6, 1))
 
     runs = cfg.runs
     if cfg.min_exectime_s > 0:
@@ -241,6 +252,27 @@ def run_proxy(name: str, bundle: StepBundle, cfg: ProxyConfig,
             full_s.append(t_full)
             if measure_compute:
                 comp_s.append(time_chain(bundle.compute, k=k))
+            if telemetry.is_enabled():
+                # one ring sample per fenced chain: the measured
+                # per-iteration wall plus the axes the flight dump
+                # needs to explain it (energy per step where a sampler
+                # exists — the ISSUE 14 satellite; the injected delay
+                # so a straggler window self-identifies; the matched
+                # compute leg).  Step index = warmup + iterations so
+                # far (fault-plan units).
+                step_ix = max(cfg.warmup, 1) + sum(chains[:ci]) + k - 1
+                fields = {"phase": "timed",
+                          "step_wall_us": round(t_full * 1e6, 1),
+                          "chain_k": k}
+                if measure_compute:
+                    fields["compute_us"] = round(comp_s[-1] * 1e6, 1)
+                if energy_sampler is not None and energy_j:
+                    fields["energy_j"] = round(energy_j[-1], 6)
+                if injector is not None and fault_us:
+                    fields["fault_delay_us"] = round(fault_us[-1], 1)
+                telemetry.record_step("proxy", step=step_ix, **fields)
+                telemetry.observe_step_wall("proxy", t_full * 1e6,
+                                            step=step_ix)
     timers["runtimes"] = [t * 1e6 for t in full_s]
     if injector is not None:
         timers["fault_delay_us"] = [round(v, 1) for v in fault_us]

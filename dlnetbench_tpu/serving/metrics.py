@@ -123,6 +123,108 @@ def goodput_timeline(completed: list[Completed], slo_ttft_ms: float,
     return out
 
 
+def rolling_slo_breach(recent: list[Completed], *, slo_ttft_ms: float,
+                       slo_tpot_ms: float, now_s: float,
+                       window_s: float = 0.5, min_completed: int = 4,
+                       breach_frac: float = 0.5) -> dict | None:
+    """Live SLO-breach detection: the ``goodput_timeline`` windowing
+    applied to the trailing window at ``now_s``.  Returns the breaching
+    window entry ``{"t_s", "completed", "slo_ok", "goodput_frac"}``
+    when the last ``window_s`` seconds completed at least
+    ``min_completed`` requests and their SLO-ok fraction fell below
+    ``breach_frac`` — the mid-run form of the dip the post-mortem
+    timeline shows after the fact.  None otherwise."""
+    tail = [c for c in recent if c.finish_s >= now_s - window_s]
+    if len(tail) < min_completed:
+        return None
+    ok = sum(1 for c in tail if meets_slo(c, slo_ttft_ms, slo_tpot_ms))
+    frac = ok / len(tail)
+    if frac >= breach_frac:
+        return None
+    return {"t_s": round(now_s, 3), "completed": len(tail),
+            "slo_ok": ok, "goodput_frac": round(frac, 4)}
+
+
+class LiveMetricsWriter:
+    """Windowed live-metrics JSONL stream (the ``bench.py
+    --live-metrics`` channel): one snapshot line per ``window_s`` of
+    engine time — rolling TTFT/TPOT percentiles over the window's
+    completions, queue depth, admitted concurrency, KV occupancy.
+    Schema locked by tests/test_bench_aux.py; pure except for the
+    appends to ``path``."""
+
+    def __init__(self, path, *, window_s: float = 0.5):
+        self.path = path
+        self.window_s = float(window_s)
+        self._last_emit_s: float | None = None
+        self._run = 0
+        self.lines_written = 0
+        # one invocation = one stream: a re-run appending to last
+        # time's file would interleave stale lines into the feed
+        open(self.path, "w").close()
+
+    def reset_run(self) -> None:
+        """New engine run: the engine clock restarts at 0 (``t_s`` in
+        the stream is run-relative), so the window stamps must too — a
+        stale prior-round stamp would silence the whole next round
+        (``now - last`` negative) and compare finish times across
+        incomparable clocks.  Bumps the ``run`` stamp so a consumer
+        can attribute each line despite the restarting clock.  Wired
+        from ``Engine._reset_state``."""
+        self._last_emit_s = None
+        self._run += 1
+
+    @staticmethod
+    def snapshot_line(*, t_s: float, window_s: float,
+                      window_completed: list[Completed],
+                      queue_depth: int, active_slots: int,
+                      kv_occupancy: float,
+                      engine_steps: int, run: int = 0) -> dict:
+        """One snapshot's dict (pure — the schema-lock test calls this
+        directly).  Latency percentiles cover the WINDOW's completions
+        only: a live stream must show the current state, not the
+        run-to-date mixture.  ``run`` counts engine runs on this
+        stream: ``t_s`` is run-relative (every Engine.run restarts the
+        clock at 0), so (run, t_s) — not t_s alone — orders the feed."""
+        ttft = [c.ttft_ms for c in window_completed]
+        tpot = [c.tpot_ms for c in window_completed]
+        return {
+            "run": int(run),
+            "t_s": round(t_s, 3),
+            "window_s": window_s,
+            "completed": len(window_completed),
+            "ttft_ms": latency_summary(ttft),
+            "tpot_ms": latency_summary(tpot),
+            "queue_depth": int(queue_depth),
+            "active_slots": int(active_slots),
+            "kv_occupancy": round(float(kv_occupancy), 4),
+            "engine_steps": int(engine_steps),
+        }
+
+    def maybe_emit(self, engine, now_s: float) -> dict | None:
+        """Called by the engine once per step; writes (and returns) a
+        snapshot when a full window elapsed since the last one."""
+        if self._last_emit_s is not None \
+                and now_s - self._last_emit_s < self.window_s:
+            return None
+        t0 = (self._last_emit_s if self._last_emit_s is not None
+              else max(0.0, now_s - self.window_s))
+        self._last_emit_s = now_s
+        line = self.snapshot_line(
+            t_s=now_s, window_s=self.window_s,
+            window_completed=[c for c in engine.completed
+                              if c.finish_s >= t0],
+            queue_depth=len(engine.pending),
+            active_slots=sum(1 for s in engine.slots if s is not None),
+            kv_occupancy=engine.cache.stats()["occupancy"],
+            engine_steps=engine.engine_steps, run=self._run)
+        import json
+        with open(self.path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+        self.lines_written += 1
+        return line
+
+
 def serving_block(completed: list[Completed], plan: ArrivalPlan, *,
                   slo_ttft_ms: float, slo_tpot_ms: float,
                   wall_s: float, engine_steps: int,

@@ -45,7 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dlnetbench_tpu.core import executor
-from dlnetbench_tpu.metrics import spans
+from dlnetbench_tpu.metrics import spans, telemetry
 from dlnetbench_tpu.models.transformer import (TransformerConfig,
                                                init_params)
 from dlnetbench_tpu.serving import decode as D
@@ -307,6 +307,11 @@ class Engine:
                           if k != "compile_ms"},
             "prefill_chunk": {k: v for k, v in self._prefill.stats.items()
                               if k != "compile_ms"}}
+        # live windowed metrics stream (serving/metrics.LiveMetricsWriter
+        # or None) — attached by bench --live-metrics / run_serving;
+        # survives _reset_state so a warm round and the measured run
+        # share one stream
+        self.live = None
         self._reset_state()
 
     # ---- construction helpers ----------------------------------------
@@ -451,6 +456,19 @@ class Engine:
         self._accepted = 0
         self._step_ewma_s = 0.0
         self._n_scalars: dict[int, jax.Array] = {}
+        # flight recorder (ISSUE 14): refreshed per run; None (the
+        # default) keeps the engine step bit-identical and
+        # allocation-free — the telemetry branch is never entered
+        self._tele = telemetry.current()
+        if self._tele is not None:
+            # new run = new step-time baseline: without this, the first
+            # steps of a structurally different run (a fused-N engine
+            # after a 1-step engine in a bench A/B) would band-escape
+            # the PREVIOUS run's walls and fire a bogus step_time
+            # anomaly on a clean benchmark
+            self._tele.reset_walls("serving")
+        if self.live is not None:
+            self.live.reset_run()  # the engine clock restarts at 0
 
     # ---- the loop ----------------------------------------------------
     def run(self, requests: list[Request], *, injector=None,
@@ -676,10 +694,72 @@ class Engine:
         the compiled-call wall: the marshalling/bookkeeping/dispatch
         overhead the fused loop exists to amortize (ISSUE 11
         satellite — the A/B's measured before-number)."""
+        tele = self._tele
+        if tele is None and self.live is None:
+            # the zero-overhead path: no clock read, no dict built,
+            # no branch into the sampling below (ISSUE 14 disabled
+            # contract — locked by tests/test_telemetry.py)
+            if self._loop_mode:
+                self._step_fused()
+            else:
+                self._step_single()
+            return
+        t0 = time.perf_counter()
+        sync0 = (self.dstate.sync_total_us() if self.dstate is not None
+                 else 0.0)
         if self._loop_mode:
             self._step_fused()
         else:
             self._step_single()
+        self._sample_step((time.perf_counter() - t0) * 1e6, sync0)
+
+    def _sample_step(self, wall_us: float, sync0: float) -> None:
+        """One flight-ring sample per engine step (ISSUE 14): the
+        serving tier's per-step TIME SERIES — queue depth, admitted
+        concurrency, KV occupancy/fragmentation, prefix hit rate, spec
+        acceptance, decode sync-crossing cost — plus the band-aware
+        step-time detector feed and the rolling-window SLO breach
+        check (``serving/metrics.rolling_slo_breach``, the
+        goodput_timeline windowing applied live)."""
+        tele = self._tele
+        now = self._now()
+        step = self.engine_steps
+        if tele is not None:
+            cs = self.cache.stats()
+            fields = {
+                "phase": "engine_step",
+                "step_wall_us": round(wall_us, 1),
+                "queue_depth": len(self.pending),
+                "active_slots": sum(1 for s in self.slots
+                                    if s is not None),
+                "kv_occupancy": cs["occupancy"],
+                "kv_fragmentation": cs["fragmentation"],
+            }
+            prefix = cs.get("prefix")
+            if prefix:
+                fields["prefix_hit_rate"] = prefix["hit_rate"]
+            if self.cfg.speculative and self._drafted:
+                fields["spec_acceptance"] = round(
+                    self._accepted / self._drafted, 4)
+            if self.dstate is not None:
+                fields["sync_us"] = round(
+                    self.dstate.sync_total_us() - sync0, 1)
+            tele.record("serving", step=step, **fields)
+            tele.observe_step_wall("serving", wall_us, step=step)
+            # bounded tail: completions append in finish order, so the
+            # trailing window is a suffix — scanning the whole list
+            # every step would put an O(completed) cost inside the very
+            # step wall being measured
+            breach = M.rolling_slo_breach(
+                self.completed[-64:], slo_ttft_ms=self.cfg.slo_ttft_ms,
+                slo_tpot_ms=self.cfg.slo_tpot_ms, now_s=now)
+            if breach is not None:
+                tele.trigger("slo", step=step, detail={
+                    **breach,
+                    "slo": {"ttft_ms": self.cfg.slo_ttft_ms,
+                            "tpot_ms": self.cfg.slo_tpot_ms}})
+        if self.live is not None:
+            self.live.maybe_emit(self, now)
 
     def _step_preamble(self) -> tuple[list[int], float]:
         """The per-step work BOTH decode paths share (one definition —
@@ -942,7 +1022,7 @@ class Engine:
 
 def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
                 plan: ArrivalPlan, *, fault_plan=None, params=None,
-                devices=None):
+                devices=None, live_metrics=None):
     """One measured serving run -> ``ProxyResult`` (-> ``metrics.emit``).
 
     Clean runs drive one engine.  With ``fault_plan``: delay/jitter
@@ -955,6 +1035,12 @@ def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
     ``degraded_world``/``fault_*`` so the analysis layer reads serving
     faults exactly like training faults."""
     engine = Engine(model_cfg, cfg, params=params, devices=devices)
+    if live_metrics is not None:
+        # path or writer: the windowed live JSONL stream (ISSUE 14
+        # satellite; serving/metrics.LiveMetricsWriter)
+        engine.live = (live_metrics if hasattr(live_metrics,
+                                               "maybe_emit")
+                       else M.LiveMetricsWriter(live_metrics))
     requests = plan.sample()
     if cfg.warmup_requests > 0:
         # warm-in: saturating synthetic mini-workload, discarded — the
@@ -990,6 +1076,13 @@ def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
         # rebuild (recompile priced), finish degraded.
         detection_ms = (time.monotonic()
                         - injector.crash_raised_at) * 1e3
+        # anomaly engine (ISSUE 14): a detected fault is a trigger —
+        # the flight ring into the crash dumps as flight_fault.json
+        telemetry.trigger("fault", step=engine.engine_steps, detail={
+            "kind": type(e).__name__,
+            "rank": getattr(e, "rank", None),
+            "iteration": getattr(e, "iteration", None),
+            "detection_ms": round(detection_ms, 3)})
         survivors = [r for r in range(cfg.world)
                      if r not in fault_plan.crash_victims(cfg.world)
                      and r not in fault_plan.preempt_victims()]
@@ -1009,6 +1102,7 @@ def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
             engine2 = Engine(model_cfg, shrunk, params=params,
                              devices=[engine.devices[r]
                                       for r in survivors])
+        engine2.live = engine.live  # the stream outlives the shrink
         recovery_ms = (time.monotonic() - t0) * 1e3
         done1, wall = engine2.run(leftovers, injector=injector,
                                   t_origin=t_origin)

@@ -50,6 +50,12 @@ class StepWatchdog:
         # inside the harness the measuring thread was sitting — the
         # postmortem breadcrumb stamped into the record
         self.last_stall_spans: list[str] = []
+        # the last-K flight-recorder samples at stall time (ISSUE 14,
+        # metrics/telemetry.py; [] when telemetry is off): the span
+        # stack says where the run froze, these say how it TRENDED into
+        # the stall — queue building? step times climbing? KV full?
+        self.last_stall_telemetry: list[dict] = []
+        self.stall_telemetry_k = 8
         # last COMPLETED checkpoint (utils/checkpoint.py
         # SnapshotCheckpointer calls checkpoint_saved): a hang report
         # should say how much work a kill would lose, so the stall
@@ -74,6 +80,16 @@ class StepWatchdog:
         if self.last_stall_spans:
             stack = ("; active spans: "
                      + " | ".join(self.last_stall_spans))
+        trend = ""
+        if self.last_stall_telemetry:
+            walls = [s.get("step_wall_us") for s in
+                     self.last_stall_telemetry
+                     if isinstance(s.get("step_wall_us"), (int, float))]
+            trend = (f"; telemetry trend: last "
+                     f"{len(self.last_stall_telemetry)} ring samples")
+            if walls:
+                trend += (" (step walls us: "
+                          + ", ".join(f"{w:.0f}" for w in walls) + ")")
         ckpt = ""
         age = self.last_checkpoint_age_s()
         if age is not None:
@@ -82,7 +98,7 @@ class StepWatchdog:
                     f"loses the work since")
         print(f"[watchdog] section {name!r} exceeded its {self.deadline_s:.1f}s "
               f"deadline ({elapsed_s:.1f}s elapsed) — likely a hung "
-              f"collective or device stall{where}{stack}{ckpt}",
+              f"collective or device stall{where}{stack}{trend}{ckpt}",
               file=sys.stderr, flush=True)
 
     # ---- checkpoint age: what would a kill lose? ---------------------
@@ -126,6 +142,12 @@ class StepWatchdog:
         meta["watchdog_stalls"] = self.stalls
         if self.last_stall_spans:
             meta["watchdog_stall_spans"] = list(self.last_stall_spans)
+        if self.last_stall_telemetry:
+            # the trend into the stall (ISSUE 14): the flight ring's
+            # last-K samples at fire time — a hang report shows the
+            # climb, not just the frozen instant
+            meta["watchdog_stall_telemetry"] = list(
+                self.last_stall_telemetry)
         age = self.last_checkpoint_age_s()
         if age is not None:
             # how much work a kill at emission time would lose: the age
@@ -135,6 +157,7 @@ class StepWatchdog:
         return meta
 
     def _fire(self, armed_at: float) -> None:
+        elapsed = time.monotonic() - armed_at
         with self._stall_lock:  # Timer threads may fire concurrently
             self.stalls += 1
             # capture where every thread's open spans sit RIGHT NOW —
@@ -144,7 +167,24 @@ class StepWatchdog:
             self.last_stall_spans = [
                 " > ".join(stack)
                 for _, stack in sorted(spans.active_stacks().items())]
-        self._on_stall(self.name, time.monotonic() - armed_at)
+            # ... and the trend INTO the stall: the flight recorder's
+            # last-K per-step samples ([] when telemetry is off).  The
+            # stall itself is an anomaly — the ring window dumps as
+            # flight_stall.json alongside
+            from dlnetbench_tpu.metrics import telemetry
+            rec = telemetry.current()
+            if rec is not None:
+                self.last_stall_telemetry = rec.last(
+                    self.stall_telemetry_k)
+                rec.trigger("stall", detail={
+                    "section": self.name,
+                    "deadline_s": self.deadline_s,
+                    "elapsed_s": round(elapsed, 3),
+                    "heartbeat_age_s": {
+                        k: round(v, 3)
+                        for k, v in self.heartbeat_ages().items()},
+                    "spans": list(self.last_stall_spans)})
+        self._on_stall(self.name, elapsed)
 
     def __enter__(self) -> "StepWatchdog":
         armed_at = time.monotonic()

@@ -130,14 +130,22 @@ inline RankRun run_measured(
   auto& meter = energy::Meter::instance();
   bool record_energy =
       meter.available() && meter.recording_rank.load() == sync_comm.rank();
+  auto& ring = TelemetryRing::instance();
   for (int r = 0; r < out.runs; ++r) {
     double e0 = record_energy ? meter.read_joules() : 0.0;
     auto t0 = Clock::now();
     step(timers);
-    timers.record("runtimes", us_since(t0));
+    double wall_us = us_since(t0);
+    timers.record("runtimes", wall_us);
     if (record_energy)
       timers.record("energy_consumed",
                     std::max(0.0, meter.read_joules() - e0));
+    // continuous telemetry (ISSUE 14): per-step flight ring, step
+    // index in fault-plan units (warmup included) — the per-rank step
+    // series analysis/critical_path.py merges into blame
+    if (ring.enabled())
+      ring.record(sync_comm.rank(), std::max(cfg.warmup, 1) + r,
+                  wall_us);
   }
   if (record_energy) meter.relax();
   return out;

@@ -15,7 +15,9 @@
 #include <algorithm>
 #include <chrono>
 #include <cstdint>
+#include <cstdlib>
 #include <map>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -96,6 +98,91 @@ class TimerSet {
 
  private:
   std::map<std::string, std::vector<double>> vals_;
+};
+
+// Continuous telemetry (ISSUE 14) — the native twin of the Python
+// tier's metrics/telemetry.py FlightRecorder: a fixed-capacity ring of
+// per-step samples {rank, step, t_s, step_wall_us}, fed by the
+// measured loop (harness.hpp run_measured) when DLNB_TELEMETRY is set
+// and emitted as the record's "telemetry" global (a per-process
+// measurement: metrics/merge.py treats the block as volatile, and
+// analysis/critical_path.matrix_from_flights merges the per-rank
+// samples into the blame engine's step matrix).  Off by default: the
+// disabled path is one atomic-free bool test per step.
+class TelemetryRing {
+ public:
+  static TelemetryRing& instance() {
+    static TelemetryRing ring;
+    return ring;
+  }
+
+  bool enabled() const { return enabled_; }
+
+  void record(int rank, int step, double wall_us) {
+    if (!enabled_) return;
+    std::lock_guard<std::mutex> lock(mu_);
+    Sample s;
+    s.rank = rank;
+    s.step = step;
+    s.t_s = std::chrono::duration<double>(Clock::now() - origin_).count();
+    s.wall_us = wall_us;
+    buf_[recorded_ % buf_.size()] = s;
+    ++recorded_;
+  }
+
+  // The record's "telemetry" global, schema-matched to the Python
+  // tier's FlightRecorder.telemetry_block (plus full resident samples
+  // — the native tier has no separate flight-dump channel, the record
+  // IS the artifact).
+  Json to_json() const {
+    std::lock_guard<std::mutex> lock(mu_);
+    Json block = Json::object();
+    block["capacity"] = static_cast<std::int64_t>(buf_.size());
+    block["recorded"] = static_cast<std::int64_t>(recorded_);
+    block["dropped"] = static_cast<std::int64_t>(
+        recorded_ > buf_.size() ? recorded_ - buf_.size() : 0);
+    Json arr = Json::array();
+    const std::size_t n = std::min(recorded_, buf_.size());
+    const std::size_t head = recorded_ > buf_.size()
+                                 ? recorded_ % buf_.size()
+                                 : 0;
+    for (std::size_t i = 0; i < n; ++i) {
+      const Sample& s = buf_[(head + i) % buf_.size()];
+      Json j = Json::object();
+      j["rank"] = s.rank;
+      j["step"] = s.step;
+      j["t_s"] = s.t_s;
+      j["step_wall_us"] = s.wall_us;
+      arr.push_back(j);
+    }
+    block["samples"] = arr;
+    return block;
+  }
+
+ private:
+  TelemetryRing() : origin_(Clock::now()) {
+    const char* on = std::getenv("DLNB_TELEMETRY");
+    enabled_ = on && *on && std::string(on) != "0";
+    std::size_t cap = 512;
+    if (const char* c = std::getenv("DLNB_TELEMETRY_CAPACITY"); c && *c) {
+      long v = std::atol(c);
+      if (v > 0) cap = static_cast<std::size_t>(v);
+    }
+    buf_.resize(cap);
+  }
+
+  struct Sample {
+    int rank = 0;
+    int step = 0;
+    double t_s = 0;
+    double wall_us = 0;
+  };
+
+  bool enabled_ = false;
+  Clock::time_point origin_;
+  std::vector<Sample> buf_;
+  std::size_t recorded_ = 0;
+  mutable std::mutex mu_;
 };
 
 // One per-rank output row: identity + this rank's timers.

@@ -475,6 +475,11 @@ inline int run_proxy_main(const std::string& section, const ProxyEnv& env,
   }
   Json mesh = Json::object();
   fab.describe(meta, mesh);  // backend/platform identity + cache stats
+  // continuous telemetry (ISSUE 14): the per-step flight ring as a
+  // record section — schema-matched to the Python tier's telemetry
+  // block (volatile at merge; each process emits its own ring)
+  if (TelemetryRing::instance().enabled())
+    meta["telemetry"] = TelemetryRing::instance().to_json();
 
   int rep_rank = local.at(0);  // the rank whose harness counters we hold
   Json rec = make_record(section, meta, mesh, runs[rep_rank].runs,

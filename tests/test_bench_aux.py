@@ -259,6 +259,61 @@ def test_serving_decode_line_schema_locked():
     assert is_ms_line(line)
 
 
+def test_live_metrics_line_schema_locked(tmp_path):
+    """ISSUE 14 satellite: the --live-metrics JSONL stream's snapshot
+    line — one per window, rolling TTFT/TPOT percentiles over the
+    WINDOW's completions, queue depth, admitted slots, KV occupancy —
+    is a machine-read dashboard feed; lock its schema."""
+    import json
+
+    from dlnetbench_tpu.serving.metrics import (Completed,
+                                                LiveMetricsWriter)
+
+    done = [Completed(rid=i, arrival_s=0.1 * i, admitted_s=0.1 * i,
+                      first_token_s=0.1 * i + 0.02,
+                      finish_s=0.1 * i + 0.08, prompt_len=8,
+                      output_len=4) for i in range(5)]
+    line = LiveMetricsWriter.snapshot_line(
+        t_s=0.5, window_s=0.5, window_completed=done, queue_depth=3,
+        active_slots=2, kv_occupancy=0.625, engine_steps=40, run=1)
+    assert set(line) == {"run", "t_s", "window_s", "completed",
+                         "ttft_ms", "tpot_ms", "queue_depth",
+                         "active_slots", "kv_occupancy",
+                         "engine_steps"}
+    assert line["run"] == 1  # (run, t_s) orders the feed — t_s is
+    #                          run-relative and restarts per engine run
+    assert line["completed"] == 5 and line["queue_depth"] == 3
+    assert line["kv_occupancy"] == 0.625
+    for base in ("ttft_ms", "tpot_ms"):
+        for k in ("p50", "p95", "p99", "mean", "n"):
+            assert k in line[base], (base, k)
+    assert line["ttft_ms"]["p50"] == 20.0  # 0.02 s to first token
+    # the writer emits at window boundaries, JSONL-append, and the
+    # bench flag reaches the serving aux line
+    path = tmp_path / "live.jsonl"
+    w = LiveMetricsWriter(path, window_s=0.5)
+
+    class _Eng:
+        completed = done
+        pending = [1, 2, 3]
+        slots = [object(), object(), None]
+        engine_steps = 40
+
+        class cache:
+            @staticmethod
+            def stats():
+                return {"occupancy": 0.625}
+
+    assert w.maybe_emit(_Eng(), 0.5) is not None
+    assert w.maybe_emit(_Eng(), 0.6) is None   # inside the window
+    assert w.maybe_emit(_Eng(), 1.1) is not None
+    got = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert len(got) == 2 and got[0]["active_slots"] == 2
+    import bench
+    args = bench._parse_args(["--live-metrics", str(path)])
+    assert args.live_metrics == str(path)
+
+
 def _ab_round(e2e_p99, tokens_per_s, *, n=1, spd=1.0, dev_us=50000.0,
               steps=50, disp=50, host_us=500.0, spec=None):
     """A synthetic per-round serving block with a decode_loop section

@@ -1,0 +1,467 @@
+"""Continuous telemetry (metrics/telemetry.py): the per-step flight
+recorder, the anomaly engine, and the ISSUE 14 contracts.
+
+Locks the tentpole properties: the ring is fixed-capacity and ordered,
+the disabled path allocates nothing per step and leaves records
+byte-identical to a pre-telemetry build (committed fixture
+``record_no_telemetry.jsonl`` — generated from the pre-PR emitter and
+verified byte-equal at generation time), the band-aware step-time
+detector fires exactly once per shift, anomaly dumps land as
+``flight_<trigger>.json`` with the ring window INTO the trigger, the
+serving SLO-breach e2e produces a ``flight_slo.json`` whose window
+covers the breach and an ``anomalies`` block that survives
+parser -> merge, and the committed two-process fixture
+``record_telemetry.jsonl`` round-trips parser -> merge -> bandwidth
+with anomalies pooled across processes.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from dlnetbench_tpu.metrics import telemetry
+
+pytestmark = pytest.mark.telemetry
+
+DATA = Path(__file__).parent / "data"
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    """Never leak an enabled recorder into (or out of) a test."""
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+# ------------------------------------------------------------- the ring
+def test_ring_is_fixed_capacity_and_ordered():
+    rec = telemetry.FlightRecorder(capacity=4)
+    for i in range(7):
+        rec.record("proxy", step=i, step_wall_us=float(i))
+    assert rec.recorded == 7 and rec.dropped == 3
+    samples = rec.samples()
+    assert [s["step"] for s in samples] == [3, 4, 5, 6]
+    assert [s["step"] for s in rec.last(2)] == [5, 6]
+    # t_s is monotone within the ring (the aligned-window invariant
+    # flight dumps rely on)
+    ts = [s["t_s"] for s in samples]
+    assert ts == sorted(ts)
+
+
+def test_window_selects_by_time():
+    rec = telemetry.FlightRecorder(capacity=8)
+    for i in range(4):
+        rec.record("proxy", step=i)
+    t_mid = rec.samples()[1]["t_s"]
+    win = rec.window(t_lo=t_mid)
+    assert [s["step"] for s in win] == [1, 2, 3]
+
+
+def test_enable_disable_lifecycle(tmp_path):
+    assert not telemetry.is_enabled()
+    rec = telemetry.enable(capacity=16, dump_dir=tmp_path)
+    assert telemetry.is_enabled() and telemetry.current() is rec
+    telemetry.record_step("proxy", step=0, step_wall_us=1.0)
+    got = telemetry.disable()
+    assert got is rec and not telemetry.is_enabled()
+    assert got.recorded == 1
+
+
+def test_enable_from_env(monkeypatch, tmp_path):
+    monkeypatch.delenv("DLNB_TELEMETRY", raising=False)
+    assert telemetry.enable_from_env() is None
+    monkeypatch.setenv("DLNB_TELEMETRY", "1")
+    monkeypatch.setenv("DLNB_TELEMETRY_CAPACITY", "32")
+    monkeypatch.setenv("DLNB_FLIGHT_DIR", str(tmp_path / "fl"))
+    rec = telemetry.enable_from_env()
+    assert rec is not None and rec.capacity == 32
+    assert rec.dump_dir == tmp_path / "fl"
+    # an active recorder wins — no silent replacement
+    assert telemetry.enable_from_env() is rec
+
+
+# --------------------------------------------- the disabled-path contract
+def test_disabled_path_allocates_nothing_per_step():
+    """The zero-overhead contract (the spans.py pattern): every hot
+    site gates on ``is_enabled()`` BEFORE assembling kwargs, so the
+    disabled per-step cost is one global load + one branch — zero
+    allocations."""
+    import tracemalloc
+
+    assert not telemetry.is_enabled()
+    gated = 0
+
+    def loop(n: int) -> None:
+        nonlocal gated
+        for _ in range(n):
+            if telemetry.is_enabled():
+                telemetry.record_step("proxy", step=0,
+                                      step_wall_us=1.0)
+                gated += 1
+            telemetry.record_step("also-free-when-disabled")
+
+    loop(10)  # warm interpreter caches (specialization, frame reuse)
+    tracemalloc.start()
+    try:
+        s0 = tracemalloc.take_snapshot()
+        loop(1000)
+        s1 = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    assert gated == 0
+    # judged per-file (other threads may allocate elsewhere
+    # concurrently) and by SCALE: a real per-step allocation over 1000
+    # iterations is tens of KB; one-time interpreter artifacts
+    # (bytecode specialization buffers attributed to lineno 0) are a
+    # few dozen bytes and do not grow with the step count
+    mod = telemetry.__file__
+    grew = sum(st.size_diff for st in s1.compare_to(s0, "filename")
+               if st.traceback[0].filename == mod and st.size_diff > 0)
+    assert grew < 512, f"{grew} bytes allocated over 1000 disabled steps"
+
+
+def test_disabled_record_bytes_match_pre_telemetry_fixture(monkeypatch):
+    """Telemetry off => the emitted record is byte-identical to the
+    pre-PR emitter's output for the same ProxyResult.  The fixture was
+    generated from the pre-telemetry ``metrics/emit.py`` (verified
+    byte-equal against this build's disabled path at generation time);
+    this test locks the disabled path against it forever."""
+    import socket
+
+    from dlnetbench_tpu.metrics.emit import result_to_record
+    from dlnetbench_tpu.proxies.base import ProxyResult
+
+    monkeypatch.setattr(socket, "gethostname", lambda: "fixedhost")
+    monkeypatch.delenv("DLNB_TUNING_DB_DIR", raising=False)
+    assert not telemetry.is_enabled()
+    result = ProxyResult(
+        name="dp",
+        global_meta={"proxy": "dp", "model": "gpt2_l_16_bfloat16",
+                     "world_size": 2, "num_buckets": 2,
+                     "bucket_bytes": [1000, 1000],
+                     "mesh": {"platform": "cpu", "device_kind": "host",
+                              "num_hosts": 1,
+                              "devices": [{"id": 0, "process": 0},
+                                          {"id": 1, "process": 0}]}},
+        timers_us={"runtimes": [100.0, 110.0, 105.0],
+                   "barrier_time": [20.0, 25.0, 22.0]},
+        warmup_times_us=[950.0],
+        num_runs=3)
+    got = json.dumps(result_to_record(result)) + "\n"
+    want = (DATA / "record_no_telemetry.jsonl").read_text()
+    assert got == want
+    assert '"telemetry"' not in got and '"anomalies"' not in got
+
+
+def test_serving_engine_disabled_path_never_samples(tiny_engine):
+    """With telemetry off the engine's step takes the zero-overhead
+    branch: no recorder reference, no sample, nothing stamped."""
+    engine, requests = tiny_engine
+    engine.run(requests)
+    assert engine._tele is None
+    meta = engine.global_meta(_tiny_plan())
+    assert "telemetry" not in meta and "anomalies" not in meta
+
+
+# --------------------------------------- band-aware step-time detection
+def test_step_time_detector_fires_once_per_shift():
+    rec = telemetry.FlightRecorder(capacity=64)
+    for i in range(telemetry.BASELINE_MIN + 2):
+        rec.observe_step_wall("proxy", 100.0 + (i % 3), step=i)
+    assert rec.anomalies == []  # stable baseline: no trigger
+    for i in range(telemetry.RECENT_K):
+        rec.observe_step_wall("proxy", 400.0 + i, step=20 + i)
+    assert [a["trigger"] for a in rec.anomalies] == ["step_time"]
+    detail = rec.anomalies[0]["detail"]
+    assert detail["ratio"] > 1.5
+    # re-baselined: the sustained shift does not re-fire every step
+    for i in range(telemetry.RECENT_K):
+        rec.observe_step_wall("proxy", 400.0, step=30 + i)
+    assert len(rec.anomalies) == 1
+
+
+def test_reset_walls_rebaselines_across_runs():
+    """A structurally new run over a live recorder (next engine in a
+    bench A/B, next in-process sweep config) must not band-escape the
+    PREVIOUS run's walls: reset_walls drops the history, so the new
+    steady state is its own baseline, not an anomaly."""
+    rec = telemetry.FlightRecorder(capacity=64)
+    for i in range(telemetry.BASELINE_MIN + telemetry.RECENT_K):
+        rec.observe_step_wall("serving", 100.0 + (i % 3), step=i)
+    rec.reset_walls("serving")
+    # 16x slower — a fused-N engine's honest per-dispatch wall
+    for i in range(telemetry.RECENT_K + 2):
+        rec.observe_step_wall("serving", 1600.0 + i, step=i)
+    assert rec.anomalies == []
+
+
+def test_step_time_detector_ignores_band_overlapping_noise():
+    rec = telemetry.FlightRecorder(capacity=64)
+    vals = [100.0, 130.0, 90.0, 120.0, 105.0, 95.0, 125.0, 110.0] * 4
+    for i, v in enumerate(vals):
+        rec.observe_step_wall("proxy", v, step=i)
+    assert rec.anomalies == []
+
+
+# ------------------------------------------------------- anomaly engine
+def test_trigger_dumps_ring_window(tmp_path):
+    rec = telemetry.FlightRecorder(capacity=16, dump_dir=tmp_path)
+    for i in range(5):
+        rec.record("proxy", step=i, step_wall_us=100.0 + i)
+    ev = rec.trigger("fault", step=4, detail={"rank": 2})
+    assert ev["dump"] == str(tmp_path / "flight_fault.json")
+    dump = json.loads((tmp_path / "flight_fault.json").read_text())
+    assert dump["trigger"] == "fault" and dump["step"] == 4
+    assert [s["step"] for s in dump["samples"]] == [0, 1, 2, 3, 4]
+    # the window is aligned INTO the trigger: nothing after it
+    assert all(s["t_s"] <= dump["t_s"] for s in dump["samples"])
+    block = rec.anomalies_block()
+    assert block["count"] == 1 and block["triggers"] == {"fault": 1}
+
+
+def test_trigger_cooldown_and_dump_cap(tmp_path):
+    rec = telemetry.FlightRecorder(capacity=8, dump_dir=tmp_path,
+                                   cooldown_s=0.0,
+                                   max_dumps_per_trigger=2)
+    assert rec.trigger("slo") is not None
+    assert rec.trigger("slo") is not None
+    assert rec.trigger("slo")["t_s"] >= 0  # recorded...
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert names == ["flight_slo.json", "flight_slo_2.json"]  # ...capped
+    throttled = telemetry.FlightRecorder(capacity=8, cooldown_s=60.0)
+    assert throttled.trigger("slo") is not None
+    assert throttled.trigger("slo") is None  # inside the cooldown
+    assert throttled.anomalies_block()["count"] == 1
+
+
+def test_clean_run_stamps_no_anomalies_block():
+    rec = telemetry.FlightRecorder(capacity=8)
+    rec.record("proxy", step=0)
+    assert rec.anomalies_block() is None
+    block = rec.telemetry_block()
+    assert block["recorded"] == 1 and block["capacity"] == 8
+
+
+# --------------------------------------------- serving e2e (acceptance)
+def _tiny_plan():
+    from dlnetbench_tpu.serving.arrivals import ArrivalPlan
+    return ArrivalPlan(kind="poisson", rate_rps=500.0, num_requests=10,
+                       seed=1, prompt_len=[4, 8], output_len=[3, 5])
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    """One compiled tiny engine shared by the serving telemetry tests
+    (compile once; ``run`` resets all run state)."""
+    import jax
+
+    from dlnetbench_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+    from dlnetbench_tpu.serving.scheduler import Engine, ServingConfig
+
+    mc = TransformerConfig(vocab_size=64, embed_dim=32, num_heads=2,
+                           num_kv_heads=2, ff_dim=64, num_layers=1,
+                           seq_len=32, gated=True, max_positions=0,
+                           dtype="float32")
+    # SLO budgets impossibly tight: every completion breaches, so the
+    # rolling-window detector MUST fire (the anomaly e2e's arrival plan)
+    cfg = ServingConfig(slots=2, page_size=4, num_pages=24,
+                        max_seq_len=16, slo_ttft_ms=0.001,
+                        slo_tpot_ms=0.001, warmup_requests=0)
+    engine = Engine(mc, cfg, params=init_params(jax.random.key(0), mc))
+    return engine, _tiny_plan().sample()
+
+
+@pytest.mark.serving
+def test_slo_breach_e2e_dump_and_record(tiny_engine, tmp_path):
+    """ISSUE 14 acceptance: an SLO-breach plan produces a
+    ``flight_slo.json`` whose window covers the breach, and the
+    record's ``anomalies`` block survives parser -> merge."""
+    from dlnetbench_tpu.metrics.merge import merge_records
+    from dlnetbench_tpu.metrics.parser import validate_record
+    from dlnetbench_tpu.serving import metrics as M
+
+    engine, requests = tiny_engine
+    rec = telemetry.enable(capacity=128, dump_dir=tmp_path)
+    completed, wall = engine.run(requests)
+    assert engine._tele is rec
+    # the per-step serving series landed in the ring
+    serving_samples = [s for s in rec.samples()
+                       if s["source"] == "serving"]
+    assert serving_samples, "engine steps never sampled"
+    for key in ("step_wall_us", "queue_depth", "active_slots",
+                "kv_occupancy", "kv_fragmentation"):
+        assert key in serving_samples[0]
+    # the breach fired and dumped
+    dump = json.loads((tmp_path / "flight_slo.json").read_text())
+    assert dump["trigger"] == "slo"
+    assert dump["detail"]["goodput_frac"] < 0.5
+    window = dump["samples"]
+    assert window and window[0]["t_s"] <= dump["t_s"]
+    assert all(s["t_s"] <= dump["t_s"] for s in window)
+    # ... and covers the breach window: ring samples reach back at
+    # least one detector window before the trigger
+    assert dump["t_s"] - window[0]["t_s"] >= 0.0
+
+    # the record pathway: build -> emit -> validate -> merge
+    meta = engine.global_meta(_tiny_plan())
+    meta["serving"] = M.serving_block(
+        completed, _tiny_plan(), slo_ttft_ms=engine.cfg.slo_ttft_ms,
+        slo_tpot_ms=engine.cfg.slo_tpot_ms, wall_s=wall,
+        engine_steps=engine.engine_steps)
+    result = M.build_result(completed, _tiny_plan(), meta)
+    from dlnetbench_tpu.metrics.emit import result_to_record
+    record = result_to_record(result)
+    assert record["global"]["anomalies"]["triggers"].get("slo", 0) >= 1
+    assert record["global"]["telemetry"]["recorded"] == rec.recorded
+    validate_record(record)
+    merged = merge_records([record])
+    assert merged["global"]["anomalies"]["triggers"].get("slo", 0) >= 1
+
+
+@pytest.mark.serving
+def test_live_metrics_stream_from_engine(tiny_engine, tmp_path):
+    """The --live-metrics channel: an engine with a writer attached
+    streams schema-complete windowed snapshot lines."""
+    from dlnetbench_tpu.serving.metrics import LiveMetricsWriter
+
+    engine, requests = tiny_engine
+    path = tmp_path / "live.jsonl"
+    engine.live = LiveMetricsWriter(path, window_s=0.0)
+    try:
+        engine.run(requests)
+    finally:
+        engine.live = None
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert lines
+    for ln in lines:
+        assert set(ln) == {"run", "t_s", "window_s", "completed",
+                           "ttft_ms", "tpot_ms", "queue_depth",
+                           "active_slots", "kv_occupancy",
+                           "engine_steps"}
+    assert any(ln["completed"] >= 1 for ln in lines)
+
+
+# ------------------------------------------- proxy-tier integration
+def test_run_proxy_feeds_ring_with_energy(tmp_path):
+    """run_proxy samples one ring entry per fenced chain — step wall,
+    the matched compute leg, and (the energy satellite) the per-chain
+    joules where a sampler exists."""
+    from dlnetbench_tpu.proxies.base import (ProxyConfig, StepBundle,
+                                             run_proxy)
+
+    class FakeSampler:
+        source = "fake"
+        _j = 0.0
+
+        def read_joules(self):
+            self._j += 0.25
+            return self._j
+
+    import jax.numpy as jnp
+
+    telemetry.enable(capacity=64, dump_dir=tmp_path)
+    x = jnp.ones((8,), jnp.float32)
+    bundle = StepBundle(full=lambda: x * 2.0,
+                        compute=lambda: x + 1.0,
+                        comm=None, global_meta={"model": "t"})
+    cfg = ProxyConfig(warmup=2, runs=4, measure_comm_only=False,
+                      measure_energy=True)
+    run_proxy("dp", bundle, cfg, energy_sampler=FakeSampler())
+    rec = telemetry.current()
+    timed = [s for s in rec.samples() if s.get("phase") == "timed"]
+    warm = [s for s in rec.samples() if s.get("phase") == "warmup"]
+    assert len(timed) == 4 and len(warm) == 2
+    assert all("energy_j" in s and s["energy_j"] > 0 for s in timed)
+    # step indices in fault-plan units: warmup included
+    assert [s["step"] for s in timed] == [2, 3, 4, 5]
+
+
+# ------------------------------------ fixture round trip (parser/merge)
+def test_committed_fixture_roundtrips_parser_merge_bandwidth():
+    """tests/data/record_telemetry.jsonl: two per-process records of
+    one faulted 2-rank run, telemetry blocks + a step_time anomaly on
+    process 1.  Parser validates both, merge pools the anomalies
+    (volatile telemetry: process 0's ring survives), the DataFrame
+    hoists anomaly_count, and the bandwidth table carries the blame
+    columns pointing at the straggler."""
+    from dlnetbench_tpu.analysis.bandwidth import (bandwidth_summary,
+                                                   effective_bandwidth)
+    from dlnetbench_tpu.metrics.merge import merge_records
+    from dlnetbench_tpu.metrics.parser import (load_records,
+                                               records_to_dataframe,
+                                               validate_record)
+
+    records = load_records(DATA / "record_telemetry.jsonl")
+    assert len(records) == 2
+    for rec in records:
+        validate_record(rec)
+        assert rec["global"]["telemetry"]["capacity"] == 64
+    merged = merge_records([json.loads(json.dumps(r))
+                            for r in records])
+    assert merged["global"]["telemetry"] == \
+        records[0]["global"]["telemetry"]
+    anom = merged["global"]["anomalies"]
+    assert anom["count"] == 1 and anom["triggers"] == {"step_time": 1}
+    assert anom["events"][0]["process"] == 1
+    df = records_to_dataframe([merged])
+    assert set(df["anomaly_count"]) == {1}
+    bw = effective_bandwidth([merged])
+    assert set(bw["blame_rank"]) == {"1"}
+    assert (bw["blame_frac"] >= 0.8).all()
+    summary = bandwidth_summary([merged])
+    assert "blame_rank" in summary.columns
+    assert "blame_frac" in summary.columns
+
+
+def test_no_telemetry_records_still_parse_and_mixed_merge_refused():
+    """v1 and pre-telemetry v2 records parse unchanged, and the
+    existing v1-with-v2 merge refusal still holds with telemetry
+    records in the mix."""
+    from dlnetbench_tpu.metrics.merge import merge_records
+    from dlnetbench_tpu.metrics.parser import load_records, \
+        validate_record
+
+    v1 = load_records(DATA / "record_v1.jsonl")[0]
+    validate_record(v1)  # pre-telemetry v1 fixture parses unchanged
+    v2 = load_records(DATA / "record_telemetry.jsonl")[0]
+    # a v1-build sibling of the telemetry record: same run identity,
+    # older schema (no summaries, no telemetry) — merge must refuse
+    sibling = json.loads(json.dumps(v2))
+    sibling["process"] = 1
+    sibling["version"] = 1
+    for row in sibling["ranks"]:
+        row.pop("summary", None)
+    sibling["global"].pop("telemetry", None)
+    with pytest.raises(ValueError, match="different harness builds"):
+        merge_records([v2, sibling])
+
+
+# ------------------------------------------------ Perfetto export
+def test_telemetry_counter_events_render_ring_and_anomalies():
+    from dlnetbench_tpu.metrics import spans
+
+    rec = telemetry.FlightRecorder(capacity=8)
+    rec.record("serving", step=0, step_wall_us=100.0, queue_depth=3)
+    rec.record("serving", step=1, step_wall_us=120.0, queue_depth=5)
+    rec.trigger("slo", step=1)
+    events = spans.telemetry_counter_events(
+        rec.telemetry_block(last=8), rec.anomalies_block())
+    counters = [e for e in events if e.get("ph") == "C"]
+    names = {e["name"] for e in counters}
+    assert names == {"step_wall_us", "queue_depth"}
+    instants = [e for e in events if e.get("ph") == "i"]
+    assert len(instants) == 1 and instants[0]["name"] == "anomaly: slo"
+    # and the record-derived pathway picks them up
+    record = {"section": "dp", "ranks": [],
+              "global": {"telemetry": rec.telemetry_block(last=8),
+                         "anomalies": rec.anomalies_block()}}
+    tracked = spans.record_track_events(record)
+    assert any(e.get("ph") == "C" for e in tracked)
+    assert any(e.get("ph") == "i" and "anomaly" in e.get("name", "")
+               for e in tracked)

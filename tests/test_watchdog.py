@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import time
 
+import pytest
+
 from dlnetbench_tpu.utils.watchdog import StepWatchdog
 
 
@@ -116,6 +118,60 @@ def test_stall_dumps_active_span_stack(capsys):
     meta = {}
     wd.stamp(meta)
     assert meta["watchdog_stall_spans"] == ["timed > fence"]
+
+
+@pytest.mark.telemetry
+def test_stall_dumps_telemetry_ring_trend(capsys, tmp_path):
+    """ISSUE 14 satellite: a stall report carries the flight ring's
+    last-K samples — the TREND into the stall, not just the frozen
+    instant — in the message, the record stamp, and a flight_stall.json
+    anomaly dump."""
+    import json
+
+    from dlnetbench_tpu.metrics import telemetry
+
+    rec = telemetry.enable(capacity=32, dump_dir=tmp_path)
+    try:
+        for i in range(12):
+            telemetry.record_step("proxy", step=i,
+                                  step_wall_us=100.0 + 10 * i)
+        wd = StepWatchdog(0.05, name="timed")
+        wd.beat("chain_0")
+        with wd:
+            time.sleep(0.12)
+    finally:
+        telemetry.disable()
+    err = capsys.readouterr().err
+    assert wd.stalls == 1
+    assert "telemetry trend" in err and "step walls us" in err
+    assert len(wd.last_stall_telemetry) == wd.stall_telemetry_k
+    assert [s["step"] for s in wd.last_stall_telemetry] == \
+        list(range(4, 12))  # the LAST K, oldest first
+    meta = {}
+    wd.stamp(meta)
+    assert meta["watchdog_stall_telemetry"] == wd.last_stall_telemetry
+    # the stall is an anomaly: ring window dumped alongside
+    dump = json.loads((tmp_path / "flight_stall.json").read_text())
+    assert dump["trigger"] == "stall"
+    assert dump["detail"]["section"] == "timed"
+    assert dump["detail"]["elapsed_s"] >= 0.05
+    assert [s["step"] for s in dump["samples"]] == list(range(12))
+    assert rec.anomalies_block()["triggers"] == {"stall": 1}
+
+
+@pytest.mark.telemetry
+def test_stall_without_telemetry_has_no_trend_noise(capsys):
+    """Telemetry off: the stall message carries no telemetry clause and
+    the record stamp no ring key (the zero-overhead contract's
+    watchdog face)."""
+    wd = StepWatchdog(0.05, name="timed")
+    with wd:
+        time.sleep(0.12)
+    err = capsys.readouterr().err
+    assert wd.stalls == 1 and "telemetry trend" not in err
+    meta = {}
+    wd.stamp(meta)
+    assert "watchdog_stall_telemetry" not in meta
 
 
 def test_stall_message_and_record_carry_checkpoint_age(capsys):
