@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test check-bench check-resilience check-serving check-tuning \
 	check-longcontext check-decode check-density check-telemetry \
-	sentinel-scan
+	check-moe sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -121,6 +121,23 @@ check-telemetry:
 	    tests/test_watchdog.py
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
 	    tests/test_bench_aux.py::test_live_metrics_line_schema_locked
+
+# the expert-parallel MoE lane (ISSUE 15, docs/PERF.md "Expert-parallel
+# MoE" / docs/SERVING.md "MoE decode"): seeded grouped routing
+# (determinism, shard invariance, the capacity-factor drop closed
+# form), the grouped Pallas expert-FFN kernels (count-aware skipping,
+# int8 exactness, tuning-DB site), the decomposed-a2a dispatch/combine
+# loop vs the monolithic pair, SPMD step parity across the knob matrix,
+# the native-vs-SPMD a2a schedule-parity formula, MoE decode in the
+# serving tier (per-expert batching, overflow rounds, seeded skew ->
+# p99, imbalance telemetry + record/parser round trip), and the moe_ab
+# bench-line schema + sentinel comparability.  ~2 min wall.
+check-moe:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m 'moe and not slow' \
+	    tests/test_moe.py tests/test_moe_serving.py
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
+	    tests/test_bench_aux.py::test_moe_ab_line_schema_locked \
+	    tests/test_sentinel.py::test_moe_ab_line_is_comparable
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

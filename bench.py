@@ -540,7 +540,7 @@ def _run_bench(args, tracer) -> int:
     if args.skip_aux:
         fp8 = fp8_chain = int8 = int8_ab = fp8_ab = None
         straggler = ckpt_ab = int8_step = int8_sb = overlap_ab = None
-        serving = tuned_ab = longcontext = kv_density = None
+        serving = tuned_ab = longcontext = kv_density = moe_ab = None
     else:
         fp8 = _aux("fp8 mlp matmul", _bench_fp8_mlp, card, hw_key, dev)
         fp8_chain = _aux("fp8 swiglu chain", _bench_fp8_swiglu_chain,
@@ -577,6 +577,10 @@ def _run_bench(args, tracer) -> int:
         # attention-only compiles, bounded by the shared aux deadline
         longcontext = _aux("longcontext A/B", _bench_longcontext_ab,
                            card, hw_key, dev)
+        # the ISSUE-15 MoE evidence: dense FFN vs sparse-dispatch MoE
+        # vs grouped-kernel MoE at matched active params — three
+        # reduced-depth train-step compiles under the aux deadline
+        moe_ab = _aux("moe A/B", _bench_moe_ab, card, hw_key, dev)
         # LAST among the aux lines: they are the most expensive (a full
         # train-step compile+measure each) and the only ones with a
         # known backend-poisoning failure mode (the r5 composed-VJP
@@ -635,6 +639,7 @@ def _run_bench(args, tracer) -> int:
         **({"serving_decode": serving} if serving else {}),
         **({"kv_density_ab": kv_density} if kv_density else {}),
         **({"longcontext_ab": longcontext} if longcontext else {}),
+        **({"moe_ab": moe_ab} if moe_ab else {}),
         **({"spmd_overlap_ab": overlap_ab} if overlap_ab else {}),
         **({"int8_step": int8_step} if int8_step else {}),
         **({"int8_switchback_step": int8_sb} if int8_sb else {}),
@@ -1957,6 +1962,130 @@ def _bench_longcontext_ab(card, hw_key: str, dev) -> dict | None:
             1.0 - dense_stats["block_skip_fraction"], 1e-9),
         nbytes=int(jnp.dtype(dt).itemsize * s * (2 * hq + 2 * hkv)
                    * dh), hw=hw, dtype_key="bfloat16")
+    print(json.dumps(line))
+    return line
+
+
+def _moe_ab_line(summaries_s: dict, round_times_s: dict, *,
+                 metric: str, moe_info: dict,
+                 active_params: dict) -> dict:
+    """Assemble the dense-FFN-vs-MoE A/B line (ISSUE 15; pure —
+    tests/test_bench_aux.py locks this schema).  The headline ``value``
+    is the sparse-MoE train-step median ms (the production MoE recipe;
+    lower-is-better so the sentinel compares it like every ms line);
+    every variant ships its {value, best, band, n} sub-object, the MoE
+    variants a paired per-round ratio band vs dense (the r4 protocol —
+    at MATCHED ACTIVE PARAMS the ratio IS the routing+dispatch premium
+    of sparse execution), and ``moe_info`` carries the routing knobs +
+    measured layer-0 router stats as record globals."""
+    mo = summaries_s["moe"]
+    dense_rounds = round_times_s["dense"]
+    line = {
+        "metric": metric,
+        "value": round(mo["value"] * 1e3, 3),
+        "unit": "ms",
+        **_band_ms(mo),
+    }
+    for name, s in summaries_s.items():
+        line[f"{name}_ms"] = {"value": round(s["value"] * 1e3, 3),
+                              **_band_ms(s)}
+    for name in summaries_s:
+        if name == "dense":
+            continue
+        ratios = [t / d for t, d in zip(round_times_s[name],
+                                        dense_rounds) if d > 0]
+        line[f"ratio_{name}_vs_dense"] = stats_mod.summarize(
+            ratios, ndigits=4)
+    line["band_disjoint"] = (
+        stats_mod.bands_overlap(mo["band"],
+                                summaries_s["dense"]["band"]) is False)
+    line["active_params"] = active_params
+    line.update(moe_info)
+    return stats_mod.flag_low_mode(line)
+
+
+def _bench_moe_ab(card, hw_key: str, dev) -> dict | None:
+    """Dense FFN vs MoE at MATCHED ACTIVE PARAMS (ISSUE 15 satellite):
+    three train-step chains under the r4 pairing protocol — a dense
+    model with ``ff = top_k * f_e``, the sparse-dispatch MoE with E
+    experts of width ``f_e`` (identical per-token FFN params, so the
+    paired ratio prices routing/dispatch/combine, not model size), and
+    the same MoE through the grouped Pallas expert-FFN kernels
+    (ops/grouped_matmul.py).  Shapes ride the bench card's dims with
+    DLNB_BENCH_MOE_* env overrides so the sentinel lane can run the
+    exact pipeline on a tiny CPU model."""
+    import dataclasses as _dc
+
+    from dlnetbench_tpu.models import bench_step
+    from dlnetbench_tpu.models import moe as moe_mod
+    from dlnetbench_tpu.models import transformer as tfm
+    from dlnetbench_tpu.utils.tpu_probe import env_int
+
+    e = env_int("DLNB_BENCH_MOE_EXPERTS", 8)
+    top_k = env_int("DLNB_BENCH_MOE_TOPK", 2)
+    f_e = env_int("DLNB_BENCH_MOE_FF", 0) or max(
+        128, card.ff_dim // top_k)
+    layers = env_int("DLNB_BENCH_MOE_LAYERS", 2)
+    seq = env_int("DLNB_BENCH_MOE_SEQ", min(SEQ, 2048))
+    cf = 1.25
+    K = env_int("DLNB_BENCH_MOE_K", 4)
+
+    base = dict(vocab_size=VOCAB, embed_dim=card.embed_dim,
+                num_heads=card.num_heads,
+                num_kv_heads=card.num_kv_heads, num_layers=layers,
+                seq_len=seq, gated=True, max_positions=0,
+                scan_layers=False, logits_f32=False)
+    cfgs = {
+        "dense": tfm.TransformerConfig(ff_dim=top_k * f_e, **base),
+        "moe": tfm.TransformerConfig(
+            ff_dim=f_e, num_experts=e, top_k=top_k, moe_impl="sparse",
+            moe_capacity_factor=cf, **base),
+        "moe_grouped": tfm.TransformerConfig(
+            ff_dim=f_e, num_experts=e, top_k=top_k,
+            moe_impl="grouped", moe_capacity_factor=cf, **base),
+    }
+    tokens = jax.random.randint(jax.random.key(1), (BATCH, seq + 1), 0,
+                                VOCAB)
+    progs = {}
+    for name, cfg in cfgs.items():
+        params = tfm.init_params(jax.random.key(0), cfg)
+        train_k = bench_step.make_train_k(cfg, K)
+        progs[name] = _compile_chain(
+            lambda p, f=train_k: f(p, tokens), params)
+    summaries, round_times = _measure_paired(progs, K)
+
+    # measured router stats: the layer-0 routing of the benched model
+    # over the benched tokens' embeddings (the honest cheap probe —
+    # full per-layer load telemetry lives in the serving tier and the
+    # SPMD stats step)
+    mcfg = cfgs["moe"]
+    mparams = tfm.init_params(jax.random.key(0), mcfg)
+
+    def probe(params, toks):
+        from dlnetbench_tpu.models import layers as L
+        x = params["embed"][toks.reshape(-1)]
+        y = L.rmsnorm(x, params["layers"]["norm2"][0])
+        return moe_mod.dispatch(y, params["layers"]["w_router"][0], e,
+                                top_k, cf, with_stats=True)[3]
+
+    stats = jax.jit(probe)(mparams, tokens[:, :-1])
+    moe_info = moe_mod.stats_globals(
+        jax.device_get(stats), num_experts=e, top_k=top_k,
+        capacity_factor=cf, drop_seed=None, group_tokens=0)
+
+    d = card.embed_dim
+    active = {"dense_ffn_params": 3 * d * top_k * f_e,
+              "moe_active_ffn_params": 3 * d * top_k * f_e,
+              "moe_total_ffn_params": 3 * d * e * f_e,
+              "router_params": d * e}
+    line = _moe_ab_line(
+        summaries, round_times,
+        metric=f"moe A/B: dense FFN (ff={top_k * f_e}) vs "
+               f"{e}-expert top-{top_k} MoE (f_e={f_e}, cf={cf}; "
+               f"matched active params; sparse dispatch vs grouped "
+               f"Pallas expert FFN), {layers}L B={BATCH} S={seq}, "
+               f"{dev.device_kind} ({hw_key})",
+        moe_info=moe_info, active_params=active)
     print(json.dumps(line))
     return line
 

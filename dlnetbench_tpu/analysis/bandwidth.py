@@ -220,6 +220,17 @@ def effective_bandwidth(records: list[dict]):
         tuned = (f"{int(tun.get('hits', 0))}/"
                  f"{int(tun.get('hits', 0)) + int(tun.get('misses', 0))}"
                  if isinstance(tun, dict) else "-")
+        # MoE imbalance columns (ISSUE 15): measured expert-load
+        # imbalance (max/mean of the routed-load fractions) and drop
+        # rate of the run's routing — NaN on dense records, so a MoE
+        # run's bandwidth rows always say how skewed its dispatch was
+        moe = g.get("moe") or {}
+        moe_cols = {
+            "expert_imbalance": float(
+                moe.get("load_imbalance", float("nan"))),
+            "moe_drop_rate": float(
+                moe.get("drop_rate", float("nan"))),
+        }
         # critical-path blame (ISSUE 14, analysis/critical_path.py):
         # which rank's clock carried the excess, and how much of it —
         # per-rank signal exists only on records with genuinely
@@ -328,6 +339,7 @@ def effective_bandwidth(records: list[dict]):
                         "straggler_amp": straggler_amp,
                         **ckpt_cols,
                         **attr_cols,
+                        **moe_cols,
                         **blame,
                     })
     return pd.DataFrame(rows)
@@ -381,6 +393,17 @@ def serving_summary(records: list[dict]):
             for p in ("p50", "p95", "p99"):
                 row[f"{base[:-3]}_{p}_ms"] = float(
                     pcts.get(p, float("nan")))
+        # MoE decode provenance (ISSUE 15): the skew knob + measured
+        # imbalance and overflow-round cost ride every serving row —
+        # the columns the latency-vs-imbalance study grids by.  NaN /
+        # "-" on dense engines.
+        moe = g.get("moe") or {}
+        cfg_srv = g.get("serving_config") or {}
+        row["moe_skew"] = float(cfg_srv.get("moe_skew", float("nan")))
+        row["expert_imbalance"] = float(
+            moe.get("load_imbalance", float("nan")))
+        row["moe_rounds_mean"] = float(
+            moe.get("rounds_mean", float("nan")))
         rows.append(row)
     return pd.DataFrame(rows)
 
@@ -410,5 +433,6 @@ def bandwidth_summary(records: list[dict]):
               "overlap", "straggler_amp", "detection_ms", "recovery_ms",
               "checkpoint_ms", "restore_ms", "lost_steps", "goodput",
               "attr_compute", "attr_hbm", "attr_comm", "attr_host",
+              "expert_imbalance", "moe_drop_rate",
               "blame_frac"]]
             .mean().reset_index())

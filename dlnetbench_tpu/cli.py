@@ -544,6 +544,24 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
                         "the target + shared head")
     p.add_argument("--drafter_layers", type=int, default=1,
                    help="truncated drafter depth (< --layers)")
+    p.add_argument("--num_experts", type=int, default=1,
+                   help=">1 turns every layer's MLP into a MoE "
+                        "(ISSUE 15): decode batches tokens per expert "
+                        "into capacity buffers and pays overflow "
+                        "ROUNDS when routing skews — imbalance "
+                        "becomes a measurable p99 story "
+                        "(docs/SERVING.md 'MoE decode')")
+    p.add_argument("--top_k", type=int, default=1,
+                   help="experts per token (MoE models)")
+    p.add_argument("--moe_capacity_factor", type=float, default=1.0,
+                   help="per-round expert capacity factor of the "
+                        "serving MoE MLP")
+    p.add_argument("--moe_skew", type=float, default=0.0,
+                   help="seeded expert-skew injection: bias added to "
+                        "the router logits (serving/moe_decode."
+                        "skew_bias) — the imbalance-shaped sibling of "
+                        "a fault plan's seeded delays; 0 = off")
+    p.add_argument("--moe_skew_seed", type=int, default=0)
     # decode-model shape (tiny CPU-feasible defaults; a real study on
     # chip raises these)
     p.add_argument("--embed", type=int, default=64)
@@ -627,7 +645,9 @@ def _run_serve(args, parser) -> int:
         num_heads=args.heads, num_kv_heads=args.kv_heads,
         ff_dim=args.ff, num_layers=args.layers,
         seq_len=args.max_seq_len, gated=True, max_positions=0,
-        dtype=args.dtype)
+        dtype=args.dtype, num_experts=args.num_experts,
+        top_k=args.top_k,
+        moe_capacity_factor=args.moe_capacity_factor)
     srv_cfg = ServingConfig(
         slots=args.slots, page_size=args.page_size,
         num_pages=args.num_pages, max_seq_len=args.max_seq_len,
@@ -639,7 +659,8 @@ def _run_serve(args, parser) -> int:
         speculative=args.speculative, spec_k=args.spec_k,
         drafter=args.drafter, drafter_layers=args.drafter_layers,
         cache_dtype=args.cache_dtype,
-        prefix_sharing=args.prefix_sharing)
+        prefix_sharing=args.prefix_sharing,
+        moe_skew=args.moe_skew, moe_skew_seed=args.moe_skew_seed)
     try:
         srv_cfg.validate()
         if srv_cfg.speculative:

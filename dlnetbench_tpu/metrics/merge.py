@@ -92,7 +92,15 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      # measurements, never run identity.  Process 0's
                      # blocks survive in the merged record.
                      "telemetry", "anomalies",
-                     "watchdog_stall_telemetry"}
+                     "watchdog_stall_telemetry",
+                     # MoE imbalance measurements (ISSUE 15): each
+                     # process measures its own expert-load histogram
+                     # and overflow-round counts; the routing KNOBS
+                     # (moe_experts/top_k/capacity/skew — in
+                     # serving_config and the moe_* globals) stay
+                     # comparable: differently-routed runs are
+                     # different runs
+                     "moe"}
 
 # scheduler-stamped variables that identify the PROCESS, not the run
 # (metrics.emit.scheduler_variables): they legitimately differ between

@@ -164,6 +164,18 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                     hits = int(tun.get("hits", 0))
                     total = hits + int(tun.get("misses", 0))
                     row["tuned"] = f"{hits}/{total}"
+                # MoE imbalance block (a dict global, skipped above —
+                # ISSUE 15): hoist the expert-load axes a skew study
+                # grids by; dense/pre-MoE records simply lack them
+                moe = g.get("moe")
+                if isinstance(moe, dict):
+                    for mk in ("load_imbalance", "rounds_mean",
+                               "drop_rate", "router_entropy"):
+                        if mk in moe:
+                            row[f"moe_{mk}"] = moe[mk]
+                    if "expert_load" in moe:
+                        row["moe_expert_load_max"] = max(
+                            moe["expert_load"], default=0.0)
                 # serving block (a dict global, skipped above): hoist
                 # the latency-vs-load axes — offered load, the tail
                 # percentiles and goodput-at-SLO — to plain columns so

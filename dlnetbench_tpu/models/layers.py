@@ -247,10 +247,18 @@ def gelu_mlp(x, w_in, b_in, w_out, b_out):
                     preferred_element_type=_F32) + b_out).astype(x.dtype)
 
 
+def router_logits(x, w_router):
+    """The ONE spelling of the router projection (f32 — router logits
+    are precision-sensitive): ``moe_router`` here, the seeded grouped
+    routing in ``models/moe.py`` and the serving MoE decode all build
+    on it, so their expert assignments can never drift apart."""
+    return jnp.dot(x.astype(_F32), w_router.astype(_F32))
+
+
 def moe_router(x, w_router, top_k: int):
     """Token router: returns (weights [T, k], expert indices [T, k]).
     Softmax over the selected top-k (Mixtral convention)."""
-    logits = jnp.dot(x.astype(_F32), w_router.astype(_F32))
+    logits = router_logits(x, w_router)
     top_vals, top_idx = jax.lax.top_k(logits, top_k)
     weights = jax.nn.softmax(top_vals, axis=-1)
     return weights, top_idx

@@ -1,0 +1,287 @@
+"""Expert-parallel MoE core (ISSUE 15): seeded deterministic routing,
+capacity/drop accounting, routing stats, and the grouped expert FFN.
+
+The reference's ``hybrid_3d_moe`` proxy replays all-to-all VOLUMES; the
+SPMD tier (models/spmd.py) has run the GShard capacity dispatch with
+real math since the seed — but its token-drop rule was per-rank arrival
+order, so the set of dropped tokens depended on how the batch happened
+to be sharded.  This module makes routing a first-class, certifiable
+schedule:
+
+* **Seeded grouped token-drop** — capacity is enforced per GROUP of
+  ``group_tokens`` consecutive tokens in canonical (batch-row,
+  sequence) order, and within a group the dispatch queue order is a
+  seeded splitmix-style priority over GLOBAL token ids instead of
+  arrival order.  Because a group never straddles a shard boundary
+  (``group_tokens`` must divide the sequence shard), the kept/dropped
+  set is a pure function of ``(tokens, router weights, seed,
+  group_tokens)`` — IDENTICAL across shard counts, which is what lets
+  the dryrun certify token-identical routing between sharded and
+  single-device execution (the acceptance bar the arrival-order rule
+  could never meet).  ``drop_seed=None`` + one group delegates to
+  ``layers.moe_dispatch`` — bit-identical legacy behavior.
+* **Drop closed form** — ``expected_drops`` states the capacity
+  arithmetic (``sum_e,g max(0, n_ge - cap_g)``) the property tests pin
+  the measured drop counts against.
+* **Routing stats** — per-expert load, drop rate and router entropy as
+  in-graph arrays (``dispatch(..., with_stats=True)``) plus the
+  ``stats_globals`` formatter that shapes them as record globals
+  (hoisted by ``metrics/parser.py``, volatile at merge like every
+  measured quantity).
+* **Grouped expert FFN** — ``moe_grouped`` runs the sparse MoE through
+  the Pallas grouped-matmul kernels (ops/grouped_matmul.py): per-expert
+  token batching with count-aware block skipping and the PR-3 int8/fp8
+  VMEM-prologue quantization recipes.
+* **Schedule twin** — ``a2a_elems_per_rank`` mirrors the native
+  schedule's all-to-all message arithmetic
+  (``core/schedule.moe_schedule``), so the native-vs-SPMD MoE parity
+  test compares one formula against the twin's ACTUAL dispatch buffer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dlnetbench_tpu.models import layers as L
+
+_F32 = jnp.float32
+
+
+# ----------------------------------------------------------- priority
+def token_priority(seed: int, gids):
+    """Seeded per-token drop priority: a 32-bit murmur3-style finalizer
+    over the GLOBAL token id, xor-folded with the seed.  Pure function
+    of (seed, gid) — the same token gets the same priority on every
+    rank of every mesh, which is the whole point."""
+    h = gids.astype(jnp.uint32) ^ jnp.uint32((seed * 0x9E3779B9)
+                                             & 0xFFFFFFFF)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def group_capacity(group_tokens: int, top_k: int, num_experts: int,
+                   capacity_factor: float) -> int:
+    """Per-(group, expert) dispatch slots — the ONE capacity spelling
+    (``layers.moe_dispatch`` uses the same arithmetic at group =
+    the whole batch)."""
+    return max(1, int(capacity_factor * group_tokens * top_k
+                      / num_experts))
+
+
+def expected_drops(counts, cap_g: int):
+    """The capacity-factor closed form: tokens routed beyond their
+    (group, expert) capacity.  ``counts``: [G, E] routed-assignment
+    histogram.  The property tests pin measured drops to this."""
+    over = jnp.maximum(counts - cap_g, 0)
+    return jnp.sum(over)
+
+
+# ----------------------------------------------------------- dispatch
+def dispatch(x2d, w_router, num_experts: int, top_k: int,
+             capacity_factor: float, *, drop_seed: int | None = None,
+             group_tokens: int = 0, gids=None, with_stats: bool = False):
+    """Capacity-based token dispatch with seeded grouped token-drop.
+
+    Returns ``(xe [E, C_total, d], disp [T, E, C_total], gate [T, E])``
+    (+ ``stats`` with ``with_stats``) — the ``layers.moe_dispatch``
+    contract with the expert buffer subdivided into per-group capacity
+    blocks (``C_total = G * cap_g``).
+
+    * ``group_tokens = 0`` (one group) + ``drop_seed = None`` is the
+      LEGACY path — it delegates to ``layers.moe_dispatch`` outright,
+      bit-identical to the pre-ISSUE-15 harness.
+    * ``drop_seed`` set: within each group the dispatch queue order is
+      the seeded priority over ``gids`` (global token ids; defaults to
+      ``arange(T)`` for single-device callers) instead of arrival
+      order.
+    * ``group_tokens > 0``: capacity is per group of that many
+      CONSECUTIVE tokens; T must divide evenly.  Because groups nest
+      inside every shard's local block (validated by the SPMD config),
+      assignments are shard-count invariant.
+    """
+    t, _ = x2d.shape
+    e = num_experts
+    g = group_tokens or t
+    if t % g:
+        raise ValueError(f"moe.dispatch: {t} tokens not divisible by "
+                         f"group_tokens={g}")
+    if drop_seed is None and g == t:
+        xe, disp, gate = L.moe_dispatch(x2d, w_router, e, top_k,
+                                        capacity_factor)
+        if not with_stats:
+            return xe, disp, gate
+        cap = group_capacity(t, top_k, e, capacity_factor)
+        _, idx = L.moe_router(x2d, w_router, top_k)
+        counts = jnp.sum(jax.nn.one_hot(idx, e, dtype=_F32),
+                         axis=(0, 1))[None]          # [1, E]
+        stats = _routing_stats(x2d, w_router, counts, disp, cap)
+        return xe, disp, gate, stats
+
+    n_groups = t // g
+    cap_g = group_capacity(g, top_k, e, capacity_factor)
+    weights, idx = L.moe_router(x2d, w_router, top_k)
+    onehot = jax.nn.one_hot(idx, e, dtype=_F32)          # [T, k, E]
+    gate = jnp.sum(onehot * weights[..., None], axis=1)  # [T, E]
+    mask = jnp.sum(onehot, axis=1)                       # [T, E] 0/1
+    maskg = mask.reshape(n_groups, g, e)
+    if drop_seed is not None:
+        if gids is None:
+            gids = jnp.arange(t, dtype=jnp.int32)
+        prio = token_priority(drop_seed, gids).reshape(n_groups, g)
+        order = jnp.argsort(prio, axis=1)                # queue order
+        inv = jnp.argsort(order, axis=1)
+        ms = jnp.take_along_axis(maskg, order[..., None], axis=1)
+        pos_s = jnp.cumsum(ms, axis=1) - 1.0
+        pos = jnp.take_along_axis(pos_s, inv[..., None], axis=1)
+    else:
+        pos = jnp.cumsum(maskg, axis=1) - 1.0
+    keep = maskg * (pos < cap_g)                         # [G, g, E]
+    slot = pos + (jnp.arange(n_groups, dtype=_F32)
+                  * cap_g)[:, None, None]
+    c_total = n_groups * cap_g
+    disp = jax.nn.one_hot(slot.astype(jnp.int32).reshape(t, e),
+                          c_total, dtype=_F32) \
+        * keep.reshape(t, e)[..., None]                  # [T, E, C]
+    xe = jnp.einsum("tec,td->ecd", disp, x2d.astype(_F32))
+    if not with_stats:
+        return xe, disp, gate
+    counts = jnp.sum(maskg, axis=1)                      # [G, E]
+    stats = _routing_stats(x2d, w_router, counts, disp, cap_g)
+    return xe, disp, gate, stats
+
+
+def _routing_stats(x2d, w_router, counts, disp, cap_g: int) -> dict:
+    """In-graph routing stats: routed/kept histograms, drop count (and
+    its closed form — equal by construction, pinned by tests), router
+    entropy of the MEAN full-softmax distribution (normalized to
+    [0, 1] by ln E)."""
+    e = counts.shape[-1]
+    probs = jax.nn.softmax(L.router_logits(x2d, w_router), axis=-1)
+    p_mean = jnp.mean(probs, axis=0)                     # [E]
+    entropy = -jnp.sum(p_mean * jnp.log(p_mean + 1e-12))
+    routed = jnp.sum(counts, axis=0)                     # [E]
+    kept = jnp.sum(disp, axis=(0, 2))                    # [E]
+    return {
+        "routed": routed,
+        "kept": kept,
+        "dropped": jnp.sum(routed) - jnp.sum(kept),
+        "expected_dropped": expected_drops(counts, cap_g),
+        "entropy": entropy / jnp.log(jnp.asarray(float(e))),
+    }
+
+
+def stats_globals(stats, *, num_experts: int, top_k: int,
+                  capacity_factor: float, drop_seed: int | None,
+                  group_tokens: int) -> dict:
+    """Shape measured routing stats (host-side numpy-ables) as record
+    globals: the knobs are COMPARABLE (different routing configs are
+    different runs), the measured load/drop/entropy ride the volatile
+    ``moe`` block (metrics/merge) and hoist as ``moe_*`` columns
+    (metrics/parser)."""
+    import numpy as np
+    routed = np.asarray(stats["routed"], dtype=float)
+    total = max(float(routed.sum()), 1.0)
+    load = routed / total
+    mean = max(float(load.mean()), 1e-12)
+    return {
+        "moe_experts": int(num_experts),
+        "moe_top_k": int(top_k),
+        "moe_capacity_factor": float(capacity_factor),
+        "moe_drop_seed": (int(drop_seed) if drop_seed is not None
+                          else None),
+        "moe_group_tokens": int(group_tokens),
+        "moe": {
+            "expert_load": [round(float(v), 6) for v in load],
+            "load_imbalance": round(float(load.max()) / mean, 4),
+            "drop_rate": round(float(stats["dropped"]) / total, 6),
+            "router_entropy": round(float(stats["entropy"]), 6),
+        },
+    }
+
+
+# -------------------------------------------------- grouped expert FFN
+def expert_ffn(xe, w_gate, w_up, w_down, *, impl: str = "einsum",
+               quant: str | None = None, counts=None,
+               mlp_int8: bool = False):
+    """The expert-FFN dispatch point shared by the single-device MoE
+    below and the EP-sharded SPMD path: ``xe`` [E, C, d] dispatch
+    buffers -> [E, C, d].
+
+    * ``impl="einsum"`` — the XLA batched-einsum path (the pre-ISSUE-15
+      spelling; ``mlp_int8`` keeps the r5 int8_dot_batched recipe).
+    * ``impl="grouped"`` — the Pallas grouped-matmul kernels with
+      optional fused int8/fp8 quantization (``quant``) and count-aware
+      block skipping (``counts``).
+    """
+    if impl == "grouped":
+        from dlnetbench_tpu.ops.grouped_matmul import grouped_ffn
+        return grouped_ffn(xe, w_gate, w_up, w_down, counts=counts,
+                           fmt=quant).astype(_F32)
+    if impl != "einsum":
+        raise ValueError(f"moe.expert_ffn: unknown impl {impl!r} "
+                         f"(einsum | grouped)")
+    if mlp_int8:
+        from dlnetbench_tpu.ops.int8 import int8_dot_batched
+        dt = xe.dtype
+        g = int8_dot_batched(xe, w_gate.astype(dt))
+        u = int8_dot_batched(xe, w_up.astype(dt))
+        h = jax.nn.silu(g.astype(_F32)) * u.astype(_F32)
+        out = int8_dot_batched(h.astype(dt), w_down.astype(dt))
+        return out.astype(_F32)
+    h = jax.nn.silu(jnp.einsum("ecd,edh->ech", xe, w_gate,
+                               preferred_element_type=_F32))
+    h = h * jnp.einsum("ecd,edh->ech", xe, w_up,
+                       preferred_element_type=_F32)
+    return jnp.einsum("ech,ehd->ecd", h.astype(xe.dtype), w_down,
+                      preferred_element_type=_F32)
+
+
+def moe_grouped(x2d, w_router, w_gate, w_up, w_down, top_k: int,
+                capacity_factor: float = 1.25, *,
+                quant: str | None = None,
+                drop_seed: int | None = None):
+    """Single-device sparse MoE through the grouped Pallas kernels
+    (``TransformerConfig.moe_impl="grouped"``): the ``layers.moe_sparse``
+    schedule with the expert FFN running as per-expert token batches —
+    blocks past an expert's kept-token count are skipped, and ``quant``
+    selects the fused int8/fp8 recipes."""
+    e = w_gate.shape[0]
+    out = dispatch(x2d, w_router, e, top_k, capacity_factor,
+                   drop_seed=drop_seed, with_stats=True)
+    xe, disp, gate, stats = out
+    counts = jnp.minimum(
+        stats["kept"],
+        jnp.float32(xe.shape[1])).astype(jnp.int32)
+    y = expert_ffn(xe.astype(x2d.dtype), w_gate, w_up, w_down,
+                   impl="grouped", quant=quant, counts=counts)
+    return L.moe_combine(y, disp, gate).astype(x2d.dtype)
+
+
+# ------------------------------------------------------- schedule twin
+def a2a_elems_per_rank(tokens_per_mb: int, top_k: int, embed_dim: int,
+                       ep: int) -> int:
+    """The native schedule's per-rank all-to-all message arithmetic
+    (``core/schedule.moe_schedule``: ``tokens_per_mb * top_k *
+    embed_dim // num_expert_shards`` — reference
+    hybrid_3d_moe.cpp:354-359), restated here so the parity test can
+    pin BOTH tiers to one formula."""
+    return tokens_per_mb * top_k * embed_dim // ep
+
+
+def spmd_a2a_elems(cfg, dp: int, tp: int) -> int:
+    """The JAX twin's ACTUAL per-rank dispatch-buffer elements per
+    microbatch tick: the [E, C, d] buffer ``_moe_block`` hands the
+    EP all-to-all.  At ``capacity_factor == 1`` (and divisible shapes)
+    this equals ``a2a_elems_per_rank`` over this rank's token share —
+    the native-vs-SPMD schedule-parity certification
+    (tests/test_moe.py)."""
+    mb_size = cfg.batch // (dp * cfg.num_microbatches)
+    t_loc = mb_size * (cfg.seq_len // tp)
+    cap = group_capacity(cfg.moe_group_tokens or t_loc, cfg.top_k,
+                         cfg.num_experts, cfg.capacity_factor)
+    n_groups = t_loc // (cfg.moe_group_tokens or t_loc)
+    return cfg.num_experts * n_groups * cap * cfg.embed_dim

@@ -135,6 +135,38 @@ class SpmdConfig:
     # local layers per bucket.  None = tuning-DB consult, frozen
     # default 1 on a miss; explicit ints always win (resolve_tuned)
     grad_bucket_layers: int | None = None
+    # --- ISSUE 15: expert-parallel MoE knobs -------------------------
+    # How the EP dispatch/combine all-to-alls execute:
+    #   monolithic  blocking lax.all_to_all pair around the expert FFN
+    #               (the pre-ISSUE-15 spelling, bit-identical)
+    #   decomposed  ppermute chunk loop fused with the expert FFN
+    #               (ops/moe_dispatch.a2a_expert_ffn): each peer
+    #               block's dispatch hop / expert compute / combine
+    #               hop interleave, forward AND backward (custom VJP)
+    moe_a2a: str = "monolithic"
+    # FFN capacity-axis chunks per peer block (decomposed overlap
+    # grain — the moe sibling of tp_overlap_chunks)
+    moe_chunks: int = 1
+    # Token-drop determinism (models/moe.py): None keeps the legacy
+    # per-rank arrival-order drop (bit-identical); an int switches to
+    # the seeded priority over GLOBAL token ids, which (with
+    # moe_group_tokens) makes the kept/dropped set identical across
+    # shard counts — the dryrun's token-identical-routing bar
+    moe_drop_seed: int | None = None
+    # Capacity-group size in tokens (0 = this rank's whole per-tick
+    # buffer, the legacy semantics).  Must divide the sequence shard
+    # (seq_len/tp) so groups never straddle shard boundaries
+    moe_group_tokens: int = 0
+    # Expert FFN implementation: "einsum" (XLA batched einsums, the
+    # legacy spelling) | "grouped" (Pallas grouped-matmul kernels,
+    # ops/grouped_matmul.py — block shapes a tuning-DB site)
+    moe_ffn_impl: str = "einsum"
+    # Fused-quantization recipe for the grouped expert FFN ("none" |
+    # "int8" | "float8"): per-expert dynamic scales quantize the
+    # activation tile in the kernel's VMEM prologue (the PR-3 recipe);
+    # requires moe_ffn_impl="grouped" and excludes mlp_int8 (two
+    # quant recipes on one matmul would measure neither)
+    moe_ffn_quant: str = "none"
 
     @property
     def head_dim(self) -> int:
@@ -233,6 +265,26 @@ class SpmdConfig:
              self.grad_bucket_layers >= 1, "grad_bucket_layers < 1"),
             (self.attention_window >= 0, "attention_window < 0"),
             (self.attention_seg_avg >= 0, "attention_seg_avg < 0"),
+            (self.moe_a2a in ("monolithic", "decomposed"),
+             f"unknown moe_a2a {self.moe_a2a!r}"),
+            (self.moe_chunks >= 1, "moe_chunks < 1"),
+            (self.moe_group_tokens >= 0, "moe_group_tokens < 0"),
+            (self.moe_group_tokens == 0
+             or (self.seq_len // tp) % self.moe_group_tokens == 0,
+             f"moe_group_tokens {self.moe_group_tokens} must divide "
+             f"the sequence shard {self.seq_len // tp} (groups may "
+             f"not straddle shard boundaries)"),
+            (self.moe_ffn_impl in ("einsum", "grouped"),
+             f"unknown moe_ffn_impl {self.moe_ffn_impl!r}"),
+            (self.moe_ffn_quant in ("none", "int8", "float8"),
+             f"unknown moe_ffn_quant {self.moe_ffn_quant!r}"),
+            (self.moe_ffn_quant == "none"
+             or self.moe_ffn_impl == "grouped",
+             "moe_ffn_quant requires moe_ffn_impl='grouped' (the "
+             "fused recipes live in the grouped kernel)"),
+            (not (self.mlp_int8 and self.moe_ffn_impl == "grouped"),
+             "mlp_int8 and moe_ffn_impl='grouped' are two quant "
+             "recipes on one matmul — pick one"),
             (self.num_layers % pp == 0, "layers % pp != 0"),
             (self.batch % (dp * self.num_microbatches) == 0,
              "batch % (dp*microbatches) != 0"),
@@ -338,67 +390,85 @@ def _local_a2a(x, tp: int, split_axis: int, concat_axis: int):
     return jnp.concatenate(parts, axis=concat_axis)
 
 
-def _moe_block(cfg: SpmdConfig, tp: int, y, lp, comm_on=True,
+def _moe_block(cfg: SpmdConfig, tp: int, y, lp, gids, comm_on=True,
                compute_on=True):
-    """y: [mb, S/tp, d] local tokens; experts sharded over tp (EP)."""
+    """y: [mb, S/tp, d] local tokens; experts sharded over tp (EP).
+
+    ``gids``: [mb, S/tp] GLOBAL token ids — the seeded drop priority's
+    domain (models/moe.py), so routing is identical however the batch
+    is sharded.  Routing dispatches through ``models/moe.dispatch``
+    (legacy knobs delegate to ``layers.moe_dispatch`` bit-identically);
+    the a2a pair runs blocking (``moe_a2a="monolithic"``) or as the
+    ppermute chunk loop fused with the expert FFN
+    (``"decomposed"`` — ops/moe_dispatch.a2a_expert_ffn, the
+    hybrid_3d_moe dispatch/combine A2As overlapped)."""
+    from dlnetbench_tpu.models import moe as MoE
+    from dlnetbench_tpu.ops import moe_dispatch as MD
     mb, s_loc, d = y.shape
-    x2 = y.reshape(mb * s_loc, d)
+    t = mb * s_loc
+    x2 = y.reshape(t, d)
+    quant = None if cfg.moe_ffn_quant == "none" else cfg.moe_ffn_quant
     if compute_on:
-        # capacity-based one-hot dispatch (GShard style) — the shared
-        # math in models/layers.py, so the single-device sparse MoE and
-        # this EP-sharded path can never drift apart
-        ein, disp, gate = Lyr.moe_dispatch(x2, lp["w_router"],
-                                           cfg.num_experts, cfg.top_k,
-                                           cfg.capacity_factor)
+        ein, disp, gate = MoE.dispatch(
+            x2, lp["w_router"], cfg.num_experts, cfg.top_k,
+            cfg.capacity_factor, drop_seed=cfg.moe_drop_seed,
+            group_tokens=cfg.moe_group_tokens, gids=gids.reshape(t))
     else:   # comm variant: dispatch stubbed, buffer shapes preserved
-        cap = max(1, int(cfg.capacity_factor * x2.shape[0] * cfg.top_k
-                         / cfg.num_experts))
-        ein = CM.comm_stub((cfg.num_experts, cap, d), _F32, x2,
+        g = cfg.moe_group_tokens or t
+        c_total = (t // g) * MoE.group_capacity(
+            g, cfg.top_k, cfg.num_experts, cfg.capacity_factor)
+        ein = CM.comm_stub((cfg.num_experts, c_total, d), _F32, x2,
                            lp["w_router"])
         disp = gate = None
-    # EP all_to_all: [E, C, d] -> [E/tp, C*tp, d] (each rank gets its experts'
-    # tokens from every peer — the hybrid_3d_moe dispatch A2A)
-    if tp > 1:
-        ein = (lax.all_to_all(ein, AXIS_TP, split_axis=0, concat_axis=1,
-                              tiled=True) if comm_on
-               else _local_a2a(ein, tp, 0, 1))
-    ein = ein.astype(cfg.jdtype)
-    if not compute_on:
-        out = CM.comm_stub(ein.shape, _F32, ein, lp["w_gate"],
-                           lp["w_up"], lp["w_down"])
-    elif cfg.mlp_int8:
-        from dlnetbench_tpu.ops.int8 import int8_dot_batched
-        g = int8_dot_batched(ein, lp["w_gate"].astype(cfg.jdtype))
-        u = int8_dot_batched(ein, lp["w_up"].astype(cfg.jdtype))
-        h = jax.nn.silu(g.astype(_F32)) * u.astype(_F32)
-        out = int8_dot_batched(h.astype(cfg.jdtype),
-                               lp["w_down"].astype(cfg.jdtype))
-        out = out.astype(_F32)
+    if cfg.moe_a2a == "decomposed" and tp > 1:
+        # dispatch a2a + expert FFN + combine a2a as ONE fused
+        # ppermute chunk loop — each peer block's hops overlap the
+        # blocks already computing, forward and backward
+        out = MD.a2a_expert_ffn(
+            ein.astype(cfg.jdtype), lp["w_gate"], lp["w_up"],
+            lp["w_down"], AXIS_TP, chunks=cfg.moe_chunks,
+            fake_compute=not compute_on, fake_comm=not comm_on,
+            ffn_impl=cfg.moe_ffn_impl, quant=quant,
+            mlp_int8=cfg.mlp_int8)
     else:
-        h = jax.nn.silu(jnp.einsum("ecd,edh->ech", ein, lp["w_gate"],
-                                   preferred_element_type=_F32))
-        h = h * jnp.einsum("ecd,edh->ech", ein, lp["w_up"],
-                           preferred_element_type=_F32)
-        out = jnp.einsum("ech,ehd->ecd", h.astype(cfg.jdtype),
-                         lp["w_down"], preferred_element_type=_F32)
-    if tp > 1:  # combine A2A (reverse reshard)
-        out = (lax.all_to_all(out, AXIS_TP, split_axis=1, concat_axis=0,
-                              tiled=True) if comm_on
-               else _local_a2a(out, tp, 1, 0))
+        # EP all_to_all: [E, C, d] -> [E/tp, C*tp, d] (each rank gets
+        # its experts' tokens from every peer — the hybrid_3d_moe
+        # dispatch A2A)
+        if tp > 1:
+            ein = (lax.all_to_all(ein, AXIS_TP, split_axis=0,
+                                  concat_axis=1, tiled=True) if comm_on
+                   else _local_a2a(ein, tp, 0, 1))
+        ein = ein.astype(cfg.jdtype)
+        if not compute_on:
+            out = CM.comm_stub(ein.shape, _F32, ein, lp["w_gate"],
+                               lp["w_up"], lp["w_down"])
+        else:
+            # the shared expert-FFN dispatch point (models/moe.py):
+            # einsum (bit-identical legacy spelling, incl. the r5
+            # mlp_int8 recipe) or the grouped Pallas kernels
+            out = MoE.expert_ffn(ein, lp["w_gate"], lp["w_up"],
+                                 lp["w_down"], impl=cfg.moe_ffn_impl,
+                                 quant=quant, mlp_int8=cfg.mlp_int8)
+        if tp > 1:  # combine A2A (reverse reshard)
+            out = (lax.all_to_all(out, AXIS_TP, split_axis=1,
+                                  concat_axis=0, tiled=True) if comm_on
+                   else _local_a2a(out, tp, 1, 0))
     if compute_on:
         y2 = Lyr.moe_combine(out, disp, gate)
     else:
-        y2 = CM.comm_stub((mb * s_loc, d), _F32, out)
+        y2 = CM.comm_stub((t, d), _F32, out)
     return y2.reshape(mb, s_loc, d).astype(y.dtype)
 
 
-def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions, comm_on=True,
-                 compute_on=True):
+def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions, gids,
+                 comm_on=True, compute_on=True):
     """One decoder block under TP+SP; x: [mb, S/tp, d] sequence-sharded.
 
     ``positions``: the GLOBAL positions matching the sequence length rope
     sees — the full [S] in megatron mode (rope runs after the gather),
     this shard's [S/tp] slice in ring/ulysses mode (rope runs locally).
+    ``gids``: [mb, S/tp] global token ids for the seeded MoE drop
+    priority (models/moe.py — shard-layout invariant routing).
     """
     mb, s_loc, d = x.shape
     dh = cfg.head_dim
@@ -489,7 +559,7 @@ def _stage_block(cfg: SpmdConfig, tp: int, x, lp, positions, comm_on=True,
     x = x + out
 
     y = Lyr.rmsnorm(x, lp["norm2"])
-    return x + _moe_block(cfg, tp, y, lp, comm_on, compute_on)
+    return x + _moe_block(cfg, tp, y, lp, gids, comm_on, compute_on)
 
 
 def _vocab_parallel_ce(logits_loc, targets, tp: int, vocab: int,
@@ -615,10 +685,10 @@ def make_train_step(mesh: Mesh, cfg: SpmdConfig, variant: str = "full"):
                              [layers_xs["wq"], layers_xs["wk"],
                               layers_xs["wv"]], axis=-1)}
 
-        def run_stage(x):
+        def run_stage(x, gids):
             def body(carry, lp):
                 return _stage_block(cfg, tp, carry, lp, positions,
-                                    comm_on, compute_on), None
+                                    gids, comm_on, compute_on), None
             out, _ = lax.scan(body, x, layers_xs)
             return out
 
@@ -634,7 +704,16 @@ def make_train_step(mesh: Mesh, cfg: SpmdConfig, variant: str = "full"):
             inp_loc = lax.dynamic_slice_in_dim(inp, tp_idx * s_loc, s_loc, 1)
             emb = params_loc["embed"][inp_loc]      # [mb, S/tp, d]
             x_in = jnp.where(stage == 0, emb, x_carry)
-            x_out = run_stage(x_in)
+            # global token ids of this rank's (microbatch, seq-shard)
+            # block — the seeded MoE drop priority's domain: the same
+            # token gets the same id on every mesh shape
+            dp_idx = lax.axis_index(AXIS_DP)
+            rows = (dp_idx * (cfg.batch // dp) + mb_c * mb_size
+                    + jnp.arange(mb_size, dtype=jnp.int32))
+            gids = (rows[:, None] * cfg.seq_len
+                    + tp_idx * s_loc
+                    + jnp.arange(s_loc, dtype=jnp.int32)[None, :])
+            x_out = run_stage(x_in, gids)
             # last stage: loss for this tick's microbatch
             xh = Lyr.rmsnorm(x_out, params_loc["final_norm"])
             tgt = lax.dynamic_index_in_dim(targets, mb_c, 0, keepdims=False)

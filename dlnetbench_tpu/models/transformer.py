@@ -92,10 +92,15 @@ class TransformerConfig:
                              # backward stays in the master dtype
                              # (straight-through) for both
     moe_impl: str = "dense"        # "dense" (every expert computes every
-                             # selected token — exact, E/k x the FLOPs) or
+                             # selected token — exact, E/k x the FLOPs),
                              # "sparse" (capacity-based dispatch, GShard
                              # style: ~k*cf*T*ffn FLOPs, over-capacity
                              # tokens dropped — the production semantics)
+                             # or "grouped" (sparse routing with the
+                             # expert FFN as Pallas grouped-matmul
+                             # kernels, ops/grouped_matmul.py — blocks
+                             # past an expert's kept-token count are
+                             # skipped; ISSUE 15)
     moe_capacity_factor: float = 1.25
     int8_backward: str = "master"  # mlp_dtype="int8" backward mode:
                              # "master" = straight-through bf16 (the
@@ -151,9 +156,9 @@ class TransformerConfig:
             raise ValueError(
                 "remat_scope='mlp' covers the dense gated (SwiGLU) MLP "
                 "path only")
-        if self.moe_impl not in ("dense", "sparse"):
+        if self.moe_impl not in ("dense", "sparse", "grouped"):
             raise ValueError(f"unknown moe_impl {self.moe_impl!r}; "
-                             f"expected 'dense' or 'sparse'")
+                             f"expected 'dense', 'sparse' or 'grouped'")
         if self.mlp_dtype not in ("bfloat16", "float8", "int8"):
             raise ValueError(f"unknown mlp_dtype {self.mlp_dtype!r}; "
                              f"expected 'bfloat16', 'float8' or 'int8'")
@@ -344,10 +349,17 @@ def _block(cfg: TransformerConfig, x, lp, positions, qs_row=None):
     if cfg.gated:
         y = L.rmsnorm(x, lp["norm2"])
         if cfg.num_experts > 1:
-            moe = (L.moe_dense if cfg.moe_impl == "dense"
-                   else functools.partial(
-                       L.moe_sparse,
-                       capacity_factor=cfg.moe_capacity_factor))
+            if cfg.moe_impl == "dense":
+                moe = L.moe_dense
+            elif cfg.moe_impl == "grouped":
+                from dlnetbench_tpu.models.moe import moe_grouped
+                moe = functools.partial(
+                    moe_grouped,
+                    capacity_factor=cfg.moe_capacity_factor)
+            else:
+                moe = functools.partial(
+                    L.moe_sparse,
+                    capacity_factor=cfg.moe_capacity_factor)
             y2 = moe(y.reshape(b * s, d), lp["w_router"],
                      lp["w_gate"], lp["w_up"], lp["w_down"],
                      cfg.top_k).reshape(b, s, d)

@@ -55,11 +55,11 @@ _F32 = jnp.float32
 
 def check_config(cfg: TransformerConfig,
                  decode: bool = False) -> TransformerConfig:
-    if not cfg.gated or cfg.num_experts > 1 or cfg.max_positions:
+    if not cfg.gated or cfg.max_positions:
         raise ValueError(
-            "serving decode covers the dense gated (SwiGLU+RMSNorm+"
-            "RoPE) family only — non-gated / MoE / learned-position "
-            "configs have no decode path yet")
+            "serving decode covers the gated (SwiGLU+RMSNorm+RoPE) "
+            "family only — non-gated / learned-position configs have "
+            "no decode path yet")
     if cfg.attention_seg_avg:
         raise ValueError(
             "serving decode supports sliding-window attention masks "
@@ -134,7 +134,8 @@ def _split_pools(cache_cfg: CacheConfig, pools: tuple):
 
 def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
                  params, pools, tokens, positions, write_ok,
-                 block_tables, *, layers: int | None = None):
+                 block_tables, *, layers: int | None = None,
+                 moe_bias=None):
     """ONE batched single-token step over the paged cache — the math
     both the single-step program and the fused multi-step loop body run
     (sharing the definition is what makes N-step-vs-1-step token parity
@@ -151,7 +152,14 @@ def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
     read: the fed token's k/v land first).  ``layers`` truncates the
     stack — the speculative TRUNCATED drafter is literally the first
     ``layers`` layers of the target plus the shared final-norm/head
-    (serving/speculative.py); ``None`` runs the full depth."""
+    (serving/speculative.py); ``None`` runs the full depth.
+
+    MoE configs (``cfg.num_experts > 1`` — ISSUE 15) run the MLP as
+    per-expert token batches with overflow rounds
+    (``serving/moe_decode.moe_mlp_rounds``; ``moe_bias`` is the seeded
+    skew-injection knob) and the return value grows a third element:
+    ``(pools, next_tokens, (expert_load [E], rounds))`` summed over
+    the layer stack — the imbalance telemetry the engine records."""
     b = tokens.shape[0]
     scale = cfg.head_dim ** -0.5
     page_size = cache_cfg.page_size
@@ -166,6 +174,9 @@ def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
     slots = positions % page_size
     att_lengths = positions + 1
     depth = cfg.num_layers if layers is None else layers
+    moe = cfg.num_experts > 1
+    moe_load = jnp.zeros((cfg.num_experts,), jnp.int32) if moe else None
+    moe_rounds = jnp.int32(0)
     for li in range(depth):
         lp = jax.tree.map(lambda a: a[li], params["layers"])
         y = L.rmsnorm(x, lp["norm1"])
@@ -200,18 +211,34 @@ def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
                    block_tables)
         x = x + jnp.dot(att.reshape(b, cfg.embed_dim), lp["wo"])
         y = L.rmsnorm(x, lp["norm2"])
-        x = x + L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
+        if moe:
+            from dlnetbench_tpu.serving.moe_decode import (
+                decode_capacity, moe_mlp_rounds)
+            cap = decode_capacity(b, cfg.top_k, cfg.num_experts,
+                                  cfg.moe_capacity_factor)
+            y2, load_l, rounds_l = moe_mlp_rounds(
+                y, lp["w_router"], lp["w_gate"], lp["w_up"],
+                lp["w_down"], top_k=cfg.top_k, capacity=cap,
+                bias=moe_bias, active=write_ok)
+            moe_load = moe_load + load_l
+            moe_rounds = moe_rounds + rounds_l
+            x = x + y2
+        else:
+            x = x + L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
     x = L.rmsnorm(x, params["final_norm"])
     head = params["embed"].T if cfg.tied_embeddings else params["head"]
     logits = jnp.dot(x, head, preferred_element_type=_F32)
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if quant:
-        return (k_pages, v_pages, k_scale, v_scale), next_tokens
-    return (k_pages, v_pages), next_tokens
+    pools_out = ((k_pages, v_pages, k_scale, v_scale) if quant
+                 else (k_pages, v_pages))
+    if moe:
+        return pools_out, next_tokens, (moe_load, moe_rounds)
+    return pools_out, next_tokens
 
 
 def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
-                     *, attn_impl: str = "auto", mesh=None):
+                     *, attn_impl: str = "auto", mesh=None,
+                     moe_bias=None):
     """``decode_step(params, k_pages, v_pages, tokens, positions,
     block_tables, active) -> (k_pages, v_pages, next_tokens)``.
 
@@ -226,26 +253,36 @@ def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
     k_scale, v_scale, tokens, positions, block_tables, active) ->
     (k_pages, v_pages, k_scale, v_scale, next_tokens)`` — threaded
     functionally exactly like the pools themselves.  The dense
-    signature (and its compiled program) is untouched."""
+    signature (and its compiled program) is untouched.
+
+    MoE configs (ISSUE 15) append the per-step imbalance stats to the
+    outputs — ``(..., next_tokens, expert_load, rounds)`` — and take
+    the seeded ``moe_bias`` skew knob (serving/moe_decode.py)."""
     check_config(cfg, decode=True)
     attn = _attn_fn(cache_cfg, attn_impl, mesh)
+    moe = cfg.num_experts > 1
+
+    def _run(params, pools, tokens, positions, block_tables, active):
+        out = _step_tokens(cfg, cache_cfg, attn, params, pools, tokens,
+                           positions, active, block_tables,
+                           moe_bias=moe_bias)
+        if moe:
+            pools, nxt, (load, rounds) = out
+            return (*pools, nxt, load, rounds)
+        pools, nxt = out
+        return (*pools, nxt)
 
     if cache_cfg.quantized:
         def decode_step(params, k_pages, v_pages, k_scale, v_scale,
                         tokens, positions, block_tables, active):
-            pools, nxt = _step_tokens(
-                cfg, cache_cfg, attn, params,
-                (k_pages, v_pages, k_scale, v_scale), tokens,
-                positions, active, block_tables)
-            return (*pools, nxt)
+            return _run(params, (k_pages, v_pages, k_scale, v_scale),
+                        tokens, positions, block_tables, active)
         return decode_step
 
     def decode_step(params, k_pages, v_pages, tokens, positions,
                     block_tables, active):
-        pools, nxt = _step_tokens(cfg, cache_cfg, attn, params,
-                                  (k_pages, v_pages), tokens,
-                                  positions, active, block_tables)
-        return (*pools, nxt)
+        return _run(params, (k_pages, v_pages), tokens, positions,
+                    block_tables, active)
 
     return decode_step
 
@@ -259,7 +296,8 @@ STATE_ROWS = 4
 
 def make_multi_step_decode(cfg: TransformerConfig,
                            cache_cfg: CacheConfig, n_max: int, *,
-                           attn_impl: str = "auto", mesh=None):
+                           attn_impl: str = "auto", mesh=None,
+                           moe_bias=None):
     """The device-resident fused decode loop (ISSUE 11 tentpole).
 
     ``multi_step(params, k_pages, v_pages, state, block_tables,
@@ -291,19 +329,28 @@ def make_multi_step_decode(cfg: TransformerConfig,
     the pools (``multi_step(params, k_pages, v_pages, k_scale,
     v_scale, state, ...)``) — same write sequence as the 1-step
     quantized engine, so N-step-vs-1-step parity holds per cache
-    dtype."""
+    dtype.
+
+    MoE configs (ISSUE 15) run the per-expert batched MLP inside the
+    loop body and append the ACCUMULATED imbalance stats to the
+    outputs — ``(..., steps_run, expert_load, rounds)`` summed over
+    the loop trips — so one host sync still carries the whole
+    dispatch window's telemetry."""
     check_config(cfg, decode=True)
     if n_max < 1:
         raise ValueError(f"multi_step_decode: n_max must be >= 1, "
                          f"got {n_max}")
     attn = _attn_fn(cache_cfg, attn_impl, mesh)
     n_pools = 4 if cache_cfg.quantized else 2
+    moe = cfg.num_experts > 1
 
     def _multi_step(params, pools, state, block_tables, n_steps):
         b = state.shape[1]
         n = jnp.minimum(n_steps.astype(jnp.int32), n_max)
         out0 = jnp.zeros((b, n_max), jnp.int32)
         counts0 = jnp.zeros((b,), jnp.int32)
+        load0 = jnp.zeros((cfg.num_experts,), jnp.int32)
+        rounds0 = jnp.int32(0)
 
         def cond(carry):
             i, st = carry[0], carry[1 + n_pools]
@@ -312,12 +359,19 @@ def make_multi_step_decode(cfg: TransformerConfig,
         def body(carry):
             i = carry[0]
             pc = carry[1:1 + n_pools]
-            st, out, cnt = carry[1 + n_pools:]
+            st, out, cnt, load, rounds = carry[1 + n_pools:]
             last, pos, rem = (st[STATE_LAST], st[STATE_POS],
                               st[STATE_REM])
             act = rem > 0
-            pc, nxt = _step_tokens(cfg, cache_cfg, attn, params, pc,
-                                   last, pos, act, block_tables)
+            step_out = _step_tokens(cfg, cache_cfg, attn, params, pc,
+                                    last, pos, act, block_tables,
+                                    moe_bias=moe_bias)
+            if moe:
+                pc, nxt, (load_s, rounds_s) = step_out
+                load = load + load_s
+                rounds = rounds + rounds_s
+            else:
+                pc, nxt = step_out
             # append each active slot's token at its own count index;
             # inactive slots aim past the buffer edge and drop
             idx = jnp.where(act, cnt, n_max)
@@ -327,14 +381,17 @@ def make_multi_step_decode(cfg: TransformerConfig,
             st = st.at[STATE_POS].set(pos + step)
             st = st.at[STATE_REM].set(rem - step)
             cnt = cnt + step
-            return (i + 1, *pc, st, out, cnt)
+            return (i + 1, *pc, st, out, cnt, load, rounds)
 
         final = lax.while_loop(
             cond, body,
-            (jnp.int32(0), *pools, state, out0, counts0))
+            (jnp.int32(0), *pools, state, out0, counts0, load0,
+             rounds0))
         i = final[0]
         pc = final[1:1 + n_pools]
-        st, out, cnt = final[1 + n_pools:]
+        st, out, cnt, load, rounds = final[1 + n_pools:]
+        if moe:
+            return (*pc, st, out, cnt, i, load, rounds)
         return (*pc, st, out, cnt, i)
 
     if cache_cfg.quantized:
@@ -354,7 +411,7 @@ def make_multi_step_decode(cfg: TransformerConfig,
 
 
 def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
-                       chunk: int):
+                       chunk: int, *, moe_bias=None):
     """``prefill_chunk(params, k_pages, v_pages, tokens, start, n_valid,
     block_row) -> (k_pages, v_pages, next_token)``.
 
@@ -411,6 +468,9 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
         w_pages = jnp.where(valid, page_id, num_pages)     # OOB -> drop
         slots = positions % page_size
         last = jnp.maximum(n_valid - 1, 0)
+        moe_load = (jnp.zeros((cfg.num_experts,), jnp.int32)
+                    if cfg.num_experts > 1 else None)
+        moe_rounds = jnp.int32(0)
         for li in range(cfg.num_layers):
             lp = jax.tree.map(lambda a: a[li], params["layers"])
             y = L.rmsnorm(x, lp["norm1"])
@@ -485,29 +545,54 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
             att = att.reshape(chunk, cfg.embed_dim).astype(x.dtype)
             x = x + jnp.dot(att, lp["wo"])
             y = L.rmsnorm(x, lp["norm2"])
-            x = x + L.swiglu(y, lp["w_gate"], lp["w_up"], lp["w_down"])
+            if cfg.num_experts > 1:
+                from dlnetbench_tpu.serving.moe_decode import (
+                    decode_capacity, moe_mlp_rounds)
+                cap = decode_capacity(chunk, cfg.top_k,
+                                      cfg.num_experts,
+                                      cfg.moe_capacity_factor)
+                y2, load_l, rounds_l = moe_mlp_rounds(
+                    y, lp["w_router"], lp["w_gate"], lp["w_up"],
+                    lp["w_down"], top_k=cfg.top_k, capacity=cap,
+                    bias=moe_bias, active=valid)
+                moe_load = moe_load + load_l
+                moe_rounds = moe_rounds + rounds_l
+                x = x + y2
+            else:
+                x = x + L.swiglu(y, lp["w_gate"], lp["w_up"],
+                                 lp["w_down"])
         x = L.rmsnorm(x, params["final_norm"])
         head = params["embed"].T if cfg.tied_embeddings else params["head"]
         logits = jnp.dot(x[last], head, preferred_element_type=_F32)
         next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if quant:
-            return (k_pages, v_pages, k_scale, v_scale), next_token
-        return (k_pages, v_pages), next_token
+        pools_out = ((k_pages, v_pages, k_scale, v_scale) if quant
+                     else (k_pages, v_pages))
+        if cfg.num_experts > 1:
+            return pools_out, next_token, (moe_load, moe_rounds)
+        return pools_out, next_token
+
+    moe = cfg.num_experts > 1
+
+    def _wrap(params, pools, tokens, start, n_valid, block_row):
+        out = _prefill(params, pools, tokens, start, n_valid,
+                       block_row)
+        if moe:
+            pools, nxt, (load, rounds) = out
+            return (*pools, nxt, load, rounds)
+        pools, nxt = out
+        return (*pools, nxt)
 
     if quant:
         def prefill_chunk(params, k_pages, v_pages, k_scale, v_scale,
                           tokens, start, n_valid, block_row):
-            pools, nxt = _prefill(
-                params, (k_pages, v_pages, k_scale, v_scale), tokens,
-                start, n_valid, block_row)
-            return (*pools, nxt)
+            return _wrap(params, (k_pages, v_pages, k_scale, v_scale),
+                         tokens, start, n_valid, block_row)
         return prefill_chunk
 
     def prefill_chunk(params, k_pages, v_pages, tokens, start, n_valid,
                       block_row):
-        pools, nxt = _prefill(params, (k_pages, v_pages), tokens,
-                              start, n_valid, block_row)
-        return (*pools, nxt)
+        return _wrap(params, (k_pages, v_pages), tokens, start,
+                     n_valid, block_row)
 
     return prefill_chunk
 

@@ -120,6 +120,17 @@ class ServingConfig:
                                 # admission charges only unshared
                                 # pages and the shared prefix skips
                                 # prefill (the TTFT win)
+    moe_skew: float = 0.0       # ISSUE 15: seeded expert-skew
+                                # injection — added to the router
+                                # logits of a MoE model's decode path
+                                # (serving/moe_decode.skew_bias), the
+                                # imbalance-shaped sibling of the
+                                # fault plans' seeded delays.  0.0 =
+                                # no bias built (bit-identical
+                                # routing).  COMPARABLE via
+                                # serving_config: a skewed run must
+                                # never merge with a balanced one
+    moe_skew_seed: int = 0      # which experts the skew favors
     warmup_requests: int = 8    # run_serving drives this many synthetic
                                 # requests through the engine BEFORE the
                                 # measured run (0 disables): first-call
@@ -171,6 +182,9 @@ class ServingConfig:
                     f"sampling-aware acceptance lands")
             raise ValueError(f"serving: unknown sampling "
                              f"{self.sampling!r} (greedy only)")
+        if self.moe_skew < 0:
+            raise ValueError(f"serving: moe_skew must be >= 0, got "
+                             f"{self.moe_skew}")
         if self.speculative:
             from dlnetbench_tpu.serving.speculative import DRAFTERS
             if self.spec_k < 1:
@@ -259,6 +273,20 @@ class Engine:
         # slot state device-resident between admission syncs
         self._loop_mode = cfg.multi_step_n > 1 or cfg.speculative
         self._decode = self._loop = None
+        # ISSUE 15: MoE decode — per-expert token batching with
+        # overflow rounds inside both decode paths; the seeded skew
+        # bias is an engine-build constant (serving/moe_decode.py)
+        self._moe = model_cfg.num_experts > 1
+        if self._moe and cfg.speculative:
+            raise ValueError(
+                "serving: speculative decode covers dense models only "
+                "— the draft/verify overwrite cycle has no stated "
+                "parity story through the MoE overflow rounds")
+        self._moe_bias = None
+        if self._moe:
+            from dlnetbench_tpu.serving.moe_decode import skew_bias
+            self._moe_bias = skew_bias(model_cfg.num_experts,
+                                       cfg.moe_skew, cfg.moe_skew_seed)
         with spans.span("build", what="serving engine"):
             if self._loop_mode:
                 if cfg.speculative:
@@ -277,7 +305,8 @@ class Engine:
                 else:
                     loop_fn = D.make_multi_step_decode(
                         model_cfg, self.cache_cfg, cfg.multi_step_n,
-                        attn_impl=cfg.attn_impl, mesh=mesh)
+                        attn_impl=cfg.attn_impl, mesh=mesh,
+                        moe_bias=self._moe_bias)
                     # pools (+ scale arrays on a quantized cache) +
                     # packed state — all loop carries
                     carries = (tuple(range(1, 6)) if self._quant
@@ -289,12 +318,14 @@ class Engine:
                 self._decode = executor.CompiledStep(
                     D.make_decode_step(model_cfg, self.cache_cfg,
                                        attn_impl=cfg.attn_impl,
-                                       mesh=mesh),
+                                       mesh=mesh,
+                                       moe_bias=self._moe_bias),
                     self._decode_example_args(),
                     donate_argnums=self._pool_argnums)
             self._prefill = executor.CompiledStep(
                 D.make_prefill_chunk(model_cfg, self.cache_cfg,
-                                     cfg.prefill_chunk),
+                                     cfg.prefill_chunk,
+                                     moe_bias=self._moe_bias),
                 self._prefill_example_args(),
                 donate_argnums=self._pool_argnums)
         decode_prog = self._loop if self._loop_mode else self._decode
@@ -454,6 +485,22 @@ class Engine:
         self._tokens_emitted = 0
         self._drafted = 0
         self._accepted = 0
+        # ISSUE 15 MoE imbalance telemetry: per-expert routed-token
+        # totals, per-dispatch overflow-round counts (decode and
+        # prefill tracked SEPARATELY — their capacity regimes differ,
+        # so mixing them would let prompt length move the decode
+        # rounds_mean the imbalance study grids by), and the last
+        # dispatch's snapshot for the flight ring.  _moe_pending holds
+        # intermediate prefill chunks' (load, rounds) DEVICE arrays:
+        # converting them eagerly would fence every chunk, violating
+        # the _prefill_one fence contract — they fold at the
+        # prompt-completing chunk's existing fence
+        self._moe_load = (np.zeros(self.model_cfg.num_experts,
+                                   np.int64) if self._moe else None)
+        self._moe_rounds: list[int] = []
+        self._moe_prefill_rounds: list[int] = []
+        self._moe_pending: list[tuple] = []
+        self._moe_last: dict = {}
         self._step_ewma_s = 0.0
         self._n_scalars: dict[int, jax.Array] = {}
         # flight recorder (ISSUE 14): refreshed per run; None (the
@@ -639,7 +686,15 @@ class Engine:
         outs = self._prefill(
             self.params, *self._pool_args(), chunk,
             jnp.int32(start), jnp.int32(n), row)
-        (nxt,) = self._adopt_pools(outs)
+        if self._moe:
+            # stash the DEVICE arrays — no np.asarray here, an
+            # intermediate chunk must not fence (the contract above);
+            # they fold at the completing chunk's int(nxt) fence,
+            # which orders after every prior chunk on the stream
+            nxt, load, rounds = self._adopt_pools(outs)
+            self._moe_pending.append((load, rounds))
+        else:
+            (nxt,) = self._adopt_pools(outs)
         st.prefill_done += n
         self.cache.append(slot, n)
         dev_s = 0.0
@@ -647,6 +702,7 @@ class Engine:
             # the chunk completing the prompt produces the request's
             # FIRST generated token — its TTFT stamp
             st.last_token = int(nxt)  # the fence: device work done here
+            self._fold_moe_pending()
             dev_s = time.perf_counter() - t0
             st.generated = 1
             st.first_token_s = self._now()
@@ -741,6 +797,11 @@ class Engine:
             if self.cfg.speculative and self._drafted:
                 fields["spec_acceptance"] = round(
                     self._accepted / self._drafted, 4)
+            if self._moe and self._moe_last:
+                # expert-imbalance telemetry (ISSUE 15): the last
+                # dispatch's overflow rounds + load imbalance ride
+                # the flight ring next to queue depth
+                fields.update(self._moe_last)
             if self.dstate is not None:
                 fields["sync_us"] = round(
                     self.dstate.sync_total_us() - sync0, 1)
@@ -797,7 +858,11 @@ class Engine:
             self.params, *self._pool_args(),
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(self.cache.block_tables), jnp.asarray(active))
-        (nxt,) = self._adopt_pools(outs)
+        if self._moe:
+            nxt, load, rounds = self._adopt_pools(outs)
+            self._record_moe(load, rounds)
+        else:
+            (nxt,) = self._adopt_pools(outs)
         nxt = np.asarray(nxt)        # the fence rides the device leg
         t1 = time.perf_counter()
         dev_s += t1 - t0
@@ -838,6 +903,9 @@ class Engine:
         ds.rebind(self._adopt_pools(new_carries))
         if self.cfg.speculative:
             toks, cnts, steps, drafted, accepted = extras
+        elif self._moe:
+            toks, cnts, steps, moe_load, moe_rounds = extras
+            self._record_moe(moe_load, moe_rounds)
         else:
             toks, cnts, steps = extras
         # the per-sync results (token block, counts, stats): np.asarray
@@ -881,6 +949,72 @@ class Engine:
         self._host_dispatch_us.append(
             max(0.0, (time.perf_counter() - t_step - dev_s - sync_s))
             * 1e6)
+
+    def _record_moe(self, load, rounds) -> None:
+        """Fold one DECODE dispatch's MoE stats (device outputs riding
+        the same fence as the tokens) into the run accumulators and
+        the last-dispatch snapshot the flight ring samples."""
+        load = np.asarray(load, np.int64)
+        rounds = int(rounds)
+        self._moe_load += load
+        self._moe_rounds.append(rounds)
+        total = float(load.sum())
+        if total > 0:
+            frac = load / total
+            imb = float(frac.max()) / max(float(frac.mean()), 1e-12)
+        else:
+            imb = 1.0
+        self._moe_last = {"moe_rounds": rounds,
+                          "moe_imbalance": round(imb, 4)}
+
+    def _fold_moe_pending(self) -> None:
+        """Fold the stashed prefill chunks' MoE stats.  Called under a
+        fence that already covers them (the completing chunk's TTFT
+        token, or record assembly), so the np.asarray conversions here
+        cost a copy, never a wait.  Prefill rounds accumulate apart
+        from decode rounds — prefill capacity is sized over the chunk,
+        decode capacity over the slot batch, and the decode
+        rounds_mean column must not move with prompt length."""
+        for load, rounds in self._moe_pending:
+            self._moe_load += np.asarray(load, np.int64)
+            self._moe_prefill_rounds.append(int(rounds))
+        self._moe_pending.clear()
+
+    def moe_block(self) -> dict | None:
+        """The record's MoE-imbalance block (ISSUE 15): measured
+        per-expert load distribution (prefill + decode routing — the
+        router is the router), its imbalance (max/mean), and the
+        DECODE overflow-round stats that turned imbalance into latency
+        (prefill rounds reported apart: their capacity is sized over
+        the chunk, not the slot batch).  None on dense engines —
+        pre-MoE records are byte-identical."""
+        if not self._moe:
+            return None
+        self._fold_moe_pending()   # a drained mid-prefill slot's stats
+        total = float(self._moe_load.sum())
+        load = (self._moe_load / total if total > 0
+                else np.zeros_like(self._moe_load, float))
+        rounds = self._moe_rounds
+        pf = self._moe_prefill_rounds
+        mean = max(float(load.mean()), 1e-12)
+        return {
+            "num_experts": int(self.model_cfg.num_experts),
+            "top_k": int(self.model_cfg.top_k),
+            "capacity_factor": float(
+                self.model_cfg.moe_capacity_factor),
+            "skew": self.cfg.moe_skew,
+            "skew_seed": self.cfg.moe_skew_seed,
+            "expert_load": [round(float(v), 6) for v in load],
+            "load_imbalance": round(float(load.max()) / mean, 4),
+            "rounds_mean": (round(sum(rounds) / len(rounds), 3)
+                            if rounds else 0.0),
+            "rounds_p99": (round(M.percentile(rounds, 99), 3)
+                           if rounds else 0.0),
+            "dispatches": len(rounds),
+            "prefill_rounds_mean": (round(sum(pf) / len(pf), 3)
+                                    if pf else 0.0),
+            "prefill_dispatches": len(pf),
+        }
 
     def _n_scalar(self, n: int):
         """Cached device scalar for the dynamic trip count (a fresh
@@ -1014,6 +1148,16 @@ class Engine:
                 "speculative": cfg.speculative,
                 **({"spec_k": cfg.spec_k, "drafter": cfg.drafter}
                    if cfg.speculative else {}),
+                # the skew KNOBS are run identity (serving_config is
+                # comparable): a skewed run never merges with a
+                # balanced one, exactly like mismatched fault plans
+                **({"moe_experts": self.model_cfg.num_experts,
+                    "moe_top_k": self.model_cfg.top_k,
+                    "moe_capacity_factor":
+                        self.model_cfg.moe_capacity_factor,
+                    "moe_skew": cfg.moe_skew,
+                    "moe_skew_seed": cfg.moe_skew_seed}
+                   if self._moe else {}),
             },
             "mesh": describe_mesh(make_flat_mesh(devices=self.devices)),
             **self.meta,
@@ -1119,6 +1263,13 @@ def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
                  "degraded_world": survivors,
                  "degraded_slots": shrunk.slots}
 
+    # measured MoE imbalance block (ISSUE 15): stamped from the FINAL
+    # engine AFTER the measured run (a crash-shrink continuation's
+    # stats are the degraded engine's); volatile at merge like every
+    # measurement; absent on dense engines
+    moe_blk = final.moe_block()
+    if moe_blk is not None:
+        meta["moe"] = moe_blk
     meta["serving"] = M.serving_block(
         completed, plan, slo_ttft_ms=cfg.slo_ttft_ms,
         slo_tpot_ms=cfg.slo_tpot_ms, wall_s=wall,

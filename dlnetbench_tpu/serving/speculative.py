@@ -62,6 +62,11 @@ def check_spec_config(cfg: TransformerConfig, *, spec_k: int,
                       drafter: str, drafter_layers: int) -> None:
     """Speculative knobs the model shape must also agree with (the
     ServingConfig-level checks live in scheduler.ServingConfig)."""
+    if cfg.num_experts > 1:
+        raise ValueError(
+            "speculative: MoE models are not supported — the "
+            "draft/verify overwrite cycle has no stated parity story "
+            "through the MoE overflow rounds (ISSUE 15)")
     if spec_k < 1:
         raise ValueError(f"speculative: spec_k must be >= 1, got "
                          f"{spec_k}")

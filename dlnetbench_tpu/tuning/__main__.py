@@ -40,7 +40,7 @@ from dlnetbench_tpu.tuning.search import tune_and_commit
 
 OPS = ("quantized_matmul", "flash_fwd", "flash_bwd", "splash_fwd",
        "splash_bwd", "paged_attention", "paged_attention_quant",
-       "tp_overlap_chunks", "grad_bucket_layers")
+       "grouped_ffn", "tp_overlap_chunks", "grad_bucket_layers")
 
 
 def _parse_candidates(spec: str | None, arity: int,
@@ -348,6 +348,40 @@ def _tune_grad_bucket_layers(args):
     return "grad_bucket_layers", key, cands, measure_cfg
 
 
+def _tune_grouped_ffn(args):
+    """Grouped expert-FFN grid blocks (ops/grouped_matmul.py, ISSUE
+    15): the per-expert dispatch-buffer SwiGLU measured at
+    (--experts x --capacity x --d x --ff) with optional fused
+    quantization (--fmt rides in the key via
+    ``params.grouped_ffn_key`` — bf16 optima never answer int8/fp8
+    consults)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlnetbench_tpu.ops import grouped_matmul as gm
+
+    e, c, d, h = args.experts, args.capacity, args.d, args.n
+    fmt = None if args.fmt == "none" else args.fmt
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    x = jax.random.normal(jax.random.key(0), (e, c, d), dt)
+    wg = jax.random.normal(jax.random.key(1), (e, d, h), dt) * 0.02
+    wu = jax.random.normal(jax.random.key(2), (e, d, h), dt) * 0.02
+    wd = jax.random.normal(jax.random.key(3), (e, h, d), dt) * 0.02
+    key = tparams.grouped_ffn_key(e, c, d, h, fmt or "none", x.dtype)
+    cands = _parse_candidates(args.candidates, 3,
+                              ("block_c", "block_n", "block_k")) or [
+        {"block_c": bc, "block_n": bn, "block_k": bk}
+        for bc in (512, 256, 128) for bn in (1024, 512)
+        for bk in (512, 256)]
+
+    def measure_cfg(cfg):
+        return _chain(lambda xx: gm.grouped_ffn(
+            xx, wg, wu, wd, fmt=fmt, block_c=cfg["block_c"],
+            block_n=cfg["block_n"], block_k=cfg["block_k"]),
+            (x,), args.k)
+    return "grouped_ffn", key, cands, measure_cfg
+
+
 def _run_tune(args) -> int:
     db_root = args.db or tparams.db_dir()
     if not db_root:
@@ -363,6 +397,7 @@ def _run_tune(args) -> int:
         "paged_attention": lambda: _tune_paged_attention(args),
         "paged_attention_quant":
             lambda: _tune_paged_attention_quant(args),
+        "grouped_ffn": lambda: _tune_grouped_ffn(args),
         "tp_overlap_chunks": lambda: _tune_tp_overlap_chunks(args),
         "grad_bucket_layers": lambda: _tune_grad_bucket_layers(args),
     }
@@ -438,7 +473,14 @@ def main(argv: list[str] | None = None) -> int:
     t.add_argument("--tokens", type=int, default=256)
     t.add_argument("--d", type=int, default=256)
     t.add_argument("--n", type=int, default=256)
-    t.add_argument("--fmt", default="int8", choices=["int8", "float8"])
+    t.add_argument("--fmt", default="int8",
+                   choices=["int8", "float8", "none"],
+                   help="quant format; 'none' (grouped_ffn only) "
+                        "measures the master-dtype kernel")
+    t.add_argument("--experts", type=int, default=8,
+                   help="grouped_ffn: expert count E")
+    t.add_argument("--capacity", type=int, default=256,
+                   help="grouped_ffn: dispatch slots per expert C")
     t.add_argument("--batch", type=int, default=1)
     t.add_argument("--seq", type=int, default=1024)
     t.add_argument("--heads", type=int, default=4)
@@ -462,6 +504,12 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--db", default=None)
     args = parser.parse_args(argv)
     if args.cmd == "tune":
+        if args.fmt == "none" and args.op != "grouped_ffn":
+            # every other --fmt consumer is a quantized kernel — fail
+            # as a tidy usage error, not a ValueError from inside it
+            parser.error(f"--fmt none is only meaningful for "
+                         f"--op grouped_ffn (the master-dtype grouped "
+                         f"kernel); --op {args.op} needs int8/float8")
         return _run_tune(args)
     return _run_show(args)
 

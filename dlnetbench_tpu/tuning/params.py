@@ -193,6 +193,18 @@ def paged_attention_quant_key(pages_per_seq: int, page_size: int,
                          dh=dh, fmt=fmt)
 
 
+def grouped_ffn_key(e: int, c: int, d: int, h: int, fmt: str,
+                    xdtype) -> str:
+    """The grouped expert-FFN kernel's grid blocks
+    (ops/grouped_matmul.py, op name "grouped_ffn") — keyed by the
+    dispatch-buffer geometry (experts x capacity x embed x ff) plus
+    the quant format ("none" for master-dtype): in-prologue quant
+    changes the kernel's arithmetic intensity, so bf16 optima must
+    never answer int8/fp8 consults (ISSUE 15)."""
+    return canonical_key(e=e, c=c, d=d, h=h, fmt=fmt,
+                         xdtype=str(xdtype))
+
+
 def tp_overlap_chunks_key(embed: int, ff: int, seq: int, tp: int,
                           dtype: str) -> str:
     return canonical_key(embed=embed, ff=ff, seq=seq, tp=tp,

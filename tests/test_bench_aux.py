@@ -663,3 +663,60 @@ def test_kv_density_line_schema_locked():
     assert bad["variants"]["int8"]["parity_ok"] is False
     from dlnetbench_tpu.sentinel import is_ms_line
     assert is_ms_line(line)
+
+
+def test_moe_ab_line_schema_locked():
+    """bench.py's dense-FFN-vs-MoE A/B line (ISSUE 15): the headline
+    ``value`` is the sparse-MoE median ms with {value, best, band, n},
+    every variant a sub-object, the MoE variants a paired per-round
+    ratio band vs dense (at matched active params the ratio IS the
+    routing/dispatch premium), band_disjoint the separation verdict,
+    and the routing knobs + measured router stats riding as record
+    globals."""
+    import bench
+
+    summaries = {
+        "dense": {"value": 0.010, "best": 0.009,
+                  "band": [0.009, 0.011], "n": 3},
+        "moe": {"value": 0.015, "best": 0.014,
+                "band": [0.014, 0.016], "n": 3},
+        "moe_grouped": {"value": 0.013, "best": 0.012,
+                        "band": [0.012, 0.014], "n": 3},
+    }
+    rounds = {"dense": [0.009, 0.010, 0.011],
+              "moe": [0.0135, 0.015, 0.0165],
+              "moe_grouped": [0.0117, 0.013, 0.0143]}
+    moe_info = {"moe_experts": 8, "moe_top_k": 2,
+                "moe_capacity_factor": 1.25, "moe_drop_seed": None,
+                "moe_group_tokens": 0,
+                "moe": {"expert_load": [0.125] * 8,
+                        "load_imbalance": 1.0, "drop_rate": 0.0,
+                        "router_entropy": 1.0}}
+    active = {"dense_ffn_params": 100, "moe_active_ffn_params": 100,
+              "moe_total_ffn_params": 400, "router_params": 8}
+    line = bench._moe_ab_line(summaries, rounds, metric="moe A/B: t",
+                              moe_info=moe_info, active_params=active)
+    assert line["unit"] == "ms" and line["value"] == 15.0
+    assert line["band"] == [14.0, 16.0] and line["n"] == 3
+    for sub in ("dense_ms", "moe_ms", "moe_grouped_ms"):
+        for k in ("value", "best", "band", "n"):
+            assert k in line[sub], (sub, k)
+    r = line["ratio_moe_vs_dense"]
+    assert r["n"] == 3 and r["value"] == 1.5
+    assert line["ratio_moe_grouped_vs_dense"]["value"] == 1.3
+    assert line["band_disjoint"] is True
+    # matched active params stated, knobs + measured stats ride along
+    assert (line["active_params"]["dense_ffn_params"]
+            == line["active_params"]["moe_active_ffn_params"])
+    assert line["moe_experts"] == 8
+    assert line["moe"]["load_imbalance"] == 1.0
+    # overlapping bands flip the verdict
+    s2 = dict(summaries)
+    s2["dense"] = {"value": 0.0145, "best": 0.014,
+                   "band": [0.014, 0.015], "n": 3}
+    line2 = bench._moe_ab_line(s2, rounds, metric="m",
+                               moe_info=moe_info, active_params=active)
+    assert line2["band_disjoint"] is False
+    # sentinel comparability: --check picks it up as "moe_ab"
+    from dlnetbench_tpu.sentinel import is_ms_line
+    assert is_ms_line(line)

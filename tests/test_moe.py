@@ -1,0 +1,521 @@
+"""Expert-parallel MoE subsystem tests (ISSUE 15): seeded grouped
+routing (determinism, shard invariance, the capacity-factor drop
+closed form), the grouped Pallas expert-FFN kernels (einsum parity,
+count skipping, int8 exactness, empty-DB bit-identity), the decomposed
+a2a dispatch/combine loop (monolithic parity forward and backward, the
+A/B fake legs), the SPMD training-step wiring, and the
+native-vs-SPMD a2a schedule parity."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlnetbench_tpu.models import layers as L
+from dlnetbench_tpu.models import moe
+from dlnetbench_tpu.ops import grouped_matmul as gm
+
+pytestmark = pytest.mark.moe
+
+_F32 = jnp.float32
+
+
+def _routing_case(t=64, d=16, e=4, seed=0):
+    x = jax.random.normal(jax.random.key(seed), (t, d), _F32)
+    wr = jax.random.normal(jax.random.key(seed + 100), (d, e),
+                           _F32) * 0.3
+    return x, wr
+
+
+# ------------------------------------------------------------ routing
+def test_legacy_dispatch_bit_identical():
+    """drop_seed=None + one group delegates to layers.moe_dispatch —
+    the pre-ISSUE-15 harness bit for bit."""
+    x, wr = _routing_case()
+    xe0, d0, g0 = L.moe_dispatch(x, wr, 4, 2, 1.25)
+    xe1, d1, g1 = moe.dispatch(x, wr, 4, 2, 1.25)
+    assert jnp.all(xe0 == xe1) and jnp.all(d0 == d1)
+    assert jnp.all(g0 == g1)
+
+
+def test_seeded_routing_deterministic_and_seed_sensitive():
+    x, wr = _routing_case()
+    a = moe.dispatch(x, wr, 4, 2, 0.5, drop_seed=7, group_tokens=16)
+    b = moe.dispatch(x, wr, 4, 2, 0.5, drop_seed=7, group_tokens=16)
+    c = moe.dispatch(x, wr, 4, 2, 0.5, drop_seed=8, group_tokens=16)
+    assert jnp.all(a[1] == b[1])            # same seed: identical
+    assert not jnp.all(a[1] == c[1])        # the seed is load-bearing
+
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_seeded_routing_shard_invariant(shards):
+    """The acceptance bar: the kept/dropped set computed per shard is
+    IDENTICAL to the single-device computation over the same global
+    tokens (exact one-hot equality — groups nest inside shards and
+    the priority is a pure function of (seed, global token id))."""
+    t, g = 64, 16
+    x, wr = _routing_case(t=t)
+    full = moe.dispatch(x, wr, 4, 2, 1.0, drop_seed=11, group_tokens=g,
+                        gids=jnp.arange(t))
+    h = t // shards
+    ch = full[1].shape[2] // shards
+    for s in range(shards):
+        part = moe.dispatch(x[s * h:(s + 1) * h], wr, 4, 2, 1.0,
+                            drop_seed=11, group_tokens=g,
+                            gids=jnp.arange(s * h, (s + 1) * h))
+        assert jnp.all(
+            full[1][s * h:(s + 1) * h, :, s * ch:(s + 1) * ch]
+            == part[1]), f"shard {s} routing differs"
+        assert jnp.all(full[2][s * h:(s + 1) * h] == part[2])
+
+
+@pytest.mark.parametrize("cf", [0.25, 0.5, 1.0, 4.0])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_drop_counts_match_capacity_closed_form(cf, seed):
+    """Measured drops == sum_{g,e} max(0, n_ge - cap_g) — the
+    capacity-factor closed form, at every capacity and seed."""
+    x, wr = _routing_case(seed=seed)
+    out = moe.dispatch(x, wr, 4, 2, cf, drop_seed=seed,
+                       group_tokens=16, with_stats=True)
+    stats = out[3]
+    assert float(stats["dropped"]) == float(stats["expected_dropped"])
+    # and the closed form recomputed independently agrees
+    _, idx = L.moe_router(x, wr, 2)
+    counts = np.zeros((4, 4))
+    for tok in range(64):
+        for kk in range(2):
+            counts[tok // 16, int(idx[tok, kk])] += 1
+    cap = moe.group_capacity(16, 2, 4, cf)
+    assert float(stats["dropped"]) == np.maximum(
+        counts - cap, 0).sum()
+
+
+def test_dispatch_group_divisibility_refused():
+    x, wr = _routing_case(t=60)
+    with pytest.raises(ValueError, match="group_tokens"):
+        moe.dispatch(x, wr, 4, 2, 1.0, group_tokens=16)
+
+
+def test_stats_globals_shape():
+    x, wr = _routing_case()
+    stats = moe.dispatch(x, wr, 4, 2, 1.0, drop_seed=1,
+                         group_tokens=16, with_stats=True)[3]
+    g = moe.stats_globals(jax.device_get(stats), num_experts=4,
+                          top_k=2, capacity_factor=1.0, drop_seed=1,
+                          group_tokens=16)
+    assert g["moe_experts"] == 4 and g["moe_drop_seed"] == 1
+    blk = g["moe"]
+    assert len(blk["expert_load"]) == 4
+    assert abs(sum(blk["expert_load"]) - 1.0) < 1e-3
+    assert 0.0 <= blk["drop_rate"] <= 1.0
+    assert 0.0 <= blk["router_entropy"] <= 1.0 + 1e-6
+    assert blk["load_imbalance"] >= 1.0
+
+
+# ----------------------------------------------------- grouped kernel
+def _gm_case(e=4, c=16, d=32, h=48, dtype=_F32):
+    x = jax.random.normal(jax.random.key(0), (e, c, d), dtype)
+    wg = jax.random.normal(jax.random.key(1), (e, d, h), dtype) * 0.05
+    wu = jax.random.normal(jax.random.key(2), (e, d, h), dtype) * 0.05
+    wd = jax.random.normal(jax.random.key(3), (e, h, d), dtype) * 0.05
+    return x, wg, wu, wd
+
+
+def test_grouped_matmul_matches_einsum():
+    x, wg, _, _ = _gm_case()
+    ref = jnp.einsum("ecd,edh->ech", x, wg)
+    out = gm.grouped_matmul(x, wg, block_c=8, block_n=16, block_k=16)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
+def test_grouped_matmul_counts_skip():
+    """Blocks past an expert's count emit zeros; live rows match the
+    dense reference."""
+    x, wg, _, _ = _gm_case()
+    ref = jnp.einsum("ecd,edh->ech", x, wg)
+    counts = jnp.array([16, 5, 0, 9], jnp.int32)
+    out = gm.grouped_matmul(x, wg, counts=counts, block_c=4,
+                            block_n=16, block_k=16)
+    for e in range(4):
+        n = int(counts[e])
+        nb = min(-(-n // 4) * 4 if n else 0, 16)
+        if n:
+            assert float(jnp.max(jnp.abs(out[e, :n] - ref[e, :n]))) \
+                < 1e-5
+        if nb < 16:
+            assert float(jnp.max(jnp.abs(out[e, nb:]))) == 0.0
+
+
+def test_grouped_matmul_int8_exact_vs_composed():
+    """Same scales + associative int32 accumulation: the fused grouped
+    int8 matmul EQUALS the composed XLA reference exactly (the PR-3
+    exactness discipline on the expert axis)."""
+    from dlnetbench_tpu.ops.quantized_matmul import (_cast_q,
+                                                     scale_from_amax)
+    x, wg, _, _ = _gm_case(dtype=jnp.bfloat16)
+    wq, sw = gm.quantize_experts(wg, "int8")
+    sx = scale_from_amax(gm.expert_amax(x), "int8")
+    out = gm.grouped_matmul(x, wq, sx=sx, sw=sw, fmt="int8",
+                            block_c=8, block_n=16, block_k=16)
+    xq = _cast_q(x.astype(_F32) / sx[:, None, None], "int8")
+    comp = (jnp.einsum("ecd,edh->ech", xq.astype(jnp.int32),
+                       wq.astype(jnp.int32)).astype(_F32)
+            * (sx * sw)[:, None, None]).astype(jnp.bfloat16)
+    assert jnp.all(out == comp)
+
+
+def test_grouped_ffn_grads_match_reference():
+    x, wg, wu, wd = _gm_case()
+
+    def loss(x_, a, b, c):
+        return jnp.sum(gm.grouped_ffn(x_, a, b, c, block_c=8,
+                                      block_n=16, block_k=16) ** 2)
+
+    def ref(x_, a, b, c):
+        h = (jax.nn.silu(jnp.einsum("ecd,edh->ech", x_, a))
+             * jnp.einsum("ecd,edh->ech", x_, b))
+        return jnp.sum(jnp.einsum("ech,ehd->ecd", h, c) ** 2)
+
+    g1 = jax.grad(loss, argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+    g2 = jax.grad(ref, argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+    for a, b in zip(g1, g2):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-5
+
+
+def test_grouped_ffn_fp8_runs_finite():
+    x, wg, wu, wd = _gm_case(dtype=jnp.bfloat16)
+    y = gm.grouped_ffn(x, wg, wu, wd, fmt="float8", block_c=8,
+                       block_n=16, block_k=16)
+    assert jnp.all(jnp.isfinite(y.astype(_F32)))
+
+
+def test_grouped_blocks_validated():
+    x, wg, _, _ = _gm_case()
+    with pytest.raises(ValueError, match="block_c"):
+        gm.grouped_matmul(x, wg, block_c=-4, block_n=16, block_k=16)
+    with pytest.raises(ValueError, match="fmt"):
+        gm.grouped_matmul(x, wg, fmt="int4")
+    with pytest.raises(ValueError, match="sx/sw"):
+        gm.grouped_matmul(x, wg, fmt="int8")
+
+
+@pytest.mark.tuning
+def test_grouped_ffn_empty_db_bit_identity(tmp_path, monkeypatch):
+    """The ISSUE-9 consult contract on the new site: with no DB the
+    consult path is BIT-identical to explicit DEFAULT_BLOCKS, and a
+    committed record is consulted (frozen after first consult)."""
+    from dlnetbench_tpu import tuning
+    x, wg, wu, wd = _gm_case(e=2, c=8, d=16, h=16)
+    tuning.reset(clear_env=True)
+    try:
+        y_off = gm.grouped_ffn(x, wg, wu, wd)
+        y_exp = gm.grouped_ffn(x, wg, wu, wd, **gm.DEFAULT_BLOCKS)
+        assert jnp.all(y_off == y_exp)
+        assert tuning.provenance() is None  # disabled: logs nothing
+        # now a DB with a record for THIS key must hit
+        from dlnetbench_tpu.tuning.db import TuningDB
+        monkeypatch.setenv(tuning.params.ENV_DB_DIR, str(tmp_path))
+        db = TuningDB(str(tmp_path))
+        key = tuning.params.grouped_ffn_key(2, 8, 16, 16, "none",
+                                            x.dtype)
+        db.put("grouped_ffn", key, tuning.params.hw_key(),
+               {"block_c": 4, "block_n": 8, "block_k": 8})
+        tuning.reset()
+        y_tuned = gm.grouped_ffn(x, wg, wu, wd)
+        prov = tuning.provenance()
+        hit = [v for k, v in prov["sites"].items()
+               if k == f"grouped_ffn|{key}"]
+        assert hit and hit[0]["hit"]
+        assert hit[0]["config"]["block_c"] == 4
+        # tuned divisor blocks produce the same values (pure tiling)
+        assert float(jnp.max(jnp.abs(y_tuned - y_off))) < 1e-5
+    finally:
+        tuning.reset(clear_env=True)
+
+
+def test_moe_grouped_matches_sparse_lossless():
+    x, wr = _routing_case(t=32, d=16)
+    _, wg, wu, wd = _gm_case(e=4, c=32, d=16, h=24)
+    ys = L.moe_sparse(x, wr, wg, wu, wd, 2, capacity_factor=2.0)
+    yg = moe.moe_grouped(x, wr, wg, wu, wd, 2, capacity_factor=2.0)
+    assert float(jnp.max(jnp.abs(ys - yg))) < 1e-5
+
+
+def test_transformer_moe_grouped_impl():
+    """moe_impl='grouped' runs the transformer forward/loss and stays
+    near the sparse impl (same routing, grouped kernels)."""
+    from dlnetbench_tpu.models import transformer as tfm
+    kw = dict(vocab_size=64, embed_dim=32, num_heads=4, num_kv_heads=2,
+              ff_dim=32, num_layers=2, seq_len=16, gated=True,
+              max_positions=0, dtype="float32", num_experts=4,
+              top_k=2, moe_capacity_factor=2.0)
+    cfg_s = tfm.TransformerConfig(moe_impl="sparse", **kw)
+    cfg_g = tfm.TransformerConfig(moe_impl="grouped", **kw)
+    params = tfm.init_params(jax.random.key(0), cfg_s)
+    toks = jax.random.randint(jax.random.key(1), (2, 17), 0, 64)
+    l_s = float(tfm.loss_fn(params, toks, cfg_s))
+    l_g = float(tfm.loss_fn(params, toks, cfg_g))
+    assert abs(l_s - l_g) < 1e-4 * max(1.0, abs(l_s))
+
+
+# --------------------------------------------------- decomposed a2a
+def _shardmap_ffn(fn, mesh):
+    from jax.sharding import PartitionSpec as P
+
+    from dlnetbench_tpu.utils.jax_compat import shard_map
+    specs = (P("tp"), P("tp"), P("tp"), P("tp"))
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=specs,
+                             out_specs=P("tp"), check_vma=False))
+
+
+def _a2a_case(n=4, e=8, c=6, d=16, h=24):
+    """Per-rank [E, C, d] dispatch buffers stacked on the shard axis
+    (shard_map P("tp") hands each rank its own buffer) + GLOBAL expert
+    weights sharded to [E/n, ...] per rank."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:n]), ("tp",))
+    ein = jax.random.normal(jax.random.key(0), (n * e, c, d), _F32)
+    wg = jax.random.normal(jax.random.key(1), (e, d, h), _F32) * 0.1
+    wu = jax.random.normal(jax.random.key(2), (e, d, h), _F32) * 0.1
+    wd = jax.random.normal(jax.random.key(3), (e, h, d), _F32) * 0.1
+    return mesh, ein, wg, wu, wd
+
+
+def test_a2a_expert_ffn_matches_monolithic(eight_devices):
+    from jax import lax
+
+    from dlnetbench_tpu.ops.moe_dispatch import a2a_expert_ffn
+    mesh, ein, wg, wu, wd = _a2a_case()
+
+    def mono(e_, a, b, c):
+        x = lax.all_to_all(e_, "tp", split_axis=0, concat_axis=1,
+                           tiled=True)
+        y = moe.expert_ffn(x, a, b, c)
+        return lax.all_to_all(y.astype(e_.dtype), "tp", split_axis=1,
+                              concat_axis=0, tiled=True)
+
+    def deco(e_, a, b, c):
+        return a2a_expert_ffn(e_, a, b, c, "tp",
+                              chunks=2).astype(e_.dtype)
+
+    out_m = np.asarray(_shardmap_ffn(mono, mesh)(ein, wg, wu, wd))
+    out_d = np.asarray(_shardmap_ffn(deco, mesh)(ein, wg, wu, wd))
+    assert np.abs(out_m - out_d).max() < 1e-6
+
+
+def test_a2a_expert_ffn_backward_matches(eight_devices):
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dlnetbench_tpu.ops.moe_dispatch import a2a_expert_ffn
+    from dlnetbench_tpu.utils.jax_compat import shard_map
+    mesh, ein, wg, wu, wd = _a2a_case()
+
+    def grads_of(fn):
+        def local(e_, a, b, c):
+            def l(e2, a2, b2, c2):
+                return jnp.sum(fn(e2, a2, b2, c2) ** 2)
+            return jax.grad(l, argnums=(0, 1, 2, 3))(e_, a, b, c)
+        specs = (P("tp"),) * 4
+        f = jax.jit(shard_map(local, mesh=mesh, in_specs=specs,
+                              out_specs=(P("tp"),) * 4,
+                              check_vma=False))
+        return [np.asarray(g) for g in f(ein, wg, wu, wd)]
+
+    def mono(e_, a, b, c):
+        x = lax.all_to_all(e_, "tp", split_axis=0, concat_axis=1,
+                           tiled=True)
+        y = moe.expert_ffn(x, a, b, c)
+        return lax.all_to_all(y.astype(e_.dtype), "tp", split_axis=1,
+                              concat_axis=0, tiled=True)
+
+    def deco(e_, a, b, c):
+        return a2a_expert_ffn(e_, a, b, c, "tp").astype(e_.dtype)
+
+    for a, b in zip(grads_of(mono), grads_of(deco)):
+        assert np.abs(a - b).max() < 1e-5
+
+
+def test_a2a_expert_ffn_fake_legs(eight_devices):
+    """The A/B decomposition legs keep shapes (comm leg) / values that
+    differ from the full program (both legs are stubs, not the real
+    math) while executing — the overlap metric's Tc/Tm inputs."""
+    from dlnetbench_tpu.ops.moe_dispatch import a2a_expert_ffn
+    mesh, ein, wg, wu, wd = _a2a_case()
+    full = _shardmap_ffn(
+        lambda e_, a, b, c: a2a_expert_ffn(e_, a, b, c, "tp")
+        .astype(e_.dtype), mesh)(ein, wg, wu, wd)
+    for kw in ({"fake_compute": True}, {"fake_comm": True}):
+        out = _shardmap_ffn(
+            lambda e_, a, b, c, _kw=kw: a2a_expert_ffn(
+                e_, a, b, c, "tp", **_kw).astype(e_.dtype),
+            mesh)(ein, wg, wu, wd)
+        assert out.shape == full.shape
+        assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_a2a_expert_ffn_rejects_flat_weights():
+    from dlnetbench_tpu.ops.moe_dispatch import a2a_expert_ffn
+    with pytest.raises(ValueError, match="E_local"):
+        a2a_expert_ffn(jnp.zeros((4, 2, 8)), jnp.zeros((8, 16)),
+                       jnp.zeros((8, 16)), jnp.zeros((16, 8)), "tp")
+
+
+# --------------------------------------------------------- SPMD step
+def test_spmd_moe_knob_validation():
+    from dlnetbench_tpu.models import spmd
+    with pytest.raises(ValueError, match="moe_a2a"):
+        spmd.SpmdConfig(moe_a2a="ring").validate(1, 1, 2)
+    with pytest.raises(ValueError, match="group_tokens"):
+        spmd.SpmdConfig(moe_group_tokens=12).validate(1, 1, 2)
+    with pytest.raises(ValueError, match="grouped"):
+        spmd.SpmdConfig(moe_ffn_quant="int8").validate(1, 1, 2)
+    with pytest.raises(ValueError, match="quant"):
+        spmd.SpmdConfig(mlp_int8=True,
+                        moe_ffn_impl="grouped").validate(1, 1, 2)
+
+
+def test_spmd_moe_decomposed_parity(eight_devices):
+    """The dryrun bar as a test: decomposed a2a (and the grouped FFN)
+    produce the SAME training step as the monolithic einsum baseline
+    under seeded grouped routing at finite capacity."""
+    import dataclasses
+
+    from dlnetbench_tpu.models import spmd
+    cfg0 = spmd.SpmdConfig(batch=8, num_microbatches=2,
+                           capacity_factor=1.0, moe_drop_seed=11,
+                           moe_group_tokens=8)
+    mesh, cfg0, step0, params, tokens = spmd.build(8, cfg0)
+    p0, l0 = step0(params, tokens)
+    for kw in (dict(moe_a2a="decomposed", moe_chunks=2),
+               dict(moe_ffn_impl="grouped")):
+        cfg_x = dataclasses.replace(cfg0, **kw)
+        step_x = spmd.make_train_step(mesh, cfg_x)
+        px, lx = step_x(params, tokens)
+        assert abs(float(lx) - float(l0)) <= 1e-4 * max(
+            1.0, abs(float(l0))), kw
+        dmax = max(float(jnp.max(jnp.abs(
+            a.astype(_F32) - b.astype(_F32))))
+            for a, b in zip(jax.tree.leaves(px), jax.tree.leaves(p0)))
+        assert dmax <= 1e-4, (kw, dmax)
+
+
+def test_spmd_moe_decomposed_variants_run(eight_devices):
+    """The A/B decomposition legs of the decomposed-MoE step compile
+    and execute (the overlap-fraction metric's inputs)."""
+    from dlnetbench_tpu.models import spmd
+    cfg = spmd.SpmdConfig(batch=8, num_microbatches=2,
+                          moe_a2a="decomposed")
+    mesh, cfg, _, params, tokens = spmd.build(8, cfg)
+    for variant in ("compute", "comm"):
+        step = spmd.make_train_step(mesh, cfg, variant=variant)
+        out = step(params, tokens)
+        jax.block_until_ready(out)
+
+
+# ------------------------------------------------- schedule parity
+def test_a2a_elems_matches_native_schedule():
+    """Native-vs-SPMD MoE schedule parity (the satellite): the twin
+    helper restates core/schedule.moe_schedule's a2a arithmetic — the
+    formula the native hybrid_3d_moe proxy declares and moves — and
+    the JAX tier's ACTUAL dispatch buffer equals it at dp=1, cf=1."""
+    from dlnetbench_tpu.core.model_card import load_model_card
+    from dlnetbench_tpu.core.model_stats import load_model_stats
+    from dlnetbench_tpu.core.schedule import moe_schedule
+    stats = load_model_stats("mixtral_8x7b_16_bfloat16")
+    card = load_model_card("mixtral_8x7b")
+    for ep in (2, 4):
+        sched = moe_schedule(stats, card, num_stages=4,
+                             num_microbatches=2, num_expert_shards=ep)
+        tokens_per_mb = (stats.batch_size // 2) * stats.seq_len
+        assert sched.a2a_elems == moe.a2a_elems_per_rank(
+            tokens_per_mb, card.top_k, stats.embed_dim, ep)
+        # 2 a2as (dispatch+combine) per MoE layer per direction
+        assert sched.a2a_per_direction == 2 * (card.num_layers // 4)
+
+
+def test_spmd_dispatch_buffer_matches_twin():
+    """The twin arithmetic against the REAL dispatch buffer: at dp=1
+    and capacity_factor=1 the [E, C, d] buffer _moe_block hands the EP
+    all-to-all holds exactly the native message's elements."""
+    from dlnetbench_tpu.models import spmd
+    cfg = spmd.SpmdConfig(batch=4, num_microbatches=2, seq_len=32,
+                          num_experts=4, top_k=2, capacity_factor=1.0,
+                          embed_dim=64)
+    tp = 2
+    t_loc = (cfg.batch // (1 * cfg.num_microbatches)) * \
+        (cfg.seq_len // tp)
+    x, wr = _routing_case(t=t_loc, d=cfg.embed_dim)
+    xe, _, _ = moe.dispatch(x, wr, cfg.num_experts, cfg.top_k,
+                            cfg.capacity_factor)
+    assert xe.size == moe.spmd_a2a_elems(cfg, dp=1, tp=tp)
+    # the native formula over this rank's token share (ep == tp, the
+    # per-rank tokens are the global microbatch over dp*tp)
+    assert xe.size == moe.a2a_elems_per_rank(
+        t_loc * tp, cfg.top_k, cfg.embed_dim, tp)
+
+
+def test_bandwidth_moe_columns():
+    """A record carrying the moe global surfaces expert_imbalance /
+    moe_drop_rate on its bandwidth rows; dense records get NaN."""
+    pd = pytest.importorskip("pandas")  # noqa: F841
+    from dlnetbench_tpu.analysis.bandwidth import (bandwidth_summary,
+                                                   effective_bandwidth)
+    rec = {
+        "section": "t", "num_runs": 1,
+        "global": {"model": "m", "comm_model": {
+            "ep_comm_time": [{"kind": "alltoall", "group": 2,
+                              "bytes": 1024}]},
+            "moe": {"load_imbalance": 2.5, "drop_rate": 0.1}},
+        "mesh": {"platform": "cpu"},
+        "ranks": [{"rank": 0, "ep_comm_time": [100.0]}],
+    }
+    bw = effective_bandwidth([rec])
+    assert float(bw["expert_imbalance"].iloc[0]) == 2.5
+    assert float(bw["moe_drop_rate"].iloc[0]) == 0.1
+    summ = bandwidth_summary([rec])
+    assert "expert_imbalance" in summ.columns
+    clean = dict(rec, **{"global": {"model": "m",
+                                    "comm_model": rec["global"]
+                                    ["comm_model"]}})
+    bw2 = effective_bandwidth([clean])
+    assert np.isnan(float(bw2["expert_imbalance"].iloc[0]))
+
+
+def test_merge_moe_volatile():
+    """The measured moe block is per-process state, never run
+    identity: _comparable_global drops it, so differently-imbalanced
+    hosts merge."""
+    from dlnetbench_tpu.metrics.merge import _comparable_global
+    g = {"model": "m", "moe": {"load_imbalance": 2.0},
+         "moe_experts": 8}
+    out = _comparable_global(g)
+    assert "moe" not in out
+    assert out["moe_experts"] == 8   # the KNOB stays comparable
+
+
+@pytest.mark.tuning
+def test_tune_cli_grouped_ffn_e2e(tmp_path, monkeypatch):
+    """search -> commit -> consult -> hit on a tiny CPU shape, keys
+    built by the same builders the site consults."""
+    from dlnetbench_tpu import tuning
+    from dlnetbench_tpu.tuning.__main__ import main
+    tuning.reset(clear_env=True)
+    try:
+        rc = main(["tune", "--op", "grouped_ffn", "--db",
+                   str(tmp_path), "--experts", "2", "--capacity", "8",
+                   "--d", "16", "--n", "16", "--fmt", "none",
+                   "--candidates", "4,8,8;8,16,16", "--k", "2",
+                   "--rounds", "2"])
+        assert rc == 0
+        monkeypatch.setenv(tuning.params.ENV_DB_DIR, str(tmp_path))
+        tuning.reset()
+        x, wg, wu, wd = _gm_case(e=2, c=8, d=16, h=16)
+        gm.grouped_ffn(x, wg, wu, wd)
+        prov = tuning.provenance()
+        key = tuning.params.grouped_ffn_key(2, 8, 16, 16, "none",
+                                            x.dtype)
+        assert prov["sites"][f"grouped_ffn|{key}"]["hit"]
+    finally:
+        tuning.reset(clear_env=True)

@@ -1,0 +1,245 @@
+"""MoE decode in the serving tier (ISSUE 15 tentpole leg d):
+per-expert token batching with overflow rounds, greedy token parity
+against the training forward, the fused multi-step loop's accumulated
+imbalance stats, the seeded skew injection, flight-ring telemetry,
+and the record/parser/summary pathway."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlnetbench_tpu.models import transformer as tfm
+from dlnetbench_tpu.serving import moe_decode as MD
+from dlnetbench_tpu.serving.arrivals import ArrivalPlan
+from dlnetbench_tpu.serving.scheduler import Engine, ServingConfig
+
+pytestmark = [pytest.mark.moe, pytest.mark.serving]
+
+_F32 = jnp.float32
+
+
+def moe_model(**over) -> tfm.TransformerConfig:
+    kw = dict(vocab_size=64, embed_dim=32, num_heads=4, num_kv_heads=2,
+              ff_dim=64, num_layers=2, seq_len=32, gated=True,
+              max_positions=0, dtype="float32", num_experts=4,
+              top_k=2, moe_capacity_factor=1.0)
+    kw.update(over)
+    return tfm.TransformerConfig(**kw)
+
+
+def moe_serving(**over) -> ServingConfig:
+    kw = dict(slots=4, page_size=4, num_pages=64, max_seq_len=32,
+              warmup_requests=0)
+    kw.update(over)
+    return ServingConfig(**kw)
+
+
+def tiny_plan(n=6, seed=0) -> ArrivalPlan:
+    return ArrivalPlan(kind="poisson", rate_rps=200.0, num_requests=n,
+                       seed=seed, prompt_len=(4, 8), output_len=(4, 6))
+
+
+# -------------------------------------------------- the MLP itself
+def test_moe_mlp_rounds_lossless_vs_dense_math():
+    """Whatever the round count, the result is the top-k gated sum —
+    compare against the direct (unbatched) per-token computation at a
+    capacity that FORCES multiple rounds."""
+    b, d, e, h, k = 8, 16, 4, 24, 2
+    x = jax.random.normal(jax.random.key(0), (b, d), _F32)
+    wr = jax.random.normal(jax.random.key(1), (d, e), _F32)
+    wg = jax.random.normal(jax.random.key(2), (e, d, h), _F32) * 0.1
+    wu = jax.random.normal(jax.random.key(3), (e, d, h), _F32) * 0.1
+    wd = jax.random.normal(jax.random.key(4), (e, h, d), _F32) * 0.1
+    y, load, rounds = MD.moe_mlp_rounds(x, wr, wg, wu, wd, top_k=k,
+                                        capacity=1)
+    assert int(rounds) >= 2            # capacity 1 forces overflow
+    assert int(load.sum()) == b * k
+    # unbatched reference
+    from dlnetbench_tpu.models import layers as L
+    logits = L.router_logits(x, wr)
+    tv, idx = jax.lax.top_k(logits, k)
+    w = jax.nn.softmax(tv, axis=-1)
+    ref = np.zeros((b, d), np.float32)
+    for t in range(b):
+        for j in range(k):
+            ei = int(idx[t, j])
+            xe = x[t][None]
+            hh = (jax.nn.silu(xe @ wg[ei]) * (xe @ wu[ei]))
+            ref[t] += float(w[t, j]) * np.asarray(hh @ wd[ei])[0]
+    assert np.abs(np.asarray(y) - ref).max() < 1e-4
+
+
+def test_moe_mlp_rounds_inactive_masked():
+    b, d, e = 4, 16, 4
+    x = jax.random.normal(jax.random.key(0), (b, d), _F32)
+    wr = jax.random.normal(jax.random.key(1), (d, e), _F32)
+    wg = jax.random.normal(jax.random.key(2), (e, d, 8), _F32)
+    wu = jax.random.normal(jax.random.key(3), (e, d, 8), _F32)
+    wd = jax.random.normal(jax.random.key(4), (e, 8, d), _F32)
+    active = jnp.array([True, False, True, False])
+    y, load, rounds = MD.moe_mlp_rounds(x, wr, wg, wu, wd, top_k=1,
+                                        capacity=4, active=active)
+    assert int(load.sum()) == 2        # inactive rows occupy nothing
+    assert float(jnp.abs(y[1]).max()) == 0.0
+    assert float(jnp.abs(y[3]).max()) == 0.0
+    # no active rows: zero rounds, the loop never trips
+    _, load0, rounds0 = MD.moe_mlp_rounds(
+        x, wr, wg, wu, wd, top_k=1, capacity=4,
+        active=jnp.zeros((b,), bool))
+    assert int(rounds0) == 0 and int(load0.sum()) == 0
+
+
+def test_skew_bias_seeded_and_off():
+    assert MD.skew_bias(4, 0.0, 3) is None
+    b1 = MD.skew_bias(4, 10.0, 3)
+    b2 = MD.skew_bias(4, 10.0, 3)
+    b3 = MD.skew_bias(4, 10.0, 4)
+    assert jnp.all(b1 == b2)
+    assert not jnp.all(b1 == b3)
+
+
+# ------------------------------------------------------- the engine
+def test_moe_decode_token_parity_vs_forward():
+    """The serving acceptance anchor, MoE form: prefill+decode over
+    the paged cache greedy-decodes the SAME tokens as iterated full
+    forwards of the identical MoE model."""
+    mcfg = moe_model()
+    eng = Engine(mcfg, moe_serving())
+    plan = tiny_plan()
+    reqs = plan.sample()
+    eng.run(reqs)
+    from dlnetbench_tpu.serving.decode import prompt_tokens_for
+    for r in reqs[:3]:
+        toks = list(prompt_tokens_for(r, mcfg.vocab_size))
+        ref = []
+        for _ in range(r.output_len):
+            logits = tfm.forward(eng.params, jnp.asarray([toks]), mcfg)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            ref.append(nxt)
+            toks.append(nxt)
+        assert eng.token_streams[r.rid] == ref, r.rid
+
+
+def test_moe_fused_loop_token_parity_and_stats():
+    """N-step fused MoE decode == 1-step MoE decode token for token,
+    and the loop's ACCUMULATED imbalance stats arrive at the host."""
+    mcfg = moe_model()
+    plan = tiny_plan()
+    eng1 = Engine(mcfg, moe_serving())
+    eng1.run(plan.sample())
+    engN = Engine(mcfg, moe_serving(multi_step_n=4))
+    engN.run(plan.sample())
+    for rid, stream in eng1.token_streams.items():
+        assert engN.token_streams[rid] == stream, rid
+    blk = engN.moe_block()
+    assert blk["dispatches"] > 0
+    assert blk["rounds_mean"] > 0
+    assert len(blk["expert_load"]) == 4
+
+
+def test_moe_skew_increases_rounds_and_imbalance():
+    """The study's mechanism: the seeded router skew concentrates load
+    (imbalance up) and overflows the per-round capacity (rounds up) on
+    the SAME arrival plan."""
+    mcfg = moe_model(num_experts=8, top_k=1, seq_len=64)
+    plan = ArrivalPlan(kind="poisson", rate_rps=400.0, num_requests=10,
+                       seed=0, prompt_len=(4, 8), output_len=(6, 10))
+    balanced = Engine(mcfg, moe_serving(slots=8, num_pages=160))
+    balanced.run(plan.sample())
+    skewed = Engine(mcfg, moe_serving(slots=8, num_pages=160,
+                                      moe_skew=50.0, moe_skew_seed=1))
+    skewed.run(plan.sample())
+    b, s = balanced.moe_block(), skewed.moe_block()
+    assert s["load_imbalance"] > b["load_imbalance"]
+    assert s["rounds_mean"] > b["rounds_mean"]
+    # k=1 full concentration: every token on one expert
+    assert s["load_imbalance"] == pytest.approx(8.0)
+
+
+def test_moe_quantized_cache_composes():
+    """MoE decode over an int8 paged cache: the MLP path and the
+    cache quantization are orthogonal; tokens still complete."""
+    mcfg = moe_model()
+    eng = Engine(mcfg, moe_serving(cache_dtype="int8"))
+    done, _ = eng.run(tiny_plan(n=4).sample())
+    assert len(done) == 4
+
+
+def test_moe_speculative_refused():
+    with pytest.raises(ValueError, match="[Mm]o[eE]"):
+        Engine(moe_model(),
+               moe_serving(speculative=True, multi_step_n=2))
+    from dlnetbench_tpu.serving.speculative import check_spec_config
+    with pytest.raises(ValueError, match="MoE"):
+        check_spec_config(moe_model(), spec_k=2, drafter="ngram",
+                          drafter_layers=1)
+
+
+def test_moe_skew_validation():
+    with pytest.raises(ValueError, match="moe_skew"):
+        moe_serving(moe_skew=-1.0).validate()
+
+
+def test_moe_telemetry_fields():
+    """With the flight recorder armed, engine-step samples carry the
+    expert-imbalance telemetry (moe_rounds / moe_imbalance)."""
+    from dlnetbench_tpu.metrics import telemetry
+    rec = telemetry.enable(capacity=256)
+    try:
+        eng = Engine(moe_model(), moe_serving())
+        eng.run(tiny_plan(n=3).sample())
+    finally:
+        telemetry.disable()
+    samples = [s for s in rec.samples() if "moe_rounds" in s]
+    assert samples, "no engine-step sample carried moe telemetry"
+    assert all(s["moe_imbalance"] >= 1.0 for s in samples)
+
+
+def test_moe_record_parser_summary_pathway():
+    """run_serving -> record: the measured moe block + comparable
+    knobs ride the globals, the parser hoists moe_* columns, and
+    serving_summary carries skew/imbalance/rounds."""
+    pytest.importorskip("pandas")
+    import io
+
+    from dlnetbench_tpu.analysis.bandwidth import serving_summary
+    from dlnetbench_tpu.metrics.emit import emit_result
+    from dlnetbench_tpu.metrics.parser import records_to_dataframe
+    from dlnetbench_tpu.serving.scheduler import run_serving
+    mcfg = moe_model()
+    res = run_serving(mcfg, moe_serving(moe_skew=10.0, moe_skew_seed=2,
+                                        warmup_requests=0),
+                      tiny_plan(n=4))
+    rec = emit_result(res, stream=io.StringIO())
+    g = rec["global"]
+    assert g["moe"]["dispatches"] > 0
+    assert g["serving_config"]["moe_skew"] == 10.0
+    df = records_to_dataframe([rec])
+    assert float(df["moe_load_imbalance"].iloc[0]) >= 1.0
+    assert float(df["moe_rounds_mean"].iloc[0]) > 0
+    assert "moe_expert_load_max" in df.columns
+    summ = serving_summary([rec])
+    assert float(summ["moe_skew"].iloc[0]) == 10.0
+    assert float(summ["expert_imbalance"].iloc[0]) >= 1.0
+    assert float(summ["moe_rounds_mean"].iloc[0]) > 0
+
+
+def test_moe_crash_shrink_composes():
+    """A crash+shrink fault plan on a MoE engine: the rebuilt engine
+    keeps serving MoE (the moe block survives segmentation) and every
+    request completes."""
+    from dlnetbench_tpu.faults.plan import FaultPlan
+    mcfg = moe_model()
+    scfg = moe_serving(slots=4, world=2, warmup_requests=0)
+    plan = tiny_plan(n=6)
+    fplan = FaultPlan.from_dict({
+        "policy": "shrink",
+        "events": [{"kind": "crash", "ranks": [1], "iteration": 3}]})
+    from dlnetbench_tpu.serving.scheduler import run_serving
+    res = run_serving(mcfg, scfg, plan, fault_plan=fplan)
+    g = res.global_meta
+    assert g["serving"]["completed"] == 6
+    assert g.get("degraded_world") == [0]
+    assert g["moe"]["dispatches"] > 0

@@ -124,6 +124,34 @@ def test_native_schedule_matches_python(native_bin):
     assert rec["global"]["schedule_bucket_bytes"] == sched.bucket_bytes
 
 
+@pytest.mark.moe
+def test_native_moe_a2a_matches_jax_twin(native_bin):
+    """Native-vs-SPMD MoE schedule parity (ISSUE 15 satellite): the
+    a2a bytes/step the native hybrid_3d_moe RECORD declares equal the
+    JAX twin's arithmetic (models/moe.a2a_elems_per_rank — the same
+    formula the twin's actual [E, C, d] dispatch buffer realizes at
+    cf=1, pinned buffer-vs-formula by tests/test_moe.py)."""
+    from dlnetbench_tpu.core.model_card import load_model_card
+    from dlnetbench_tpu.core.model_stats import load_model_stats
+    from dlnetbench_tpu.models import moe as moe_mod
+
+    ep, mbs = 2, 4
+    rec = run_proxy(native_bin, "hybrid_3d_moe", "--num_stages", 4,
+                    "--num_microbatches", mbs, "--num_expert_shards",
+                    ep, model="mixtral_8x7b_16_bfloat16", world=8)
+    stats = load_model_stats("mixtral_8x7b_16_bfloat16")
+    card = load_model_card("mixtral_8x7b")
+    tokens_per_mb = (stats.batch_size // mbs) * stats.seq_len
+    twin = moe_mod.a2a_elems_per_rank(tokens_per_mb, card.top_k,
+                                      stats.embed_dim, ep)
+    # the native record scales sizes (harness.hpp scale_count: floor,
+    # min 1) — undo the dev-box scaling to compare the declared
+    # full-size message against the twin formula
+    scale = rec["global"]["size_scale"]
+    elems = rec["global"]["a2a_bytes"] // 2  # bf16 itemsize
+    assert elems == max(1, int(twin * scale))
+
+
 def test_native_reads_reference_stats_files(native_bin, tmp_path):
     """Keyed parsing survives the reference's drifted committed files
     (lowercase ``non_expert_size``, SURVEY.md §7.4) — point the binary at a

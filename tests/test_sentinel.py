@@ -479,3 +479,35 @@ def test_kv_density_line_is_comparable():
         "headline": _line(10.0, [9.9, 10.1]),
         "kv_density_ab": density_line(93.0, [87.0, 96.0])})
     assert ok["verdict"] == "clean"
+
+
+def test_moe_ab_line_is_comparable():
+    """The moe_ab aux line (ISSUE 15) rides the headline like every ms
+    line and the sentinel judges it band-aware lower-is-better: a MoE
+    step that got slower past threshold with disjoint bands is a
+    regression; band-overlapping wobble is noise; old baselines
+    without the line still compare clean."""
+    def moe_line(value, band):
+        return {"metric": "moe A/B: dense FFN vs 8-expert MoE",
+                "value": value, "unit": "ms", "best": band[0],
+                "band": band, "n": 3,
+                "dense_ms": {"value": value / 1.5,
+                             "best": band[0] / 1.5,
+                             "band": [b / 1.5 for b in band], "n": 3}}
+
+    assert sentinel.is_ms_line(moe_line(15.0, [14.0, 16.0]))
+    base = {"headline": _line(10.0, [9.9, 10.1]),
+            "moe_ab": moe_line(15.0, [14.0, 16.0])}
+    cur = {"headline": _line(10.0, [9.9, 10.1]),
+           "moe_ab": moe_line(30.0, [29.0, 31.0])}
+    sent = sentinel.check(base, cur)
+    assert sent["verdict"] == "regression"
+    assert sent["regressions"] == ["moe_ab"]
+    ok = sentinel.check(base, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "moe_ab": moe_line(15.5, [14.4, 16.5])})
+    assert ok["verdict"] == "clean"
+    # a baseline predating the line compares clean (new line ignored)
+    old = sentinel.check({"headline": _line(10.0, [9.9, 10.1])},
+                         cur)
+    assert old["verdict"] == "clean"

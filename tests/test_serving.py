@@ -343,8 +343,9 @@ def test_decode_rejects_unsupported_configs():
     from dlnetbench_tpu.serving.decode import check_config
     with pytest.raises(ValueError, match="gated"):
         check_config(tiny_model(gated=False, max_positions=32))
-    with pytest.raises(ValueError, match="gated"):
-        check_config(tiny_model(num_experts=4, top_k=2))
+    # MoE models are SUPPORTED since ISSUE 15 (the per-expert batched
+    # decode path); only non-gated MoE keeps refusing
+    check_config(tiny_model(num_experts=4, top_k=2))
 
 
 # ---------------------------------------------------------------------
