@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test check-bench check-resilience check-serving check-tuning \
 	check-longcontext check-decode check-density check-telemetry \
-	check-moe sentinel-scan
+	check-moe check-disagg sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -138,6 +138,20 @@ check-moe:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
 	    tests/test_bench_aux.py::test_moe_ab_line_schema_locked \
 	    tests/test_sentinel.py::test_moe_ab_line_is_comparable
+
+# the disaggregated-serving lane (ISSUE 16, docs/SERVING.md
+# "Disaggregated prefill/decode"): the page-migration channel's
+# bit-exact quantized wire + closed-form byte accounting + overlap-leg
+# discipline, the replica config guards, the adaptive-N migration-ETA
+# cap, int8 token parity vs the monolithic engine, the committed
+# two-replica record fixture round trip, and the disagg_ab bench-line
+# schema.  The bf16 parity and prefill-crash e2e cases ride the slow
+# lane (pytest -m 'disagg and slow').  ~30s wall.
+check-disagg:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m 'disagg and not slow' \
+	    tests/test_disagg.py
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
+	    tests/test_bench_aux.py::test_disagg_line_schema_locked
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

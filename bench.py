@@ -541,6 +541,7 @@ def _run_bench(args, tracer) -> int:
         fp8 = fp8_chain = int8 = int8_ab = fp8_ab = None
         straggler = ckpt_ab = int8_step = int8_sb = overlap_ab = None
         serving = tuned_ab = longcontext = kv_density = moe_ab = None
+        disagg_ab = None
     else:
         fp8 = _aux("fp8 mlp matmul", _bench_fp8_mlp, card, hw_key, dev)
         fp8_chain = _aux("fp8 swiglu chain", _bench_fp8_swiglu_chain,
@@ -572,6 +573,10 @@ def _run_bench(args, tracer) -> int:
         # engines at EQUAL pool bytes — admitted concurrency, tokens/s
         # and the per-recipe decode-parity bars
         kv_density = _aux("kv density A/B", _bench_kv_density)
+        # the ISSUE-16 disaggregation evidence: monolithic vs split
+        # prefill/decode meshes at equal chips on one seeded plan —
+        # two tiny engines + the migration channel, one compile each
+        disagg_ab = _aux("disagg A/B", _bench_disagg_ab)
         # the ISSUE-10 long-context evidence: dense-vs-splash paired
         # rounds at S=64k under causal/window/segment masks — four
         # attention-only compiles, bounded by the shared aux deadline
@@ -638,6 +643,7 @@ def _run_bench(args, tracer) -> int:
         **({"checkpoint_ab": ckpt_ab} if ckpt_ab else {}),
         **({"serving_decode": serving} if serving else {}),
         **({"kv_density_ab": kv_density} if kv_density else {}),
+        **({"disagg_ab": disagg_ab} if disagg_ab else {}),
         **({"longcontext_ab": longcontext} if longcontext else {}),
         **({"moe_ab": moe_ab} if moe_ab else {}),
         **({"spmd_overlap_ab": overlap_ab} if overlap_ab else {}),
@@ -992,6 +998,141 @@ def _bench_serving_decode(live_path: str | None = None) -> dict | None:
                f"N={n_fused}+spec, {dev.device_kind}",
         multi_rounds=rounds["multi_step"],
         spec_rounds=rounds["speculative"], token_parity=parity)
+    print(json.dumps(line))
+    return line
+
+
+def _disagg_line(mono_rounds: list[dict], dis_rounds: list[dict],
+                 suffix: str = "", *,
+                 token_parity: bool | None = None) -> dict:
+    """Assemble the disagg_ab aux line from paired per-round
+    ``serving`` blocks (pure — tests/test_bench_aux.py locks this
+    schema).  The headline ``value`` is the DISAGGREGATED engine's
+    round-median e2e p99 in ms (lower is better, sentinel-comparable
+    like the serving_decode line); both arms ship artifact-grade
+    ``{value, best, band, n}`` bands for TTFT p50/p99 and TPOT p50,
+    the migration wire cost rides as bytes + per-send p50 ms bands,
+    and the verdict is the interference question: did splitting the
+    meshes pull decode TPOT below the monolithic band, bands
+    disjoint?"""
+    def _bands(rounds: list[dict]) -> dict:
+        return {
+            "ttft_p50_ms": stats_mod.summarize(
+                [r["ttft_ms"]["p50"] for r in rounds], ndigits=3),
+            "ttft_p99_ms": stats_mod.summarize(
+                [r["ttft_ms"]["p99"] for r in rounds], ndigits=3),
+            "tpot_p50_ms": stats_mod.summarize(
+                [r["tpot_ms"]["p50"] for r in rounds], ndigits=3),
+            "tokens_per_s": stats_mod.summarize(
+                [r["tokens_per_s"] for r in rounds], ndigits=2),
+        }
+    mono, dis = _bands(mono_rounds), _bands(dis_rounds)
+    migs = [r.get("migration") or {} for r in dis_rounds]
+    dis["migration_bytes"] = stats_mod.summarize(
+        [float(m.get("bytes", 0)) for m in migs], ndigits=1)
+    dis["migration_ms_p50"] = stats_mod.summarize(
+        [float((m.get("ms") or {}).get("p50", float("nan")))
+         for m in migs], ndigits=3)
+    dis["migration_bytes_ratio"] = migs[0].get("bytes_ratio_vs_bf16")
+    p99 = stats_mod.summarize(
+        [r["e2e_ms"]["p99"] for r in dis_rounds], ndigits=3)
+    disjoint = (stats_mod.bands_overlap(
+        mono["tpot_p50_ms"]["band"], dis["tpot_p50_ms"]["band"])
+        is False
+        and dis["tpot_p50_ms"]["value"] < mono["tpot_p50_ms"]["value"])
+    line = {
+        "metric": f"disagg_ab: monolithic vs disaggregated "
+                  f"prefill/decode at equal chips, same seeded "
+                  f"saturating plan (serving/disagg){suffix}",
+        "value": p99["value"],
+        "unit": "ms",
+        "best": p99["best"],
+        "band": p99["band"],
+        "n": p99["n"],
+        "monolithic": mono,
+        "disaggregated": dis,
+        "tpot_band_disjoint_drop": disjoint,
+        "verdict": ("decode TPOT dropped, bands disjoint — the "
+                    "prefill mesh's interference left the decode "
+                    "replica" if disjoint else
+                    "TPOT bands overlap — no interference flip at "
+                    "this scale/noise"),
+    }
+    if token_parity is not None:
+        line["token_parity"] = bool(token_parity)
+    return stats_mod.flag_low_mode(line)
+
+
+def _bench_disagg_ab() -> dict | None:
+    """The ISSUE-16 A/B: a monolithic engine and a disaggregated
+    prefill+decode pair — SAME weights, SAME chip count (world=2),
+    SAME seeded saturating poisson plan — interleaved per round (r4
+    pairing).  int8 KV on both arms so the migration channel carries
+    the quantized wire the tentpole prices; the token-parity lock
+    compares the full greedy streams."""
+    import dataclasses
+
+    from dlnetbench_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+    from dlnetbench_tpu.serving import metrics as smetrics
+    from dlnetbench_tpu.serving.arrivals import ArrivalPlan
+    from dlnetbench_tpu.serving.disagg import DisaggServer
+    from dlnetbench_tpu.serving.scheduler import Engine, ServingConfig
+
+    if len(jax.devices()) < 2:
+        return None  # the split needs two devices to mean anything
+    mc = TransformerConfig(
+        vocab_size=256, embed_dim=64, num_heads=4, num_kv_heads=2,
+        ff_dim=128, num_layers=2, seq_len=64, gated=True,
+        max_positions=0, dtype="float32")
+    # attn_impl pinned to gather for the same reason as serving_decode:
+    # the parity lock needs one attention basis on every backend
+    mono_cfg = ServingConfig(
+        slots=4, page_size=8, num_pages=48, max_seq_len=40,
+        slo_ttft_ms=250.0, slo_tpot_ms=100.0, attn_impl="gather",
+        cache_dtype="int8", multi_step_n=8, adaptive_n=True, world=2)
+    dis_cfg = dataclasses.replace(
+        mono_cfg, disaggregate=True, prefill_ranks=1, decode_ranks=1)
+    plan = ArrivalPlan(kind="poisson", rate_rps=5000.0,
+                       num_requests=8, seed=0, prompt_len=[8, 16],
+                       output_len=[16, 24])
+    params = init_params(jax.random.key(0), mc)
+    requests = plan.sample()
+    mono = Engine(mc, mono_cfg, params=params)
+    dis = DisaggServer(mc, dis_cfg, params=params)
+    mono.run(requests)  # warm round (first-dispatch), discarded
+    dis.run(requests)
+    mono_rounds, dis_rounds = [], []
+    streams = {}
+    for _ in range(3):
+        completed, wall = mono.run(requests)
+        streams["mono"] = dict(mono.token_streams)
+        mono_rounds.append(smetrics.serving_block(
+            completed, plan, slo_ttft_ms=mono_cfg.slo_ttft_ms,
+            slo_tpot_ms=mono_cfg.slo_tpot_ms, wall_s=wall,
+            engine_steps=mono.engine_steps,
+            cache_stats=mono.cache.stats(),
+            queue_depth_max=mono.queue_depth_max,
+            batch_occupancy_mean=mono.batch_occupancy_mean(),
+            decode_loop=mono.decode_loop_block()))
+        completed, wall = dis.run(requests)
+        streams["dis"] = dis.token_streams
+        dis_rounds.append(smetrics.serving_block(
+            completed, plan, slo_ttft_ms=mono_cfg.slo_ttft_ms,
+            slo_tpot_ms=mono_cfg.slo_tpot_ms, wall_s=wall,
+            engine_steps=dis.engine_steps(),
+            cache_stats=dis.decode.cache.stats(),
+            queue_depth_max=dis.prefill.queue_depth_max,
+            batch_occupancy_mean=dis.decode.batch_occupancy_mean(),
+            decode_loop=dis.decode.decode_loop_block(),
+            migration=dis.channel.stats_block()))
+    parity = streams["dis"] == streams["mono"]
+    dev = jax.devices()[0]
+    line = _disagg_line(
+        mono_rounds, dis_rounds,
+        suffix=f", {len(requests)} req slots={mono_cfg.slots} "
+               f"int8 KV, world=2 (1p+1d), {dev.device_kind}",
+        token_parity=parity)
     print(json.dumps(line))
     return line
 

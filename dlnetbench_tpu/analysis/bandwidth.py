@@ -404,6 +404,23 @@ def serving_summary(records: list[dict]):
             moe.get("load_imbalance", float("nan")))
         row["moe_rounds_mean"] = float(
             moe.get("rounds_mean", float("nan")))
+        # disaggregation provenance (ISSUE 16): the replica split and
+        # the migration wire cost ride every serving row — a Pareto
+        # table must say which rows paid a migration channel and which
+        # ran monolithic.  False / 0-ranks / NaN on monolithic and
+        # pre-disagg records.
+        row["disaggregated"] = bool(g.get("disaggregated", False))
+        row["prefill_ranks"] = int(cfg_srv.get("prefill_ranks", 0))
+        row["decode_ranks"] = int(cfg_srv.get("decode_ranks", 0))
+        mig = srv.get("migration") or {}
+        row["migration_bytes"] = float(
+            mig.get("bytes", float("nan")))
+        row["migration_bytes_ratio"] = float(
+            mig.get("bytes_ratio_vs_bf16", float("nan")))
+        ms = mig.get("ms") or {}
+        row["migration_ms_p50"] = float(ms.get("p50", float("nan")))
+        row["migration_overlap"] = float(
+            mig.get("overlap", float("nan")))
         rows.append(row)
     return pd.DataFrame(rows)
 

@@ -309,6 +309,46 @@ def blame_columns(record: dict) -> dict:
             "blame_frac": top["blame_frac"]}
 
 
+def prefill_stall_blame(record: dict) -> dict | None:
+    """Disaggregated serving (ISSUE 16): how much of the decode
+    replica's time the PREFILL side is to blame for — the migration
+    wall not hidden behind in-flight decode.  The monolithic engine's
+    interference shows up as inflated decode steps (this module's
+    per-rank blame can't separate it — one clock); the disaggregated
+    record decomposes it explicitly: ``exposed_ms`` is the migration
+    wall scaled by the UNhidden fraction of the measured overlap, and
+    ``stall_frac`` sets it against the decode device time.  None on
+    monolithic / pre-disagg records; ``exposed_ms`` is NaN when the
+    run never measured all three overlap legs (an unmeasured overlap
+    must not be scored as either 0 or 1)."""
+    g = record.get("global", {})
+    if not g.get("disaggregated"):
+        return None
+    srv = g.get("serving") or {}
+    mig = srv.get("migration")
+    if not isinstance(mig, dict):
+        return None
+    total_ms = float((mig.get("ms") or {}).get("total", 0.0))
+    ov = float(mig.get("overlap", float("nan")))
+    dl = srv.get("decode_loop") or {}
+    dev_ms = float((dl.get("decode_device_us") or {}
+                    ).get("total", 0.0)) / 1e3
+    if math.isnan(ov):
+        exposed = float("nan")
+        frac = float("nan")
+    else:
+        exposed = total_ms * (1.0 - min(max(ov, 0.0), 1.0))
+        frac = (exposed / (dev_ms + exposed)
+                if dev_ms + exposed > 0 else 0.0)
+    return {"migration_ms_total": round(total_ms, 3),
+            "migration_overlap": ov,
+            "exposed_ms": (round(exposed, 3)
+                           if not math.isnan(exposed) else exposed),
+            "decode_device_ms": round(dev_ms, 3),
+            "stall_frac": (round(frac, 4)
+                           if not math.isnan(frac) else frac)}
+
+
 # ---------------------------------------------------------------------
 # CLI: python -m dlnetbench_tpu.analysis.critical_path report ...
 

@@ -508,6 +508,22 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
                         "bf16 cache (~4x of the f32 CPU-mesh pools) "
                         "at a stated decode-parity tolerance "
                         "(docs/SERVING.md 'Cache density')")
+    p.add_argument("--disaggregate", action="store_true",
+                   help="disaggregated prefill/decode (ISSUE 16): "
+                        "split --world into a prefill mesh and a "
+                        "decode mesh on disjoint devices; finished "
+                        "prompts' KV pages migrate decode-ward in "
+                        "their stored dtype (migration bytes/ms/"
+                        "overlap stamped in the record; docs/"
+                        "SERVING.md 'Disaggregated prefill/decode')")
+    p.add_argument("--prefill_ranks", type=int, default=1,
+                   help="prefill-mesh ranks (with --disaggregate; "
+                        "prefill_ranks + decode_ranks = --world)")
+    p.add_argument("--decode_ranks", type=int, default=1,
+                   help="decode-mesh ranks (with --disaggregate)")
+    p.add_argument("--migration_chunk_pages", type=int, default=8,
+                   help="KV pages per migration chunk transfer "
+                        "(the PR-4 chunk-loop knob on the page wire)")
     p.add_argument("--prefix_sharing", action="store_true",
                    help="cross-request prefix sharing: requests whose "
                         "prompts share a prefix with a resident "
@@ -660,7 +676,11 @@ def _run_serve(args, parser) -> int:
         drafter=args.drafter, drafter_layers=args.drafter_layers,
         cache_dtype=args.cache_dtype,
         prefix_sharing=args.prefix_sharing,
-        moe_skew=args.moe_skew, moe_skew_seed=args.moe_skew_seed)
+        moe_skew=args.moe_skew, moe_skew_seed=args.moe_skew_seed,
+        disaggregate=args.disaggregate,
+        prefill_ranks=args.prefill_ranks,
+        decode_ranks=args.decode_ranks,
+        migration_chunk_pages=args.migration_chunk_pages)
     try:
         srv_cfg.validate()
         if srv_cfg.speculative:
@@ -678,9 +698,14 @@ def _run_serve(args, parser) -> int:
     import jax
     from dlnetbench_tpu.models.transformer import init_params
     params = init_params(jax.random.key(args.seed), model_cfg)
-    result = run_serving(model_cfg, srv_cfg, plan,
-                         fault_plan=fault_plan, params=params,
-                         live_metrics=args.live_metrics)
+    if srv_cfg.disaggregate:
+        from dlnetbench_tpu.serving.disagg import run_disagg
+        runner = run_disagg
+    else:
+        runner = run_serving
+    result = runner(model_cfg, srv_cfg, plan,
+                    fault_plan=fault_plan, params=params,
+                    live_metrics=args.live_metrics)
     if variables:
         result.global_meta["variables"] = variables
     record = emit_result(result, path=args.out)

@@ -101,6 +101,12 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      # comparable: differently-routed runs are
                      # different runs
                      "moe"}
+# NOT volatile, by design (ISSUE 16): the "disaggregated" global (and
+# the prefill_ranks/decode_ranks split inside serving_config) is run
+# IDENTITY — a disaggregated record must never merge with a monolithic
+# one, exactly like mismatched fault or arrival plans.  The migration
+# MEASUREMENTS (bytes/ms/overlap) ride inside the already-volatile
+# "serving" block.
 
 # scheduler-stamped variables that identify the PROCESS, not the run
 # (metrics.emit.scheduler_variables): they legitimately differ between

@@ -219,6 +219,24 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                         if isinstance(spec, dict):
                             row["serving_spec_acceptance"] = \
                                 spec.get("acceptance_rate")
+                    # ISSUE 16 disaggregation: the page-migration wire
+                    # accounting rides as plain columns so a Pareto
+                    # sweep grids by wire cost next to the latency
+                    # axes; monolithic/pre-disagg records simply lack
+                    # the block (the `disaggregated` global itself is
+                    # a plain scalar and hoists via the generic loop)
+                    mig = srv.get("migration")
+                    if isinstance(mig, dict):
+                        row["serving_migration_bytes"] = \
+                            mig.get("bytes")
+                        row["serving_migration_bytes_ratio"] = \
+                            mig.get("bytes_ratio_vs_bf16")
+                        row["serving_migration_overlap"] = \
+                            mig.get("overlap")
+                        ms = mig.get("ms")
+                        if isinstance(ms, dict):
+                            row["serving_migration_ms_p50"] = \
+                                ms.get("p50")
                 for tname, tvals in timers.items():
                     if run < len(tvals):
                         # singular column names a la reference ('runtime')

@@ -232,13 +232,17 @@ def serving_block(completed: list[Completed], plan: ArrivalPlan, *,
                   queue_depth_max: int = 0,
                   batch_occupancy_mean: float = 0.0,
                   decode_loop: dict | None = None,
-                  admitted_peak: int | None = None) -> dict:
+                  admitted_peak: int | None = None,
+                  migration: dict | None = None) -> dict:
     """The record's ``serving`` global: aggregate latency percentiles,
     throughput, and goodput-at-SLO for one run.  ``decode_loop``
     (ISSUE 11, ``Engine.decode_loop_block``) adds the dispatch
     decomposition — steps/tokens per host sync, priced host crossings,
     speculative acceptance — the attribution engine folds into the
-    host fraction (analysis/attribution.attribute_serving)."""
+    host fraction (analysis/attribution.attribute_serving).
+    ``migration`` (ISSUE 16, ``MigrationChannel.stats_block``) adds the
+    disaggregated run's page-migration wire accounting; absent on
+    monolithic runs so their records stay byte-identical."""
     ttft = [c.ttft_ms for c in completed]
     tpot = [c.tpot_ms for c in completed]
     e2e = [c.e2e_ms for c in completed]
@@ -273,6 +277,8 @@ def serving_block(completed: list[Completed], plan: ArrivalPlan, *,
         block["kv_cache"] = cache_stats
     if decode_loop:
         block["decode_loop"] = decode_loop
+    if migration:
+        block["migration"] = migration
     return block
 
 

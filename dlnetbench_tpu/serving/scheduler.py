@@ -138,6 +138,27 @@ class ServingConfig:
                                 # ride the measured latencies — the
                                 # run_proxy warmup discipline applied to
                                 # the serving loop
+    disaggregate: bool = False  # ISSUE 16: split the run into a
+                                # prefill replica and a decode replica
+                                # on DISJOINT device subsets
+                                # (serving/disagg.run_disagg) — prompts
+                                # prefill into the prefill replica's
+                                # local pool and the finished pages
+                                # migrate decode-ward in their stored
+                                # dtype.  COMPARABLE at merge: a
+                                # disaggregated record never merges
+                                # with a monolithic one
+    prefill_ranks: int = 1      # disaggregate: device ranks
+                                # [0, prefill_ranks) hold the prefill
+                                # replica (fault-shrink unit, like
+                                # world ranks on the monolithic engine)
+    decode_ranks: int = 1       # disaggregate: ranks [prefill_ranks,
+                                # world) hold the decode replica;
+                                # world must equal their sum
+    migration_chunk_pages: int = 8  # pages per migration-channel
+                                # chunk transfer (the PR-4 decomposed
+                                # chunk-loop granularity on the
+                                # page-migration wire)
 
     def validate(self) -> "ServingConfig":
         if self.prefill not in PREFILL_MODES:
@@ -157,7 +178,11 @@ class ServingConfig:
                 f"one max_seq_len request "
                 f"({self.max_seq_len // self.page_size} pages) — the "
                 f"admission gate would starve the queue head forever")
-        if self.slots % self.world:
+        if not self.disaggregate and self.slots % self.world:
+            # disaggregate replaces this with the per-replica rule
+            # below: each replica's fault-shrink unit is its OWN rank
+            # share, and world = prefill_ranks + decode_ranks need not
+            # divide the slot count (e.g. slots=4 on a 2p+1d world)
             raise ValueError("serving: slots must divide evenly across "
                              "world ranks (the fault-shrink unit)")
         if self.multi_step_n < 1:
@@ -194,6 +219,50 @@ class ServingConfig:
                 raise ValueError(
                     f"serving: unknown drafter {self.drafter!r} "
                     f"(one of {DRAFTERS})")
+        if self.disaggregate:
+            if self.prefill_ranks < 1 or self.decode_ranks < 1:
+                raise ValueError(
+                    "serving: disaggregate needs prefill_ranks >= 1 "
+                    "and decode_ranks >= 1 — each phase is a replica")
+            if self.world != self.prefill_ranks + self.decode_ranks:
+                raise ValueError(
+                    f"serving: disaggregate splits world into disjoint "
+                    f"replica meshes — world {self.world} must equal "
+                    f"prefill_ranks {self.prefill_ranks} + decode_ranks "
+                    f"{self.decode_ranks}")
+            if self.slots % self.prefill_ranks \
+                    or self.slots % self.decode_ranks:
+                raise ValueError(
+                    f"serving: disaggregate needs slots {self.slots} "
+                    f"divisible by prefill_ranks {self.prefill_ranks} "
+                    f"AND decode_ranks {self.decode_ranks} (each "
+                    f"replica's fault-shrink unit is its own rank "
+                    f"share)")
+            if self.speculative:
+                raise ValueError(
+                    "serving: speculative + disaggregate is refused — "
+                    "the draft/verify ngram state has no stated parity "
+                    "story across a page migration")
+            if self.prefix_sharing:
+                raise ValueError(
+                    "serving: prefix_sharing + disaggregate is refused "
+                    "— refcounted shared pages live in ONE pool and "
+                    "cannot migrate by reference across replicas")
+            if self.kv_shard > 1:
+                raise ValueError(
+                    "serving: kv_shard + disaggregate is refused — the "
+                    "migration channel moves single-device pools; a "
+                    "sharded pool would need a per-shard wire")
+            if self.prefill == "inline":
+                raise ValueError(
+                    "serving: disaggregate implies separate-phase "
+                    "prefill (the prefill replica has no decode slots "
+                    "to interleave with) — prefill='inline' is a "
+                    "contradiction, not a knob setting")
+            if self.migration_chunk_pages < 1:
+                raise ValueError(
+                    f"serving: migration_chunk_pages must be >= 1, "
+                    f"got {self.migration_chunk_pages}")
         return self
 
 
@@ -218,11 +287,21 @@ class Engine:
     recorded in ``global_meta``, never inside the measured loop); the
     KV page pools are donated and rebound functionally each call."""
 
+    # subclass hook (serving/disagg._PrefillReplica): a replica that
+    # never decodes skips building the decode program entirely —
+    # compile cost and pool-sized executable state must not ride a
+    # phase that will never dispatch it
+    _decode_needed = True
+
     def __init__(self, model_cfg: TransformerConfig,
                  cfg: ServingConfig, *, params=None, devices=None,
                  mesh=None):
         self.model_cfg = D.check_config(model_cfg)
         self.cfg = cfg.validate()
+        if cfg.disaggregate:
+            raise ValueError(
+                "serving: a disaggregated config drives TWO engines — "
+                "use serving/disagg.run_disagg, not Engine/run_serving")
         self.devices = (list(devices) if devices is not None
                         else jax.devices()[:max(cfg.world,
                                                 cfg.kv_shard)])
@@ -314,7 +393,7 @@ class Engine:
                 self._loop = executor.CompiledLoop(
                     loop_fn, self._loop_example_args(),
                     carry_argnums=carries)
-            else:
+            elif self._decode_needed:
                 self._decode = executor.CompiledStep(
                     D.make_decode_step(model_cfg, self.cache_cfg,
                                        attn_impl=cfg.attn_impl,
@@ -331,13 +410,16 @@ class Engine:
         decode_prog = self._loop if self._loop_mode else self._decode
         decode_name = "decode_loop" if self._loop_mode else "decode_step"
         self.meta["compile_ms"] = {
-            decode_name: decode_prog.stats["compile_ms"],
             "prefill_chunk": self._prefill.stats["compile_ms"]}
         self.meta["aot"] = {
-            decode_name: {k: v for k, v in decode_prog.stats.items()
-                          if k != "compile_ms"},
             "prefill_chunk": {k: v for k, v in self._prefill.stats.items()
                               if k != "compile_ms"}}
+        if decode_prog is not None:
+            self.meta["compile_ms"][decode_name] = \
+                decode_prog.stats["compile_ms"]
+            self.meta["aot"][decode_name] = {
+                k: v for k, v in decode_prog.stats.items()
+                if k != "compile_ms"}
         # live windowed metrics stream (serving/metrics.LiveMetricsWriter
         # or None) — attached by bench --live-metrics / run_serving;
         # survives _reset_state so a warm round and the measured run
@@ -502,6 +584,12 @@ class Engine:
         self._moe_pending: list[tuple] = []
         self._moe_last: dict = {}
         self._step_ewma_s = 0.0
+        # disaggregation (ISSUE 16): the driver sets this to the
+        # engine-clock second the next migrated sequence is expected
+        # to arrive; _pick_n_steps caps the fused trip count so a
+        # handoff never waits out a full N-step loop.  None (always,
+        # on a monolithic engine) keeps _pick_n_steps bit-identical.
+        self._migration_eta_s: float | None = None
         self._n_scalars: dict[int, jax.Array] = {}
         # flight recorder (ISSUE 14): refreshed per run; None (the
         # default) keeps the engine step bit-identical and
@@ -600,9 +688,11 @@ class Engine:
             # output) so a running sequence can never OOM mid-decode.
             # With prefix sharing the plan charges only UNSHARED pages
             # (fully-matched prefix pages map by reference; the
-            # divergence page's copy-on-write copy is pre-charged)
+            # divergence page's copy-on-write copy is pre-charged).
+            # A disaggregated prefill replica overrides the token
+            # count to prompt-only — its pool never decodes.
             plan = self.cache.plan_admission(
-                req.prompt_len + req.output_len,
+                self._admission_tokens(req),
                 prompt if self.cfg.prefix_sharing else None)
             if plan.need_pages > self.cache.free_pages:
                 break  # FIFO: do not starve the head by admitting later
@@ -629,6 +719,14 @@ class Engine:
                 while self.slots[i] is not None \
                         and st.prefill_done < req.prompt_len:
                     self._prefill_one(i, st)
+
+    def _admission_tokens(self, req: Request) -> int:
+        """Tokens to reserve pages for at admission — the worst case
+        (prompt + output).  The disaggregated prefill replica overrides
+        this to ``prompt_len``: decode happens on the OTHER replica's
+        pool, and reserving output pages here would halve the prefill
+        pool's admission capacity for nothing."""
+        return req.prompt_len + req.output_len
 
     def _prompt_of(self, req: Request):
         """Request -> prompt tokens, memoized: a blocked queue head is
@@ -742,6 +840,55 @@ class Engine:
                  block_row=self.cache.block_tables[slot],
                  ngram_row=ngram_row)
 
+    def admit_prefilled(self, req: Request, *, last_token: int,
+                        admitted_s: float, first_token_s: float,
+                        generated: int, pending_send,
+                        channel) -> bool:
+        """Disaggregation (ISSUE 16): admit a sequence whose prompt was
+        prefilled on the OTHER replica.  Reserves the worst case
+        (prompt + output) like any admission, rebuilds lengths/block
+        tables to exactly the monolithic post-prefill state
+        (``lengths = prompt_len``; the first generated token is NOT
+        cached — decode writes it at position prompt_len, same as
+        ``_prefill_one``'s contract), scatters the migrated pages into
+        this pool's allocation, and seeds the decode slot.  The stamps
+        (arrival, admission, TTFT) travel WITH the sequence — they
+        were taken prefill-side at the existing stamp points.  Returns
+        False when no slot or pages are free (the driver retries at
+        the next sync boundary)."""
+        slot = next((i for i, s in enumerate(self.slots) if s is None),
+                    None)
+        if slot is None:
+            return False
+        plan = self.cache.plan_admission(req.prompt_len
+                                         + req.output_len)
+        if plan.need_pages > self.cache.free_pages:
+            return False
+        self.cache.admit(slot, plan)
+        # the migrated payload covers exactly the prompt's pages;
+        # advancing the length makes append/decode see the monolithic
+        # post-prefill state
+        self.cache.append(slot, req.prompt_len)
+        s = self.cfg.page_size
+        n_pages = (req.prompt_len + s - 1) // s
+        dst_ids = self.cache.block_tables[slot][:n_pages]
+        self._adopt_pools(channel.scatter(self._pool_args(),
+                                          pending_send, dst_ids))
+        st = _SlotState(req, admitted_s=admitted_s)
+        st.prompt = self._prompt_of(req)
+        st.prefill_done = req.prompt_len
+        st.generated = generated
+        st.last_token = last_token
+        st.first_token_s = first_token_s
+        self.slots[slot] = st
+        self.concurrent_peak = max(
+            self.concurrent_peak,
+            sum(1 for s_ in self.slots if s_ is not None))
+        self._maybe_finish(slot, st)
+        if self.slots[slot] is st:
+            self._activate_decode_slot(slot, st)
+        return True
+
     def _step(self) -> None:
         """One engine step: inline prefill chunks first (one per
         prefilling slot), then decode — one token per active slot
@@ -840,10 +987,50 @@ class Engine:
         return decode_ix, dev_s
 
     def _step_single(self) -> None:
+        self._step_complete(self._step_dispatch())
+
+    def _step_fused(self) -> None:
+        """Loop mode: ONE fused device program runs up to N decode
+        steps with slot state resident on device; the host syncs only
+        here — admission updates flushed in, the per-sync token block
+        pulled out, both priced (device_state.py)."""
+        self._step_complete(self._step_dispatch())
+
+    # ---- the dispatch/complete split (ISSUE 16) ----------------------
+    # Both decode paths are split at the async-dispatch boundary: the
+    # DISPATCH phase marshals inputs and launches the compiled program
+    # WITHOUT fencing; the COMPLETE phase fences the outputs and runs
+    # the host postprocess.  The monolithic engine calls them
+    # back-to-back (_step_single/_step_fused above) — same statements
+    # in the same order, bit-identical math AND timing attribution.
+    # The disaggregated driver opens the window: while the decode
+    # replica's program runs on its device, the prefill replica's
+    # chunks and the page-migration sends run on the OTHER device —
+    # the measured interference reduction the disagg study prices.
+
+    def _step_dispatch(self) -> dict | None:
+        """Preamble + program launch, no fence.  Returns the in-flight
+        step context for ``_step_complete``, or None when no slot is in
+        the decode phase (nothing was dispatched)."""
+        if self._loop_mode:
+            return self._dispatch_fused()
+        return self._dispatch_single()
+
+    def _step_complete(self, ctx: dict | None) -> float:
+        """Fence the dispatched step's outputs and run the host
+        postprocess; returns the step's decode device-leg seconds (the
+        compute arm of the disagg driver's overlap measurement)."""
+        if ctx is None:
+            return 0.0
+        if ctx["fused"]:
+            return self._complete_fused(ctx)
+        return self._complete_single(ctx)
+
+    def _dispatch_single(self) -> dict | None:
         t_step = time.perf_counter()
         decode_ix, dev_s = self._step_preamble()
         if not decode_ix:
-            return
+            return None
         b = self.cfg.slots
         tokens = np.zeros((b,), np.int32)
         positions = np.zeros((b,), np.int32)
@@ -858,16 +1045,23 @@ class Engine:
             self.params, *self._pool_args(),
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(self.cache.block_tables), jnp.asarray(active))
+        rest = self._adopt_pools(outs)
+        return {"fused": False, "t_step": t_step, "t0": t0,
+                "dev_s": dev_s, "decode_ix": decode_ix, "rest": rest}
+
+    def _complete_single(self, ctx: dict) -> float:
+        decode_ix, dev_s = ctx["decode_ix"], ctx["dev_s"]
         if self._moe:
-            nxt, load, rounds = self._adopt_pools(outs)
+            nxt, load, rounds = ctx["rest"]
             self._record_moe(load, rounds)
         else:
-            (nxt,) = self._adopt_pools(outs)
+            (nxt,) = ctx["rest"]
         nxt = np.asarray(nxt)        # the fence rides the device leg
         t1 = time.perf_counter()
-        dev_s += t1 - t0
-        self._device_time_s += t1 - t0
-        self._decode_device_s += t1 - t0
+        leg = t1 - ctx["t0"]
+        dev_s += leg
+        self._device_time_s += leg
+        self._decode_device_s += leg
         self._dispatches += 1
         self._device_steps += 1
         for i in decode_ix:
@@ -880,18 +1074,16 @@ class Engine:
                 st.last_token)
             self._maybe_finish(i, st)
         self._host_dispatch_us.append(
-            max(0.0, (time.perf_counter() - t_step - dev_s)) * 1e6)
+            max(0.0, (time.perf_counter() - ctx["t_step"] - dev_s))
+            * 1e6)
+        return leg
 
-    def _step_fused(self) -> None:
-        """Loop mode: ONE fused device program runs up to N decode
-        steps with slot state resident on device; the host syncs only
-        here — admission updates flushed in, the per-sync token block
-        pulled out, both priced (device_state.py)."""
+    def _dispatch_fused(self) -> dict | None:
         t_step = time.perf_counter()
         sync0 = self.dstate.sync_total_us()
         decode_ix, dev_s = self._step_preamble()
         if not decode_ix:
-            return
+            return None
         ds = self.dstate
         n = self._pick_n_steps(decode_ix)
         carries = ds.carries()            # flushes if dirty (priced)
@@ -901,6 +1093,13 @@ class Engine:
                           *carries, bt, self._n_scalar(n))
         new_carries, extras = self._loop.split(outs)
         ds.rebind(self._adopt_pools(new_carries))
+        return {"fused": True, "t_step": t_step, "t0": t0,
+                "sync0": sync0, "dev_s": dev_s,
+                "decode_ix": decode_ix, "extras": extras}
+
+    def _complete_fused(self, ctx: dict) -> float:
+        decode_ix, dev_s = ctx["decode_ix"], ctx["dev_s"]
+        extras = ctx["extras"]
         if self.cfg.speculative:
             toks, cnts, steps, drafted, accepted = extras
         elif self._moe:
@@ -920,13 +1119,14 @@ class Engine:
             self._drafted += int(drafted)
             self._accepted += int(accepted)
         t2 = time.perf_counter()
-        dev_s += t2 - t0
-        self._device_time_s += t2 - t0
-        self._decode_device_s += t2 - t0
+        leg = t2 - ctx["t0"]
+        dev_s += leg
+        self._device_time_s += leg
+        self._decode_device_s += leg
         self._dispatches += 1
         self._device_steps += steps
         if steps > 0:
-            per_step = (t2 - t0) / steps
+            per_step = leg / steps
             self._step_ewma_s = (per_step if not self._step_ewma_s else
                                  0.5 * self._step_ewma_s
                                  + 0.5 * per_step)
@@ -945,10 +1145,12 @@ class Engine:
         # exclude in-step sync time: flush/pull are priced in their own
         # channels and each crossing must count against the wall ONCE
         # (serving_host_us sums host_dispatch + both sync channels)
-        sync_s = (self.dstate.sync_total_us() - sync0) * 1e-6
+        sync_s = (self.dstate.sync_total_us() - ctx["sync0"]) * 1e-6
         self._host_dispatch_us.append(
-            max(0.0, (time.perf_counter() - t_step - dev_s - sync_s))
+            max(0.0, (time.perf_counter() - ctx["t_step"] - dev_s
+                      - sync_s))
             * 1e6)
+        return leg
 
     def _record_moe(self, load, rounds) -> None:
         """Fold one DECODE dispatch's MoE stats (device outputs riding
@@ -1044,6 +1246,19 @@ class Engine:
             return max(1, n)
         rem_min = min(self.slots[i].req.output_len
                       - self.slots[i].generated for i in decode_ix)
+        # disaggregation (ISSUE 16): the decode replica has no arrival
+        # queue of its own — its "next arrival" is the next migrated
+        # sequence, whose ETA the driver maintains.  Cap the trip
+        # count the same way the queue-head cap does, so a finished
+        # handoff waits at most ~one device step for a free sync
+        # boundary instead of a full N-step loop.  None (always, on a
+        # monolithic engine) leaves every path below bit-identical.
+        eta = self._migration_eta_s
+        if eta is not None:
+            dt = eta - self._now()
+            est = self._step_ewma_s
+            if est > 0 and dt < n * est:
+                n = max(1, min(n, max(1, int(dt / est) + 1)))
         if self.pending:
             return max(1, min(n, rem_min))
         if self.queue:
@@ -1052,6 +1267,8 @@ class Engine:
             if est > 0 and dt < n * est:
                 steps_until = max(1, int(dt / est) + 1)
                 return max(1, min(n, rem_min, steps_until))
+        if eta is not None:
+            return max(1, min(n, rem_min))
         return n
 
     def _maybe_finish(self, slot: int, st: _SlotState) -> None:

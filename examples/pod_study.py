@@ -245,10 +245,21 @@ def serving_arrival(rate: float, seed: int,
                        "prompt_len": [8, 16], "output_len": [4, 8]})
 
 
-def _serve_argv(records: Path, arrival: str, tags: list[str]) -> list:
+# --disagg (ISSUE 16): the same sweep over the disaggregated engine —
+# the prefill mesh and decode mesh split the two capacity ranks, KV
+# pages migrate in the stored dtype, and the report's serving_summary
+# carries the migration_* columns next to the latency bands
+DISAGG_FLAGS = [
+    "--disaggregate", "--world", "2", "--prefill_ranks", "1",
+    "--decode_ranks", "1", "--multi_step_n", "4",
+]
+
+
+def _serve_argv(records: Path, arrival: str, tags: list[str],
+                extra: list[str] | None = None) -> list:
     argv = [sys.executable, "-m", "dlnetbench_tpu.cli", "serve",
             "--arrival", arrival, "--platform", "cpu",
-            "--out", str(records)] + SERVING_FLAGS
+            "--out", str(records)] + SERVING_FLAGS + (extra or [])
     for t in tags:
         argv += ["--tag", t]
     return argv
@@ -262,6 +273,9 @@ def run_serving_plan(args, records: Path) -> int:
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (repo, env.get("PYTHONPATH")) if p)
     failed = 0
+    disagg = bool(getattr(args, "disagg", False))
+    extra = DISAGG_FLAGS if disagg else None
+    eng_tag = f"engine={'disagg' if disagg else 'mono'}"
 
     # 1. capacity calibration: a saturating rate (every request queued
     # at t~0) — measured_rps IS the engine's drain capacity here
@@ -271,7 +285,7 @@ def run_serving_plan(args, records: Path) -> int:
           flush=True)
     rc = subprocess.run(
         _serve_argv(calib, serving_arrival(10000.0, 0),
-                    ["load_frac=calib"]),
+                    ["load_frac=calib", eng_tag], extra),
         env=env, stdout=subprocess.DEVNULL).returncode
     if rc != 0 or not calib.exists():
         raise SystemExit(f"serving calibration failed rc={rc}")
@@ -290,7 +304,7 @@ def run_serving_plan(args, records: Path) -> int:
                 _serve_argv(records,
                             serving_arrival(capacity * frac, seed),
                             [f"load_frac={frac}",
-                             f"serving_seed={seed}"]),
+                             f"serving_seed={seed}", eng_tag], extra),
                 env=env, stdout=subprocess.DEVNULL).returncode
             if rc != 0:
                 print(f"  FAILED frac={frac} seed={seed} rc={rc}",
@@ -307,7 +321,8 @@ def run_serving_plan(args, records: Path) -> int:
           f"decode step", flush=True)
     rc = subprocess.run(
         _serve_argv(records, serving_arrival(capacity * 0.5, 0),
-                    ["load_frac=0.5", "serving_fault=straggler"])
+                    ["load_frac=0.5", "serving_fault=straggler",
+                     eng_tag], extra)
         + ["--fault", fault],
         env=env, stdout=subprocess.DEVNULL).returncode
     if rc != 0:
@@ -976,6 +991,16 @@ def main() -> int:
                          "composed point proving fault plans inflate "
                          "serving p99 — one records.jsonl artifact "
                          "(docs/SERVING.md)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="with --serving: run the sweep over the "
+                         "DISAGGREGATED prefill/decode engine "
+                         "(ISSUE 16; 2 capacity ranks split 1 prefill "
+                         "+ 1 decode, KV pages migrating in the "
+                         "stored dtype) — the serving_summary carries "
+                         "the migration_* columns; run once without "
+                         "and once with into different --out_dir for "
+                         "the Pareto comparison (docs/studies/"
+                         "disagg_r17 automates exactly that)")
     ap.add_argument("--kv_density", action="store_true",
                     help="run the serving-density study instead of the "
                          "proxy grid (ISSUE 12): dense vs int8 vs fp8 "

@@ -259,6 +259,61 @@ def test_serving_decode_line_schema_locked():
     assert is_ms_line(line)
 
 
+def test_disagg_line_schema_locked():
+    """bench.py's disagg_ab aux line (ISSUE 16) is a BENCH artifact:
+    lock the paired-arm stat-band schema — ms headline from the
+    DISAGGREGATED arm's round-median e2e p99 (sentinel-comparable),
+    {value, best, band, n} sub-objects for TTFT p50/p99 + TPOT p50 +
+    tokens/s on BOTH arms, the migration wire cost on the disagg arm,
+    and the band-disjoint interference verdict."""
+    import bench
+
+    def _round(p99, ttft50, ttft99, tpot, tps, mig=None):
+        r = {"e2e_ms": {"p99": p99},
+             "ttft_ms": {"p50": ttft50, "p99": ttft99},
+             "tpot_ms": {"p50": tpot}, "tokens_per_s": tps}
+        if mig is not None:
+            r["migration"] = mig
+        return r
+
+    mono = [_round(10.0, 2.0, 5.0, 1.00, 100.0),
+            _round(12.0, 2.2, 5.5, 1.10, 90.0),
+            _round(11.0, 2.1, 5.2, 1.05, 95.0)]
+    mig = {"bytes": 16896, "ms": {"p50": 0.4},
+           "bytes_ratio_vs_bf16": 0.5156}
+    dis = [_round(8.0, 1.8, 4.0, 0.50, 140.0, mig),
+           _round(9.0, 1.9, 4.4, 0.55, 130.0, mig),
+           _round(8.5, 1.85, 4.2, 0.52, 135.0, mig)]
+    line = bench._disagg_line(mono, dis, suffix=", test",
+                              token_parity=True)
+    assert line["unit"] == "ms"
+    assert line["value"] == 8.5 and line["n"] == 3
+    assert line["band"] == [8.0, 9.0] and line["best"] == 8.0
+    for arm in ("monolithic", "disaggregated"):
+        for key in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                    "tokens_per_s"):
+            sub = line[arm][key]
+            for k in ("value", "best", "band", "n"):
+                assert k in sub, (arm, key, k)
+    d = line["disaggregated"]
+    for key in ("migration_bytes", "migration_ms_p50"):
+        for k in ("value", "best", "band", "n"):
+            assert k in d[key], (key, k)
+    assert d["migration_bytes"]["value"] == 16896.0
+    assert d["migration_bytes_ratio"] == 0.5156
+    # TPOT bands [1.0, 1.1] vs [0.5, 0.55]: disjoint AND lower — the
+    # interference verdict the disagg study prices
+    assert line["tpot_band_disjoint_drop"] is True
+    assert line["token_parity"] is True
+    # overlapping bands must NOT claim the win
+    flat = bench._disagg_line(mono, mono)
+    assert flat["tpot_band_disjoint_drop"] is False
+    assert "token_parity" not in flat
+    # sentinel comparability: an ms line, auto-compared by --check
+    from dlnetbench_tpu.sentinel import is_ms_line
+    assert is_ms_line(line)
+
+
 def test_live_metrics_line_schema_locked(tmp_path):
     """ISSUE 14 satellite: the --live-metrics JSONL stream's snapshot
     line — one per window, rolling TTFT/TPOT percentiles over the
