@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test check-bench check-resilience check-serving check-tuning \
 	check-longcontext check-decode check-density check-telemetry \
-	check-moe check-disagg sentinel-scan
+	check-moe check-disagg check-fleet sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -152,6 +152,22 @@ check-disagg:
 	    tests/test_disagg.py
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
 	    tests/test_bench_aux.py::test_disagg_line_schema_locked
+
+# the fleet-serving lane (ISSUE 18, docs/SERVING.md "Fleet serving"):
+# the seeded router's policy semantics (round_robin cycling, p2c
+# tie/draw rules, prefix-affinity's read-only trie probe), the diurnal
+# arrival shape + committed fixture, the shared re-queue arc,
+# fleet-vs-single-engine token parity + assignment replay determinism,
+# the committed record_fleet.jsonl parser -> merge round trip, and the
+# fleet_ab bench-line schema + sentinel comparability.  The autoscale
+# and replica-crash e2e cases ride the slow lane (pytest -m 'fleet and
+# slow').  ~40s wall.
+check-fleet:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m 'fleet and not slow' \
+	    tests/test_fleet.py
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
+	    tests/test_bench_aux.py::test_fleet_line_schema_locked \
+	    tests/test_sentinel.py::test_fleet_ab_line_is_comparable
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

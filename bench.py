@@ -541,7 +541,7 @@ def _run_bench(args, tracer) -> int:
         fp8 = fp8_chain = int8 = int8_ab = fp8_ab = None
         straggler = ckpt_ab = int8_step = int8_sb = overlap_ab = None
         serving = tuned_ab = longcontext = kv_density = moe_ab = None
-        disagg_ab = None
+        disagg_ab = fleet_ab = None
     else:
         fp8 = _aux("fp8 mlp matmul", _bench_fp8_mlp, card, hw_key, dev)
         fp8_chain = _aux("fp8 swiglu chain", _bench_fp8_swiglu_chain,
@@ -577,6 +577,10 @@ def _run_bench(args, tracer) -> int:
         # prefill/decode meshes at equal chips on one seeded plan —
         # two tiny engines + the migration channel, one compile each
         disagg_ab = _aux("disagg A/B", _bench_disagg_ab)
+        # the ISSUE-18 fleet evidence: three 2-replica fleets at equal
+        # chips on one seeded prefix-heavy plan, differing only in
+        # routing policy — tiny engines, three compiles, r4 pairing
+        fleet_ab = _aux("fleet A/B", _bench_fleet_ab)
         # the ISSUE-10 long-context evidence: dense-vs-splash paired
         # rounds at S=64k under causal/window/segment masks — four
         # attention-only compiles, bounded by the shared aux deadline
@@ -644,6 +648,7 @@ def _run_bench(args, tracer) -> int:
         **({"serving_decode": serving} if serving else {}),
         **({"kv_density_ab": kv_density} if kv_density else {}),
         **({"disagg_ab": disagg_ab} if disagg_ab else {}),
+        **({"fleet_ab": fleet_ab} if fleet_ab else {}),
         **({"longcontext_ab": longcontext} if longcontext else {}),
         **({"moe_ab": moe_ab} if moe_ab else {}),
         **({"spmd_overlap_ab": overlap_ab} if overlap_ab else {}),
@@ -1132,6 +1137,136 @@ def _bench_disagg_ab() -> dict | None:
         mono_rounds, dis_rounds,
         suffix=f", {len(requests)} req slots={mono_cfg.slots} "
                f"int8 KV, world=2 (1p+1d), {dev.device_kind}",
+        token_parity=parity)
+    print(json.dumps(line))
+    return line
+
+
+def _fleet_line(arm_rounds: dict, suffix: str = "", *,
+                token_parity: bool | None = None) -> dict:
+    """Assemble the fleet_ab aux line from per-policy per-round
+    ``{"serving": ..., "fleet": ...}`` dicts (pure —
+    tests/test_bench_aux.py locks this schema).  ``arm_rounds`` maps
+    each routing policy (round_robin / p2c / prefix_affinity) to its
+    measured rounds at EQUAL chips on one seeded prefix-heavy plan.
+    The headline ``value`` is the prefix_affinity arm's round-median
+    TTFT p50 in ms (lower is better, sentinel-comparable like the
+    serving_decode line); every arm ships artifact-grade
+    ``{value, best, band, n}`` bands, the affinity arm adds its hit
+    rate and migration-free prefix-token reuse, and the verdict is the
+    routing question: did prefix-aware placement pull TTFT p50 below
+    the round_robin band, bands disjoint?"""
+    def _bands(rounds: list[dict]) -> dict:
+        srv = [r["serving"] for r in rounds]
+        return {
+            "ttft_p50_ms": stats_mod.summarize(
+                [r["ttft_ms"]["p50"] for r in srv], ndigits=3),
+            "ttft_p99_ms": stats_mod.summarize(
+                [r["ttft_ms"]["p99"] for r in srv], ndigits=3),
+            "tokens_per_s": stats_mod.summarize(
+                [r["tokens_per_s"] for r in srv], ndigits=2),
+        }
+    arms = {pol: _bands(rounds) for pol, rounds in arm_rounds.items()}
+    pa_rounds = arm_rounds["prefix_affinity"]
+    arms["prefix_affinity"]["affinity_hit_rate"] = stats_mod.summarize(
+        [r["fleet"]["affinity_hit_rate"] for r in pa_rounds], ndigits=4)
+    arms["prefix_affinity"]["prefix_reuse_tokens"] = stats_mod.summarize(
+        [float(r["fleet"]["prefix_reuse_tokens"]) for r in pa_rounds],
+        ndigits=1)
+    p50 = arms["prefix_affinity"]["ttft_p50_ms"]
+    rr = arms["round_robin"]["ttft_p50_ms"]
+    disjoint = (stats_mod.bands_overlap(rr["band"], p50["band"])
+                is False and p50["value"] < rr["value"])
+    replicas = pa_rounds[0]["fleet"]["replicas"]
+    line = {
+        "metric": f"fleet_ab: round_robin vs p2c vs prefix_affinity "
+                  f"routing at equal chips ({replicas} replicas), same "
+                  f"seeded prefix-heavy plan (serving/fleet){suffix}",
+        "value": p50["value"],
+        "unit": "ms",
+        "best": p50["best"],
+        "band": p50["band"],
+        "n": p50["n"],
+        "round_robin": arms["round_robin"],
+        "p2c": arms["p2c"],
+        "prefix_affinity": arms["prefix_affinity"],
+        "ttft_band_disjoint_drop": disjoint,
+        "verdict": ("prefix-affinity TTFT p50 dropped below "
+                    "round_robin, bands disjoint — routing to the "
+                    "pages beat routing blind" if disjoint else
+                    "TTFT bands overlap — no routing flip at this "
+                    "scale/noise"),
+    }
+    if token_parity is not None:
+        line["token_parity"] = bool(token_parity)
+    return stats_mod.flag_low_mode(line)
+
+
+def _bench_fleet_ab() -> dict | None:
+    """The ISSUE-18 A/B: three two-replica fleets — SAME weights, SAME
+    chip count, SAME seeded prefix-heavy plan, prefix_sharing on every
+    arm — differing ONLY in routing policy, interleaved per round (r4
+    pairing).  The token-parity lock compares the full greedy streams
+    across all three arms (routing must be lossless placement)."""
+    from dlnetbench_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+    from dlnetbench_tpu.serving import metrics as smetrics
+    from dlnetbench_tpu.serving.arrivals import ArrivalPlan
+    from dlnetbench_tpu.serving.fleet import FleetConfig, FleetServer
+    from dlnetbench_tpu.serving.scheduler import ServingConfig
+
+    if len(jax.devices()) < 2:
+        return None  # a fleet of one replica routes nothing
+    mc = TransformerConfig(
+        vocab_size=256, embed_dim=64, num_heads=4, num_kv_heads=2,
+        ff_dim=128, num_layers=2, seq_len=64, gated=True,
+        max_positions=0, dtype="float32")
+    # attn_impl pinned to gather for the same reason as serving_decode:
+    # the parity lock needs one attention basis on every backend
+    cfg = ServingConfig(
+        slots=2, page_size=8, num_pages=64, max_seq_len=64,
+        slo_ttft_ms=250.0, slo_tpot_ms=100.0, attn_impl="gather",
+        prefix_sharing=True, warmup_requests=0)
+    # arrivals SPACED (not a t=0 burst): affinity only has pages to
+    # route to once earlier prompts have prefilled and published — a
+    # burst plan would route the whole batch against empty tries and
+    # measure nothing but p2c fallback
+    plan = ArrivalPlan(kind="poisson", rate_rps=120.0,
+                       num_requests=12, seed=2, prompt_len=[36, 44],
+                       output_len=[4, 8], shared_prefix_len=32,
+                       prefix_pool=2)
+    params = init_params(jax.random.key(0), mc)
+    requests = plan.sample()
+    devs = jax.devices()[:2]
+    servers = {
+        pol: FleetServer(mc, cfg, FleetConfig(replicas=2, routing=pol),
+                         params=params, devices=devs)
+        for pol in ("round_robin", "p2c", "prefix_affinity")}
+    for srv in servers.values():
+        srv.run(requests)  # warm round (first-dispatch), discarded
+    rounds: dict = {pol: [] for pol in servers}
+    streams: dict = {}
+    for _ in range(3):
+        for pol, srv in servers.items():   # interleaved (r4 pairing)
+            completed, wall = srv.run(requests)
+            streams[pol] = srv.token_streams
+            rounds[pol].append({
+                "serving": smetrics.serving_block(
+                    completed, plan, slo_ttft_ms=cfg.slo_ttft_ms,
+                    slo_tpot_ms=cfg.slo_tpot_ms, wall_s=wall,
+                    engine_steps=srv.engine_steps(),
+                    queue_depth_max=srv.queue_depth_max,
+                    batch_occupancy_mean=srv.batch_occupancy_mean(),
+                    admitted_peak=srv.concurrent_peak),
+                "fleet": srv.fleet_block(completed)})
+    parity = (streams["round_robin"] == streams["p2c"]
+              == streams["prefix_affinity"])
+    dev = jax.devices()[0]
+    line = _fleet_line(
+        rounds,
+        suffix=f", {len(requests)} req slots={cfg.slots}/replica, "
+               f"shared_prefix={plan.shared_prefix_len} "
+               f"pool={plan.prefix_pool}, {dev.device_kind}",
         token_parity=parity)
     print(json.dumps(line))
     return line

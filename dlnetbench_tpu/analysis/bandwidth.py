@@ -421,6 +421,17 @@ def serving_summary(records: list[dict]):
         row["migration_ms_p50"] = float(ms.get("p50", float("nan")))
         row["migration_overlap"] = float(
             mig.get("overlap", float("nan")))
+        # fleet provenance (ISSUE 18): the routing policy, fleet width
+        # and chip-second-normalized goodput ride every serving row —
+        # an equal-chips policy A/B grids by these next to the latency
+        # axes.  "-" / 1 / NaN on single-engine and pre-fleet records.
+        flt = g.get("fleet") or {}
+        row["routing"] = str(g.get("fleet_routing", "-"))
+        row["replicas"] = int(g.get("fleet_replicas", 1))
+        row["goodput_per_chip_s"] = float(
+            flt.get("slo_goodput_per_chip_s", float("nan")))
+        row["chip_seconds_saved"] = float(
+            flt.get("chip_seconds_saved", float("nan")))
         rows.append(row)
     return pd.DataFrame(rows)
 

@@ -620,6 +620,32 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
                         "percentiles, queue depth, occupancy) — the "
                         "live dashboard channel "
                         "(serving/metrics.LiveMetricsWriter)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help=">1: fleet serving (ISSUE 18) — this many "
+                        "independent engine replicas (each over its "
+                        "own --world-device subset with its own page "
+                        "pool) behind a seeded front-end router; the "
+                        "record stamps the fleet block + "
+                        "fleet_routing/fleet_replicas comparables "
+                        "(docs/SERVING.md 'Fleet serving')")
+    p.add_argument("--routing", default="round_robin",
+                   choices=["round_robin", "p2c", "prefix_affinity"],
+                   help="fleet routing policy (with --replicas > 1): "
+                        "round_robin baseline; p2c = seeded power-of-"
+                        "two-choices on live load; prefix_affinity = "
+                        "route to the replica whose radix trie holds "
+                        "the longest shared prefix (needs "
+                        "--prefix_sharing), p2c fallback on ties and "
+                        "full replicas")
+    p.add_argument("--route_seed", type=int, default=0,
+                   help="the router's splitmix64 stream seed "
+                        "(assignment replay)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="elastic fleet capacity (with --replicas > 1): "
+                        "scale up on rolling SLO breach / queue "
+                        "pressure (recompile priced into the scale "
+                        "event), scale down idle replicas through the "
+                        "drain arc (chip-seconds saved accounted)")
 
 
 def _run_serve(args, parser) -> int:
@@ -698,14 +724,27 @@ def _run_serve(args, parser) -> int:
     import jax
     from dlnetbench_tpu.models.transformer import init_params
     params = init_params(jax.random.key(args.seed), model_cfg)
-    if srv_cfg.disaggregate:
-        from dlnetbench_tpu.serving.disagg import run_disagg
-        runner = run_disagg
+    if args.replicas > 1:
+        from dlnetbench_tpu.serving.fleet import FleetConfig, run_fleet
+        try:
+            fleet_cfg = FleetConfig(replicas=args.replicas,
+                                    routing=args.routing,
+                                    route_seed=args.route_seed,
+                                    autoscale=args.autoscale).validate()
+        except ValueError as e:
+            parser.error(str(e))
+        result = run_fleet(model_cfg, srv_cfg, plan, fleet_cfg,
+                           fault_plan=fault_plan, params=params,
+                           live_metrics=args.live_metrics)
     else:
-        runner = run_serving
-    result = runner(model_cfg, srv_cfg, plan,
-                    fault_plan=fault_plan, params=params,
-                    live_metrics=args.live_metrics)
+        if srv_cfg.disaggregate:
+            from dlnetbench_tpu.serving.disagg import run_disagg
+            runner = run_disagg
+        else:
+            runner = run_serving
+        result = runner(model_cfg, srv_cfg, plan,
+                        fault_plan=fault_plan, params=params,
+                        live_metrics=args.live_metrics)
     if variables:
         result.global_meta["variables"] = variables
     record = emit_result(result, path=args.out)

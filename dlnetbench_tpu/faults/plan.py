@@ -249,6 +249,17 @@ class FaultPlan:
         dead = set(self.crash_victims(world))
         return [r for r in range(world) if r not in dead]
 
+    def shrink_survivors(self, world: int) -> list[int]:
+        """Ranks left standing under policy ``shrink``: crash AND
+        preempt victims are both gone from the degraded world (a
+        preempted rank may rejoin later, but the shrink segment runs
+        without it).  The one spelling every crash-shrink segmentation
+        shares (serving/requeue.py) — it used to be inlined per
+        runner, which is how survivor-set definitions drift."""
+        dead = set(self.crash_victims(world)) \
+            | set(self.preempt_victims())
+        return [r for r in range(world) if r not in dead]
+
     def first_crash_iteration(self) -> int | None:
         its = [e.iteration for e in self.events
                if e.kind in ("crash", "partition")]

@@ -100,7 +100,20 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      # serving_config and the moe_* globals) stay
                      # comparable: differently-routed runs are
                      # different runs
-                     "moe"}
+                     "moe",
+                     # fleet-serving measurements (ISSUE 18): the load
+                     # histogram, per-replica request counts, affinity
+                     # hit rates, scale-event timings and chip-second
+                     # spend all depend on live load and host speed —
+                     # measurements, pooled like every serving block.
+                     # The ROUTING POLICY and fleet width stay
+                     # comparable (fleet_routing/fleet_replicas below)
+                     "fleet"}
+# NOT volatile, by design (ISSUE 18): "fleet_routing" and
+# "fleet_replicas" are run IDENTITY — a p2c record must never merge
+# with a round_robin one, nor a 2-replica fleet with a 4-replica one
+# (their serving latencies answer different questions), exactly like
+# mismatched fault or arrival plans.
 # NOT volatile, by design (ISSUE 16): the "disaggregated" global (and
 # the prefill_ranks/decode_ranks split inside serving_config) is run
 # IDENTITY — a disaggregated record must never merge with a monolithic

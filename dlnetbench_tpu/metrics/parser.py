@@ -237,6 +237,29 @@ def records_to_dataframe(records: list[dict], validate: bool = True):
                         if isinstance(ms, dict):
                             row["serving_migration_ms_p50"] = \
                                 ms.get("p50")
+                # fleet block (a dict global, skipped above — ISSUE
+                # 18): hoist the routing-comparison axes — per-replica
+                # spread, affinity wins, elastic chip-second spend —
+                # to plain columns so a policy A/B grids like any
+                # other study (fleet_routing/fleet_replicas are plain
+                # scalars and hoist via the generic loop above);
+                # single-engine records simply lack the block
+                flt = g.get("fleet")
+                if isinstance(flt, dict):
+                    rpr = flt.get("requests_per_replica")
+                    if isinstance(rpr, list) and rpr:
+                        row["fleet_replica_req_max"] = max(rpr)
+                        row["fleet_replica_req_min"] = min(rpr)
+                    for fk in ("affinity_hit_rate",
+                               "prefix_reuse_tokens",
+                               "chip_seconds_used",
+                               "chip_seconds_saved",
+                               "slo_goodput_per_chip_s"):
+                        if fk in flt:
+                            row[f"fleet_{fk}"] = flt[fk]
+                    ev = flt.get("scale_events")
+                    if isinstance(ev, list):
+                        row["fleet_scale_events"] = len(ev)
                 for tname, tvals in timers.items():
                     if run < len(tvals):
                         # singular column names a la reference ('runtime')

@@ -17,6 +17,15 @@ Kinds:
             rest at ``rate_rps / factor`` — same *mean* arrival count
             per period only when duty balances factor; the point is
             tail pressure, and the plan states its own shape.
+  diurnal — day-shaped poisson (ISSUE 18): ``phases`` is a piecewise
+            rate curve, ``[[fraction_of_run, rate_multiplier], ...]``
+            — the phase starting at fraction f of the plan's nominal
+            span (``num_requests / rate_rps`` seconds) runs at
+            ``rate_rps * multiplier`` until the next phase begins (the
+            last phase holds to the end).  The load curve an elastic
+            autoscaler study needs: a trough the fleet can scale down
+            into and a peak it must scale back up for, committed in
+            the plan JSON like every other traffic shape.
   replay  — explicit trace of ``{"t": seconds, "prompt_len", ...}``
             entries (a recorded production trace, replayed verbatim).
 
@@ -30,7 +39,7 @@ import dataclasses
 import json
 import math
 
-KINDS = ("poisson", "bursty", "replay")
+KINDS = ("poisson", "bursty", "diurnal", "replay")
 
 _M64 = (1 << 64) - 1
 
@@ -104,6 +113,10 @@ class ArrivalPlan:
     period_s: float = 1.0
     duty: float = 0.2
     factor: float = 4.0
+    # diurnal shape (ISSUE 18): [[fraction_of_run, rate_multiplier],
+    # ...] — phase i runs at rate_rps * multiplier from fraction f_i of
+    # the nominal span (num_requests / rate_rps seconds) until f_{i+1}
+    phases: list = dataclasses.field(default_factory=list)
     # replay: explicit trace entries {"t", "prompt_len", "output_len"}
     trace: list = dataclasses.field(default_factory=list)
     # prefix-heavy traffic (ISSUE 12): every request's first
@@ -118,7 +131,7 @@ class ArrivalPlan:
         if self.kind not in KINDS:
             raise ValueError(f"arrival plan: unknown kind {self.kind!r} "
                              f"(one of {KINDS})")
-        if self.kind in ("poisson", "bursty"):
+        if self.kind in ("poisson", "bursty", "diurnal"):
             if not self.rate_rps > 0:
                 raise ValueError(
                     f"arrival plan: {self.kind} needs rate_rps > 0, got "
@@ -134,6 +147,43 @@ class ArrivalPlan:
                 raise ValueError(
                     "arrival plan: bursty needs period_s > 0, "
                     "0 < duty < 1 and factor >= 1")
+        if self.kind == "diurnal":
+            if not self.phases:
+                raise ValueError(
+                    "arrival plan: diurnal needs a non-empty 'phases' "
+                    "curve [[fraction_of_run, rate_multiplier], ...] — "
+                    "a diurnal plan without a day shape is just "
+                    "poisson, and the plan must state its own shape")
+            last_f = -1.0
+            for i, ph in enumerate(self.phases):
+                if not (isinstance(ph, (list, tuple)) and len(ph) == 2):
+                    raise ValueError(
+                        f"arrival plan: diurnal phase {i} must be a "
+                        f"[fraction_of_run, rate_multiplier] pair, got "
+                        f"{ph!r}")
+                f, mult = float(ph[0]), float(ph[1])
+                if not 0.0 <= f < 1.0:
+                    raise ValueError(
+                        f"arrival plan: diurnal phase {i} starts at "
+                        f"fraction {f!r} — fractions must be in [0, 1)")
+                if f <= last_f:
+                    raise ValueError(
+                        f"arrival plan: diurnal phase {i} starts at "
+                        f"fraction {f!r} <= the previous phase's "
+                        f"{last_f!r} — phases must be strictly "
+                        f"increasing")
+                if not mult > 0:
+                    raise ValueError(
+                        f"arrival plan: diurnal phase {i} has rate "
+                        f"multiplier {mult!r} — multipliers must be "
+                        f"> 0 (a zero-rate phase never draws the next "
+                        f"arrival)")
+                last_f = f
+            if float(self.phases[0][0]) != 0.0:
+                raise ValueError(
+                    "arrival plan: the first diurnal phase must start "
+                    "at fraction 0.0 — the curve must cover the whole "
+                    "run")
         if self.kind == "replay":
             if not self.trace:
                 raise ValueError(
@@ -187,12 +237,18 @@ class ArrivalPlan:
         out = {"kind": self.kind, "seed": self.seed,
                "prompt_len": self.prompt_len,
                "output_len": self.output_len}
-        if self.kind in ("poisson", "bursty"):
+        if self.kind in ("poisson", "bursty", "diurnal"):
             out["rate_rps"] = self.rate_rps
             out["num_requests"] = self.num_requests
         if self.kind == "bursty":
             out.update(period_s=self.period_s, duty=self.duty,
                        factor=self.factor)
+        if self.kind == "diurnal":
+            # JSON-canonical pairs: a fixture round-trips byte-
+            # identically through json.dumps whatever pair type the
+            # caller built the plan with
+            out["phases"] = [[float(f), float(m)]
+                             for f, m in self.phases]
         if self.kind == "replay":
             out["trace"] = list(self.trace)
         if self.shared_prefix_len:
@@ -217,6 +273,7 @@ class ArrivalPlan:
             period_s=float(d.get("period_s", 1.0)),
             duty=float(d.get("duty", 0.2)),
             factor=float(d.get("factor", 4.0)),
+            phases=[list(p) for p in d.get("phases", [])],
             trace=list(d.get("trace", [])),
             shared_prefix_len=int(d.get("shared_prefix_len", 0)),
             prefix_pool=int(d.get("prefix_pool", 1)),
@@ -260,6 +317,11 @@ class ArrivalPlan:
                                          rng.uniform_int(o_lo, o_hi))),
                     **prefix()))
             return out
+        # diurnal clock: the curve is stated in fractions of the
+        # NOMINAL span (num_requests at the base rate) so the same
+        # phases list means the same day shape at any scale
+        span = (self.num_requests / self.rate_rps
+                if self.kind == "diurnal" else 0.0)
         t = 0.0
         for i in range(self.num_requests):
             rate = self.rate_rps
@@ -267,6 +329,13 @@ class ArrivalPlan:
                 phase = (t % self.period_s) / self.period_s
                 rate = (self.rate_rps * self.factor if phase < self.duty
                         else self.rate_rps / self.factor)
+            elif self.kind == "diurnal":
+                frac = t / span
+                mult = self.phases[0][1]
+                for f, m in self.phases:
+                    if frac >= float(f):
+                        mult = m   # last phase holds past fraction 1.0
+                rate = self.rate_rps * float(mult)
             t += rng.expovariate(rate)
             out.append(Request(rid=i, arrival_s=t,
                                prompt_len=rng.uniform_int(p_lo, p_hi),

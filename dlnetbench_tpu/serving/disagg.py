@@ -53,11 +53,12 @@ from collections import deque
 
 import jax
 
-from dlnetbench_tpu.metrics import spans, telemetry
+from dlnetbench_tpu.metrics import spans
 from dlnetbench_tpu.models.transformer import (TransformerConfig,
                                                init_params)
 from dlnetbench_tpu.ops.page_migration import MigrationChannel
 from dlnetbench_tpu.serving import metrics as M
+from dlnetbench_tpu.serving import requeue
 from dlnetbench_tpu.serving.arrivals import ArrivalPlan, Request
 from dlnetbench_tpu.serving.scheduler import Engine, ServingConfig
 
@@ -460,32 +461,22 @@ def run_disagg(model_cfg: TransformerConfig, cfg: ServingConfig,
             completed, wall = server.run(requests, injector=injector)
         final = server
     except Exception as e:
-        from dlnetbench_tpu.faults.inject import (RankFailure,
-                                                  RankPreempted)
-        if not isinstance(e, (RankFailure, RankPreempted)) \
-                or fault_plan.policy != "shrink":
-            raise
-        detection_ms = (time.monotonic()
-                        - injector.crash_raised_at) * 1e3
-        telemetry.trigger(
-            "fault", step=server.engine_steps(), detail={
-                "kind": type(e).__name__,
-                "rank": getattr(e, "rank", None),
-                "replica": ("prefill"
-                            if (getattr(e, "rank", 0) or 0)
-                            < cfg.prefill_ranks else "decode"),
-                "iteration": getattr(e, "iteration", None),
-                "detection_ms": round(detection_ms, 3)})
-        victims = set(fault_plan.crash_victims(cfg.world)) \
-            | set(fault_plan.preempt_victims())
-        survivors = [r for r in range(cfg.world) if r not in victims]
+        # the shared crash-shrink head (serving/requeue.py): detection
+        # stamp, fault trigger, survivor set — re-raises non-shrinkable
+        # faults.  The replica tag rides the trigger as caller detail.
+        detection_ms, survivors = requeue.detect_shrink(
+            e, injector=injector, fault_plan=fault_plan,
+            world=cfg.world, step=server.engine_steps(),
+            detail={"replica": ("prefill"
+                                if (getattr(e, "rank", 0) or 0)
+                                < cfg.prefill_ranks else "decode")})
         p_surv = [r for r in survivors if r < cfg.prefill_ranks]
         d_surv = [r for r in survivors if r >= cfg.prefill_ranks]
         if not p_surv or not d_surv:
             # a disaggregated run needs BOTH phases alive — losing a
             # whole replica is unrecoverable under shrink
             raise
-        leftovers = server.drain_unfinished()
+        leftovers = requeue.requeue_unfinished(server)
         done0 = server.prefill.completed + server.decode.completed
         t_origin = server.prefill._t0
         steps0 = server.engine_steps()
@@ -510,8 +501,8 @@ def run_disagg(model_cfg: TransformerConfig, cfg: ServingConfig,
                 prefill_slots=p_slots, decode_slots=d_slots)
         server2.decode.live = server.decode.live
         recovery_ms = (time.monotonic() - t0) * 1e3
-        done1, wall = server2.run(leftovers, injector=injector,
-                                  t_origin=t_origin)
+        done1, wall = requeue.run_requeued(
+            server2, leftovers, injector=injector, t_origin=t_origin)
         completed = done0 + done1
         final = server2
         final.decode.engine_steps += steps0

@@ -1,0 +1,664 @@
+"""Fleet-scale serving: N engine replicas behind one seeded router.
+
+The capacity scaling axis the single-engine tier stops short of
+(ISSUE 18): a front-end ``Router`` (serving/router.py) over N
+INDEPENDENT ``Engine`` replicas — each over its own disjoint device
+subset with its own page pool and its own untouched admission control —
+driven by one host loop from one shared ``ArrivalPlan``.  Per-replica
+scheduling stays bit-identical to ``run_serving``'s engine; the fleet
+adds exactly one decision (which replica's queue a request joins) and
+measures what that decision costs/buys at equal chips.
+
+The driver mirrors ``disagg.DisaggServer``: every replica's programs
+and pools are built under its own device, the loop dispatches all
+replicas' decode programs before fencing any of them (the cross-device
+overlap a real fleet gets for free), and all replicas share ONE clock
+origin so every stamp lives on one timeline.
+
+Elastic capacity (``FleetConfig.autoscale``): an SLO autoscaler watches
+the same rolling windowed signals the flight recorder uses
+(``serving/metrics.rolling_slo_breach`` over pooled recent completions,
+plus raw queue pressure) and resizes the fleet mid-run.  Scale-down
+drains the lightest replica through the shared preempt arc
+(serving/requeue.py — in-flight requests re-queue with their ORIGINAL
+arrival stamps) and retires its devices: wall time spent retired is
+chip-seconds SAVED, the denominator win the diurnal study prices.
+Scale-up rebuilds the replica's engine with the recompile priced into
+the scale event's ``scale_up_ms`` — the p99 blip at each scale event is
+measured, not assumed.  A replica crash (``FaultPlan`` crash/preempt
+under policy ``shrink``, one fault rank per replica) takes the same
+drain arc with no rebuild: the router simply stops offering the dead
+replica and the survivors absorb the re-queued work.
+
+Record shape: the ``fleet`` global is a VOLATILE measurement block
+(per-replica request counts, the routing load histogram, affinity hit
+rate, scale events, chip-second accounting); ``fleet_routing`` and
+``fleet_replicas`` are COMPARABLE globals — records routed by different
+policies, or over different fleet widths, must never merge
+(metrics/merge.py), exactly like mismatched fault plans.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+import jax
+
+from dlnetbench_tpu.metrics import spans
+from dlnetbench_tpu.models.transformer import (TransformerConfig,
+                                               init_params)
+from dlnetbench_tpu.serving import decode as D
+from dlnetbench_tpu.serving import metrics as M
+from dlnetbench_tpu.serving import requeue
+from dlnetbench_tpu.serving.arrivals import ArrivalPlan, Request
+from dlnetbench_tpu.serving.router import ROUTING_POLICIES, Router
+from dlnetbench_tpu.serving.scheduler import Engine, ServingConfig
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Fleet-level knobs (docs/SERVING.md 'Fleet serving')."""
+    replicas: int = 2            # engine replicas (each gets its own
+    #                              cfg.world-device subset + page pool)
+    routing: str = "round_robin"  # serving/router.ROUTING_POLICIES
+    route_seed: int = 0          # the router's splitmix64 stream
+    autoscale: bool = False      # elastic capacity (diurnal studies)
+    min_replicas: int = 1        # autoscale floor — never drain below
+    scale_window_s: float = 0.5  # breach window + idle-tick cadence
+    scale_idle_frac: float = 0.25  # scale down when accepted work /
+    #                                total slots falls below this (and
+    #                                no routed backlog remains)
+    scale_cooldown_s: float = 1.0  # min seconds between scale actions
+    #                                (flap damping; the clock starts at
+    #                                run start, so an idle fleet cannot
+    #                                scale down before traffic arrives)
+
+    def validate(self) -> "FleetConfig":
+        if self.replicas < 1:
+            raise ValueError(f"fleet: replicas must be >= 1, got "
+                             f"{self.replicas}")
+        if self.routing not in ROUTING_POLICIES:
+            raise ValueError(f"fleet: unknown routing "
+                             f"{self.routing!r} (one of "
+                             f"{ROUTING_POLICIES})")
+        if not 1 <= self.min_replicas <= self.replicas:
+            raise ValueError(
+                f"fleet: min_replicas {self.min_replicas} must be in "
+                f"[1, replicas={self.replicas}]")
+        if self.autoscale and self.replicas < 2:
+            raise ValueError(
+                "fleet: autoscale needs replicas >= 2 — a one-replica "
+                "fleet has nothing to drain or rebuild")
+        for name in ("scale_window_s", "scale_cooldown_s"):
+            if not getattr(self, name) > 0:
+                raise ValueError(f"fleet: {name} must be > 0")
+        if not 0.0 < self.scale_idle_frac < 1.0:
+            raise ValueError(
+                f"fleet: scale_idle_frac must be in (0, 1), got "
+                f"{self.scale_idle_frac}")
+        return self
+
+
+class FleetServer:
+    """N independent engines, one router, one clock.  One instance
+    drives ONE measured run (plus per-engine warmup) — replicas retired
+    by a crash stay retired, like ``run_serving`` builds a fresh engine
+    per run."""
+
+    def __init__(self, model_cfg: TransformerConfig, cfg: ServingConfig,
+                 fleet: FleetConfig, *, params=None, devices=None):
+        self.model_cfg = model_cfg
+        self.cfg = cfg.validate()
+        self.fleet = fleet.validate()
+        if cfg.disaggregate:
+            raise ValueError(
+                "serving: fleet replicas are monolithic engines — "
+                "disaggregate + fleet has no stated device-budget "
+                "split (route to run_disagg OR run_fleet, not both)")
+        if fleet.routing == "prefix_affinity" and not cfg.prefix_sharing:
+            raise ValueError(
+                "fleet: prefix_affinity consults each replica's radix "
+                "trie — it requires prefix_sharing=True (without "
+                "sharing every probe returns 0 and the policy is just "
+                "a slower p2c)")
+        need = fleet.replicas * cfg.world
+        devs = (list(devices) if devices is not None
+                else jax.devices()[:need])
+        if len(devs) < need:
+            raise ValueError(
+                f"fleet: {fleet.replicas} replicas x world {cfg.world} "
+                f"need {need} devices, have {len(devs)}")
+        self._params = (params if params is not None
+                        else init_params(jax.random.key(0), model_cfg))
+        self._replica_devices = [devs[r * cfg.world:(r + 1) * cfg.world]
+                                 for r in range(fleet.replicas)]
+        self.devices = devs[:need]
+        self.engines: list[Engine | None] = []
+        for r in range(fleet.replicas):
+            self.engines.append(self._build_engine(r))
+        self.router = Router(fleet.routing, fleet.replicas,
+                             seed=fleet.route_seed)
+        self.live = None          # fleet-level LiveMetricsWriter: the
+        #                           engines' own .live stays None so ONE
+        #                           stream serves all replicas, each
+        #                           line stamped with its replica_id
+        self._prompt_memo: dict[int, object] = {}
+        self._parked: dict[int, Engine] = {}   # warm standby pool:
+        #   autoscaler retirees keep their COMPILED programs + resident
+        #   weights; scale-up revives (host-state reset) instead of
+        #   recompiling.  Crash-dead replicas never park — their chips
+        #   are gone, and a post-crash rebuild pays the full compile.
+        self.scale_events: list[dict] = []
+
+    def _build_engine(self, r: int) -> Engine:
+        """One replica's engine, programs and pools built UNDER its
+        device set; the weights are copied once per replica (same
+        values — token parity with a single engine is unaffected)."""
+        devs = self._replica_devices[r]
+        with jax.default_device(devs[0]):
+            e = Engine(self.model_cfg, self.cfg,
+                       params=jax.device_put(self._params, devs[0]),
+                       devices=devs)
+        e.replica_id = r   # rides the live-metrics stream (ISSUE 18)
+        return e
+
+    def _ctx(self, r: int):
+        return jax.default_device(self._replica_devices[r][0])
+
+    def _active_ix(self) -> list[int]:
+        return [r for r, e in enumerate(self.engines) if e is not None]
+
+    # ---- the driver loop ---------------------------------------------
+    def run(self, requests: list[Request], *, injector=None,
+            fault_plan=None, t_origin: float | None = None
+            ) -> tuple[list[M.Completed], float]:
+        """Drive the fleet until every request completes; returns
+        ``(completed, wall_s)``.  ``fault_plan`` rides along for the
+        in-loop crash arc (fleet world = one fault rank per replica)."""
+        cfg = self.cfg
+        for r in requests:
+            if r.prompt_len + r.output_len > cfg.max_seq_len:
+                raise ValueError(
+                    f"serving: request {r.rid} needs "
+                    f"{r.prompt_len + r.output_len} tokens > "
+                    f"max_seq_len {cfg.max_seq_len}")
+        for r in range(self.fleet.replicas):
+            if self.engines[r] is None:
+                # a fresh run starts at FULL strength: replicas the
+                # previous run's autoscaler (or crash) retired are
+                # revived from the warm pool (or rebuilt), exactly
+                # like run_serving builds a fresh engine per run
+                self.engines[r] = self._parked.pop(
+                    r, None) or self._build_engine(r)
+        for i in self._active_ix():
+            with self._ctx(i):
+                self.engines[i]._reset_state()
+        self.router.reset()
+        self._prompt_memo.clear()
+        self.scale_events = []
+        self._retired_completed: list[M.Completed] = []
+        self._retired_streams: dict[int, list[int]] = {}
+        self._retired_steps = 0
+        self._retired_occupancy: list[int] = []
+        self._retired_stats: dict[int, dict] = {}
+        self._standby: list[int] = []   # scale-down retirees, can return
+        self.queue_depth_max = 0
+        self.concurrent_peak = 0
+        R = self.fleet.replicas
+        self._used_s = [0.0] * R       # serving intervals, engine clock
+        self._saved_s = [0.0] * R      # retired-by-autoscaler intervals
+        self._active_from: list[float | None] = [
+            0.0 if self.engines[r] is not None else None
+            for r in range(R)]
+        self._idle_from: list[float | None] = [None] * R
+        self._queue: deque[Request] = deque(
+            sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
+        self._t0 = time.monotonic() if t_origin is None else t_origin
+        for i in self._active_ix():
+            self.engines[i]._t0 = self._t0
+        self._last_scale_s = 0.0
+        if self.live is not None:
+            self.live.reset_run()
+
+        while self._queue or self._any_engine_work():
+            now = self._now()
+            try:
+                if injector is not None:
+                    injector.before_step()  # faults land INSIDE the loop
+            except Exception as e:
+                self._on_fault(e, injector, fault_plan, now)
+                continue
+            self._autoscale_tick(now)
+            self._route_due(now)
+            active = self._active_ix()
+            for i in active:
+                with self._ctx(i):
+                    self.engines[i]._admit_arrivals(now)
+            self.concurrent_peak = max(
+                self.concurrent_peak,
+                sum(1 for i in active for s in self.engines[i].slots
+                    if s is not None))
+            if not self._any_slot_work():
+                # fleet idle: sleep to the next arrival (open loop),
+                # but keep waking at the autoscaler cadence so a
+                # diurnal trough still gets its scale-down ticks
+                if self._queue:
+                    dt = self._queue[0].arrival_s - self._now()
+                    if self.fleet.autoscale:
+                        dt = min(dt, self.fleet.scale_window_s)
+                    if dt > 0:
+                        time.sleep(dt)
+                continue
+            self._step_all(active)
+            if self.live is not None:
+                now2 = self._now()
+                for i in self._active_ix():
+                    self.live.maybe_emit(self.engines[i], now2)
+        wall = self._now()
+        for r in range(R):
+            if self._active_from[r] is not None:
+                self._used_s[r] += wall - self._active_from[r]
+                self._active_from[r] = None
+            if self._idle_from[r] is not None:
+                self._saved_s[r] += wall - self._idle_from[r]
+                self._idle_from[r] = None
+        completed = sorted(self._all_completed(),
+                           key=lambda c: c.finish_s)
+        return completed, wall
+
+    def _now(self) -> float:
+        return time.monotonic() - self._t0
+
+    def _any_engine_work(self) -> bool:
+        return any(e.queue or e.pending
+                   or any(s is not None for s in e.slots)
+                   for e in self.engines if e is not None)
+
+    def _any_slot_work(self) -> bool:
+        return any(e.pending or any(s is not None for s in e.slots)
+                   for e in self.engines if e is not None)
+
+    def _route_due(self, now: float) -> None:
+        """Pop every due arrival off the fleet queue and hand it to the
+        router's pick — the ONE fleet-level decision.  The chosen
+        replica's own admission control takes it from there."""
+        affinity = self.fleet.routing == "prefix_affinity"
+        while self._queue and self._queue[0].arrival_s <= now:
+            req = self._queue.popleft()
+            toks = self._prompt_of(req) if affinity else None
+            r = self.router.pick(req, self.engines, self._active_ix(),
+                                 prompt_tokens=toks)
+            self.engines[r].queue.append(req)
+        backlog = sum(len(e.queue) + len(e.pending)
+                      for e in self.engines if e is not None)
+        self.queue_depth_max = max(self.queue_depth_max, backlog)
+
+    def _prompt_of(self, req: Request):
+        toks = self._prompt_memo.get(req.rid)
+        if toks is None:
+            toks = D.prompt_tokens_for(req, self.model_cfg.vocab_size)
+            self._prompt_memo[req.rid] = toks
+        return toks
+
+    def _step_all(self, active: list[int]) -> None:
+        """One fleet step: dispatch every working replica's decode
+        program, THEN fence them in dispatch order — while one
+        replica's program runs on its device, the others' dispatches
+        (and inline prefill chunks) run on theirs, the cross-device
+        overlap the disagg driver pioneered, N-wide."""
+        inflight = []
+        for i in active:
+            e = self.engines[i]
+            if not (e.pending
+                    or any(s is not None for s in e.slots)):
+                continue
+            tele_on = e._tele is not None or self.live is not None
+            t_w = time.perf_counter()
+            sync0 = (e.dstate.sync_total_us()
+                     if tele_on and e.dstate is not None else 0.0)
+            with self._ctx(i):
+                ctx = e._step_dispatch()
+            inflight.append((i, e, ctx, tele_on, t_w, sync0))
+        for i, e, ctx, tele_on, t_w, sync0 in inflight:
+            with self._ctx(i):
+                e._step_complete(ctx)
+            if tele_on:
+                e._sample_step((time.perf_counter() - t_w) * 1e6,
+                               sync0)
+
+    # ---- elastic capacity --------------------------------------------
+    def _autoscale_tick(self, now: float) -> None:
+        """One control decision per cooldown window: scale UP when the
+        pooled rolling SLO window breaches or the routed backlog
+        exceeds the active slot capacity (and a standby replica
+        exists); scale DOWN when accepted work sits below the idle
+        fraction of capacity with nothing routed and waiting."""
+        if not self.fleet.autoscale:
+            return
+        if now - self._last_scale_s < self.fleet.scale_cooldown_s:
+            return
+        active = self._active_ix()
+        if not active:
+            return
+        cap = sum(self.engines[i].cfg.slots for i in active)
+        load = sum(Router.load_score(self.engines[i]) for i in active)
+        if self._standby:
+            recent: list[M.Completed] = []
+            for i in active:
+                recent += self.engines[i].completed[-32:]
+            recent.sort(key=lambda c: c.finish_s)
+            breach = M.rolling_slo_breach(
+                recent, slo_ttft_ms=self.cfg.slo_ttft_ms,
+                slo_tpot_ms=self.cfg.slo_tpot_ms, now_s=now,
+                window_s=self.fleet.scale_window_s)
+            if breach is not None or load > cap:
+                self._scale_up(now, reason=("slo_breach"
+                                            if breach is not None
+                                            else "queue_pressure"))
+                return
+        # "nothing routed and waiting" means DUE work: a diurnal
+        # trough holds the whole next peak in the fleet queue as
+        # future arrivals, and those must not pin idle capacity
+        due = bool(self._queue) and self._queue[0].arrival_s <= now
+        if (len(active) > self.fleet.min_replicas
+                and not due
+                and load < self.fleet.scale_idle_frac * cap):
+            self._scale_down(now)
+
+    def _scale_down(self, now: float) -> None:
+        """Drain the lightest-loaded replica through the shared
+        preempt arc and retire its devices: in-flight work re-queues
+        with ORIGINAL stamps (the disruption lands in its latency),
+        and every retired second is a chip-second saved."""
+        active = self._active_ix()
+        victim = min(active,
+                     key=lambda r: (Router.load_score(self.engines[r]),
+                                    r))
+        t0 = time.perf_counter()
+        leftovers = requeue.requeue_unfinished(self.engines[victim])
+        drain_ms = (time.perf_counter() - t0) * 1e3
+        self._requeue_to_fleet(leftovers)
+        self._retire(victim, now, dead=False)
+        self._standby.append(victim)
+        self.scale_events.append({
+            "t_s": round(now, 4), "kind": "scale_down",
+            "replica": victim, "requeued": len(leftovers),
+            "drain_ms": round(drain_ms, 3)})
+        self._last_scale_s = now
+
+    def _scale_up(self, now: float, *, reason: str) -> None:
+        """Bring a standby replica back — from the WARM pool when the
+        autoscaler parked it (compiled programs + resident weights
+        survive retirement; revival is a host-state reset), or a cold
+        rebuild when it never parked.  Either way the spin-up is
+        priced into the scale event (``scale_up_ms``), because elastic
+        capacity that hides its spin-up cost would overstate the
+        autoscaler's win exactly the way an unpriced recovery would
+        overstate a fault policy's."""
+        r = self._standby.pop(0)
+        t0 = time.perf_counter()
+        warm = self._parked.pop(r, None)
+        with spans.span("fleet_scale_up", replica=r,
+                        warm=warm is not None):
+            if warm is not None:
+                e = warm
+                with self._ctx(r):
+                    e._reset_state()
+            else:
+                e = self._build_engine(r)
+        scale_up_ms = (time.perf_counter() - t0) * 1e3
+        e._t0 = self._t0           # the shared timeline
+        self.engines[r] = e
+        if self._idle_from[r] is not None:
+            self._saved_s[r] += now - self._idle_from[r]
+            self._idle_from[r] = None
+        self._active_from[r] = now  # the rebuild wall counts as USED:
+        #                             those chips were compiling, not
+        #                             saving anything
+        self.scale_events.append({
+            "t_s": round(now, 4), "kind": "scale_up", "replica": r,
+            "scale_up_ms": round(scale_up_ms, 3), "reason": reason,
+            "warm": warm is not None})
+        self._last_scale_s = self._now()
+
+    def _retire(self, r: int, now: float, *, dead: bool) -> None:
+        """Take replica ``r`` out of the fleet, folding its run stats
+        into the retired accumulators (its engine object is dropped —
+        pools freed).  ``dead`` replicas (crashes) accrue NEITHER used
+        nor saved chip-seconds after retirement; autoscaler retirees
+        accrue saved time until rebuilt."""
+        e = self.engines[r]
+        self._retired_completed += e.completed
+        for rid, toks in e.token_streams.items():
+            self._retired_streams.setdefault(rid, []).extend(toks)
+        self._retired_steps += e.engine_steps
+        self._retired_occupancy += e._occupancy_samples
+        self._retired_stats[r] = e.cache.stats()
+        if self._active_from[r] is not None:
+            self._used_s[r] += now - self._active_from[r]
+            self._active_from[r] = None
+        self._idle_from[r] = None if dead else now
+        if not dead:
+            self._parked[r] = e   # warm pool: programs stay compiled
+        self.engines[r] = None
+
+    # ---- fault segmentation ------------------------------------------
+    def _on_fault(self, e: BaseException, injector, fault_plan,
+                  now: float) -> None:
+        """A scripted crash/preempt under policy shrink takes whole
+        REPLICAS down (fleet world = one fault rank per replica): the
+        victims drain through the shared re-queue arc, the router stops
+        offering them, and the survivors — never rebuilt, never
+        resurrected — absorb the re-queued work.  Re-raises when no
+        active replica survives (or the fault is not this arc's)."""
+        detection_ms, survivors = requeue.detect_shrink(
+            e, injector=injector, fault_plan=fault_plan,
+            world=self.fleet.replicas, step=self.engine_steps(),
+            detail={"scope": "fleet"})
+        surv = set(survivors)
+        if not any(r in surv for r in self._active_ix()):
+            raise e
+        for v in range(self.fleet.replicas):
+            if v in surv:
+                continue
+            if v in self._standby:
+                self._standby.remove(v)   # dead chips never scale up
+            if self.engines[v] is None:
+                continue
+            leftovers = requeue.requeue_unfinished(self.engines[v])
+            self._requeue_to_fleet(leftovers)
+            self._retire(v, now, dead=True)
+            self.scale_events.append({
+                "t_s": round(now, 4), "kind": "replica_crash",
+                "replica": v, "requeued": len(leftovers),
+                "detection_ms": round(detection_ms, 3)})
+
+    def _requeue_to_fleet(self, leftovers: list[Request]) -> None:
+        """Drained requests rejoin the FLEET queue with their original
+        (past) stamps — the very next ``_route_due`` offers them to the
+        surviving replicas, which is the router-retry the crash study
+        measures."""
+        self._queue = deque(sorted(
+            list(leftovers) + list(self._queue),
+            key=lambda r: (r.arrival_s, r.rid)))
+
+    # ---- record assembly ---------------------------------------------
+    @property
+    def token_streams(self) -> dict:
+        """Per-request greedy streams merged across replicas (rids are
+        disjoint by construction — a request lives on one replica at a
+        time) — the token-parity surface against a single engine."""
+        out = {rid: list(toks)
+               for rid, toks in self._retired_streams.items()}
+        for e in self.engines:
+            if e is None:
+                continue
+            for rid, toks in e.token_streams.items():
+                out.setdefault(rid, []).extend(toks)
+        return out
+
+    def _all_completed(self) -> list[M.Completed]:
+        done = list(self._retired_completed)
+        for e in self.engines:
+            if e is not None:
+                done += e.completed
+        return done
+
+    def engine_steps(self) -> int:
+        return self._retired_steps + sum(
+            e.engine_steps for e in self.engines if e is not None)
+
+    def batch_occupancy_mean(self) -> float:
+        samples = list(self._retired_occupancy)
+        for e in self.engines:
+            if e is not None:
+                samples += e._occupancy_samples
+        if not samples:
+            return 0.0
+        return sum(samples) / len(samples)
+
+    def replica_cache_stats(self) -> list[dict | None]:
+        """Final per-replica pool stats (retired replicas' snapshots
+        taken at retirement) — the per-replica trie hit rates the
+        affinity study's artifact reports."""
+        out: list[dict | None] = []
+        for r in range(self.fleet.replicas):
+            e = self.engines[r]
+            if e is not None:
+                out.append(e.cache.stats())
+            else:
+                out.append(self._retired_stats.get(r))
+        return out
+
+    def chip_seconds(self) -> tuple[float, float]:
+        """(used, saved), device-weighted: every second a replica was
+        serving (or rebuilding) x its device count, and every second
+        the autoscaler kept it retired x the same."""
+        ndev = len(self._replica_devices[0])
+        used = sum(self._used_s) * ndev
+        saved = sum(self._saved_s) * ndev
+        return used, saved
+
+    def fleet_block(self, completed: list[M.Completed]) -> dict:
+        """The record's ``fleet`` global: VOLATILE measurements (live
+        load scores, scale timings and chip-second spend depend on the
+        host), pooled at merge like every measurement block."""
+        used, saved = self.chip_seconds()
+        slo_ok = sum(1 for c in completed
+                     if M.meets_slo(c, self.cfg.slo_ttft_ms,
+                                    self.cfg.slo_tpot_ms))
+        rstats = self.replica_cache_stats()
+        block = {
+            "replicas": self.fleet.replicas,
+            "routing": self.fleet.routing,
+            "route_seed": self.fleet.route_seed,
+            "requests_per_replica": list(self.router.counts),
+            "load_histogram": self.router.load_histogram(),
+            "scale_events": list(self.scale_events),
+            "chip_seconds_used": round(used, 4),
+            "chip_seconds_saved": round(saved, 4),
+            "slo_goodput_per_chip_s": (round(slo_ok / used, 4)
+                                       if used > 0 else 0.0),
+            "queue_depth_max": self.queue_depth_max,
+        }
+        if self.fleet.routing == "prefix_affinity":
+            block["affinity_hit_rate"] = self.router.affinity_hit_rate()
+            block["affinity_bounces"] = self.router.affinity_bounces
+            # migration-free reuse: prefix tokens served off pages the
+            # request was ROUTED to (no cross-replica page motion — the
+            # win over a policy-blind fleet with per-replica tries)
+            block["prefix_reuse_tokens"] = \
+                self.router.prefix_reuse_tokens
+            block["replica_prefix_hit_rate"] = [
+                (s.get("prefix", {}).get("hit_rate", 0.0)
+                 if s else None) for s in rstats]
+        return block
+
+    def global_meta(self, plan: ArrivalPlan) -> dict:
+        from dlnetbench_tpu.parallel.mesh import (describe_mesh,
+                                                  make_flat_mesh)
+        first = next(e for e in self.engines if e is not None)
+        meta = first.global_meta(plan)
+        meta["world_size"] = self.fleet.replicas * self.cfg.world
+        meta["mesh"] = describe_mesh(
+            make_flat_mesh(devices=self.devices))
+        # COMPARABLE globals (not in merge._VOLATILE_GLOBALS, by
+        # design): the routing policy and fleet width are run identity
+        # — a p2c record must never merge with a round_robin one, and
+        # a 2-replica fleet never with a 4-replica one (the serving
+        # block's latencies answer different questions)
+        meta["fleet_routing"] = self.fleet.routing
+        meta["fleet_replicas"] = self.fleet.replicas
+        return meta
+
+
+def run_fleet(model_cfg: TransformerConfig, cfg: ServingConfig,
+              plan: ArrivalPlan, fleet: FleetConfig | None = None, *,
+              fault_plan=None, params=None, devices=None,
+              live_metrics=None):
+    """One measured fleet run -> ``ProxyResult`` (-> ``metrics.emit``).
+
+    Every replica is warmed DIRECTLY (its own synthetic mini-workload,
+    discarded) before the measured run — warmup must not ride the
+    router's seeded stream, or the measured assignment sequence would
+    shift with the warmup count."""
+    fleet = (fleet if fleet is not None else FleetConfig()).validate()
+    server = FleetServer(model_cfg, cfg, fleet, params=params,
+                         devices=devices)
+    if live_metrics is not None:
+        server.live = (live_metrics if hasattr(live_metrics,
+                                               "maybe_emit")
+                       else M.LiveMetricsWriter(live_metrics))
+    requests = plan.sample()
+    if cfg.warmup_requests > 0:
+        p_len = min(cfg.prefill_chunk + 1, cfg.max_seq_len - 2)
+        warm = [Request(rid=-1 - i, arrival_s=0.0, prompt_len=p_len,
+                        output_len=2)
+                for i in range(cfg.warmup_requests)]
+        with spans.span("warmup", what="serving fleet",
+                        reps=len(warm) * fleet.replicas):
+            for i in server._active_ix():
+                with server._ctx(i):
+                    server.engines[i].run(warm)
+    injector = None
+    if fault_plan is not None:
+        from dlnetbench_tpu.faults.inject import FaultInjector
+        fault_plan.validate()
+        # fleet fault geometry: ONE fault rank per replica — a crash
+        # rank r kills replica r whole (its engine is the capacity unit
+        # at this tier, like world ranks are the engine's)
+        injector = FaultInjector(fault_plan, world=fleet.replicas)
+
+    meta = server.global_meta(plan)
+    with spans.span("serving_run", requests=len(requests)):
+        completed, wall = server.run(requests, injector=injector,
+                                     fault_plan=fault_plan)
+    meta["serving"] = M.serving_block(
+        completed, plan, slo_ttft_ms=cfg.slo_ttft_ms,
+        slo_tpot_ms=cfg.slo_tpot_ms, wall_s=wall,
+        engine_steps=server.engine_steps(),
+        queue_depth_max=server.queue_depth_max,
+        batch_occupancy_mean=server.batch_occupancy_mean(),
+        admitted_peak=server.concurrent_peak)
+    meta["fleet"] = server.fleet_block(completed)
+    if cfg.prefix_sharing:
+        # pooled across replicas: per-POOL rates live in the fleet
+        # block's replica_prefix_hit_rate; these globals keep the
+        # single-engine meaning (volatile at merge, ISSUE 12)
+        hits = admits = saved = 0
+        for s in server.replica_cache_stats():
+            if not s:
+                continue
+            p = s.get("prefix", {})
+            hits += p.get("hits", 0)
+            saved += p.get("bytes_saved", 0)
+            admits += s.get("admissions", 0)
+        meta["prefix_hit_rate"] = round(hits / max(admits, 1), 4)
+        meta["prefix_bytes_saved"] = saved
+    if fault_plan is not None:
+        meta["fault_plan"] = fault_plan.to_dict()
+        meta["fault_policy"] = fault_plan.policy
+        meta["fault_injected_delay_us"] = round(
+            injector.injected_delay_us, 1)
+    return M.build_result(completed, plan, meta)

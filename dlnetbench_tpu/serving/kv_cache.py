@@ -351,6 +351,23 @@ class PagedKVCache:
         need = -(-n_tokens // self.cfg.page_size)
         return need <= len(self._free)
 
+    def prefix_match_len(self, prompt_tokens) -> int:
+        """Read-only trie probe (ISSUE 18): how many of this prompt's
+        leading tokens are already RESIDENT in this pool's published
+        pages.  The fleet router's prefix-affinity placement signal —
+        the replica with the longest match serves the prompt with the
+        fewest prefill chunks and zero cross-replica page motion.
+        Capped at ``prompt_len - 1`` exactly like ``plan_admission``
+        (the final prompt token always re-prefills), and deliberately
+        NOT counted in ``prefix_lookups``/hit stats: a routing probe
+        across N replicas is not an admission and must not dilute the
+        per-pool hit rate the density study reports."""
+        if prompt_tokens is None or len(prompt_tokens) < 2:
+            return 0
+        matched, _full, _partial = self.trie.match(
+            np.asarray(prompt_tokens)[: len(prompt_tokens) - 1])
+        return int(matched)
+
     def plan_admission(self, n_tokens: int,
                        prompt_tokens=None) -> AdmissionPlan:
         """Price one admission.  With ``prompt_tokens`` (prefix sharing
@@ -494,6 +511,7 @@ class PagedKVCache:
             "pool_bytes": self.cfg.pool_bytes,
             "pages_in_use": self.pages_in_use,
             "peak_pages_in_use": self.peak_pages_in_use,
+            "admissions": self.admissions,
             "occupancy": round(self.pages_in_use / self.cfg.num_pages, 4),
             "peak_occupancy": round(
                 self.peak_pages_in_use / self.cfg.num_pages, 4),

@@ -179,16 +179,20 @@ class LiveMetricsWriter:
                       window_completed: list[Completed],
                       queue_depth: int, active_slots: int,
                       kv_occupancy: float,
-                      engine_steps: int, run: int = 0) -> dict:
+                      engine_steps: int, run: int = 0,
+                      replica_id: int | None = None) -> dict:
         """One snapshot's dict (pure — the schema-lock test calls this
         directly).  Latency percentiles cover the WINDOW's completions
         only: a live stream must show the current state, not the
         run-to-date mixture.  ``run`` counts engine runs on this
         stream: ``t_s`` is run-relative (every Engine.run restarts the
-        clock at 0), so (run, t_s) — not t_s alone — orders the feed."""
+        clock at 0), so (run, t_s) — not t_s alone — orders the feed.
+        ``replica_id`` (ISSUE 18) attributes the line in a fleet run's
+        interleaved stream; the key is ABSENT on single-engine runs,
+        so existing consumers keep parsing byte-identical lines."""
         ttft = [c.ttft_ms for c in window_completed]
         tpot = [c.tpot_ms for c in window_completed]
-        return {
+        line = {
             "run": int(run),
             "t_s": round(t_s, 3),
             "window_s": window_s,
@@ -200,6 +204,9 @@ class LiveMetricsWriter:
             "kv_occupancy": round(float(kv_occupancy), 4),
             "engine_steps": int(engine_steps),
         }
+        if replica_id is not None:
+            line["replica_id"] = int(replica_id)
+        return line
 
     def maybe_emit(self, engine, now_s: float) -> dict | None:
         """Called by the engine once per step; writes (and returns) a
@@ -217,7 +224,8 @@ class LiveMetricsWriter:
             queue_depth=len(engine.pending),
             active_slots=sum(1 for s in engine.slots if s is not None),
             kv_occupancy=engine.cache.stats()["occupancy"],
-            engine_steps=engine.engine_steps, run=self._run)
+            engine_steps=engine.engine_steps, run=self._run,
+            replica_id=getattr(engine, "replica_id", None))
         import json
         with open(self.path, "a") as f:
             f.write(json.dumps(line) + "\n")

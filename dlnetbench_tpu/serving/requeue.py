@@ -1,0 +1,89 @@
+"""Crash-shrink segmentation helpers shared by every serving runner.
+
+The re-queue-with-ORIGINAL-arrival-stamps arc was spelled three ways
+before ISSUE 18 — ``run_serving``'s crash-shrink except block,
+``run_disagg``'s prefill-crash block, and the fleet router's
+drain/crash paths would have made a third — and three copies of a
+fault-accounting contract is a bug farm.  This module is the single
+spelling:
+
+* ``detect_shrink`` — the catch-side head: re-raise unless the fault
+  is a shrinkable crash/preempt under policy ``shrink``, stamp
+  detection at the catch, fire the ISSUE-14 ``fault`` anomaly trigger
+  (the flight ring into the crash dumps), and hand back the survivor
+  ranks (``FaultPlan.shrink_survivors``).
+* ``requeue_unfinished`` — the re-queue step: drain a source engine or
+  server and hand its leftovers back in arrival order WITH their
+  original arrival stamps, so the disruption lands in the re-run
+  requests' measured latency (never reset — a re-stamped arrival would
+  hide the outage from the SLO timeline).
+* ``run_requeued`` — the continuation: re-run the rebuilt target over
+  the leftovers anchored at the FIRST segment's clock origin, keeping
+  every stamp on one timeline.
+
+Callers keep their own stat-merge bookkeeping (each runner's engines
+carry different accumulators); the fault CONTRACT — what counts as
+shrinkable, when detection is stamped, which ranks survive, and what
+happens to an unfinished request's stamps — lives here once.
+"""
+from __future__ import annotations
+
+import time
+
+from dlnetbench_tpu.metrics import telemetry
+from dlnetbench_tpu.serving.arrivals import Request
+
+
+def detect_shrink(e: BaseException, *, injector, fault_plan, world: int,
+                  step: int, detail: dict | None = None
+                  ) -> tuple[float, list[int]]:
+    """Classify a caught fault for a crash-shrink segmentation.
+
+    Re-raises ``e`` unless it is a ``RankFailure``/``RankPreempted``
+    under policy ``shrink`` (any other exception — or a crash under
+    fail_fast/retry — is not this arc's to absorb).  Otherwise stamps
+    ``detection_ms`` at the catch (wall time from the injector's raise
+    to here — the detection latency every resilience record prices),
+    fires the ``fault`` anomaly trigger with the fault's provenance
+    (``detail`` adds caller context, e.g. which replica owned the dead
+    rank), and returns ``(detection_ms, survivors)``.  An empty
+    survivor list is returned, not raised — liveness rules differ per
+    runner (a disaggregated server also dies when one whole PHASE is
+    gone), so the caller decides when to give up."""
+    from dlnetbench_tpu.faults.inject import RankFailure, RankPreempted
+    if not isinstance(e, (RankFailure, RankPreempted)) \
+            or fault_plan is None or fault_plan.policy != "shrink":
+        raise e
+    detection_ms = (time.monotonic() - injector.crash_raised_at) * 1e3
+    telemetry.trigger("fault", step=step, detail={
+        "kind": type(e).__name__,
+        "rank": getattr(e, "rank", None),
+        "iteration": getattr(e, "iteration", None),
+        "detection_ms": round(detection_ms, 3),
+        **(detail or {})})
+    return detection_ms, fault_plan.shrink_survivors(world)
+
+
+def requeue_unfinished(source) -> list[Request]:
+    """Drain ``source`` (an Engine, DisaggServer, or FleetServer — any
+    object with ``drain_unfinished()``) and hand back its unfinished
+    requests in arrival order, ORIGINAL arrival stamps kept.  The
+    drain frees the source's slots and pages; in-flight requests lose
+    their decode progress (their cache dies with the drained capacity)
+    and the rebuilt capacity redoes their work — the disruption lands
+    in their measured latency, which is the honesty bar every
+    fault-composition study in this repo holds to."""
+    return sorted(source.drain_unfinished(),
+                  key=lambda r: (r.arrival_s, r.rid))
+
+
+def run_requeued(target, leftovers: list[Request], *, injector,
+                 t_origin: float):
+    """Finish a fault-segmented run: drive ``target`` (the rebuilt,
+    degraded engine/server) over the re-queued leftovers with the
+    FIRST segment's clock origin, so every stamp — the survivors'
+    and the re-run requests' — lives on one timeline and the SLO
+    goodput timeline shows the dip AND the recovery.  The injector
+    rides along: later scripted events still land in the degraded
+    segment."""
+    return target.run(leftovers, injector=injector, t_origin=t_origin)

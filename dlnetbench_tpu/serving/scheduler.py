@@ -50,6 +50,7 @@ from dlnetbench_tpu.models.transformer import (TransformerConfig,
                                                init_params)
 from dlnetbench_tpu.serving import decode as D
 from dlnetbench_tpu.serving import metrics as M
+from dlnetbench_tpu.serving import requeue
 from dlnetbench_tpu.serving.arrivals import ArrivalPlan, Request
 from dlnetbench_tpu.serving.kv_cache import (CACHE_DTYPES, CacheConfig,
                                              PagedKVCache,
@@ -1427,29 +1428,17 @@ def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
             completed, wall = engine.run(requests, injector=injector)
         final = engine
     except Exception as e:
-        from dlnetbench_tpu.faults.inject import (RankFailure,
-                                                  RankPreempted)
-        if not isinstance(e, (RankFailure, RankPreempted)) \
-                or fault_plan.policy != "shrink":
-            raise
         # capacity shrink: the dead rank takes its slot share down.
         # Mirrors faults/policy.run_faulted's segmentation: detect,
-        # rebuild (recompile priced), finish degraded.
-        detection_ms = (time.monotonic()
-                        - injector.crash_raised_at) * 1e3
-        # anomaly engine (ISSUE 14): a detected fault is a trigger —
-        # the flight ring into the crash dumps as flight_fault.json
-        telemetry.trigger("fault", step=engine.engine_steps, detail={
-            "kind": type(e).__name__,
-            "rank": getattr(e, "rank", None),
-            "iteration": getattr(e, "iteration", None),
-            "detection_ms": round(detection_ms, 3)})
-        survivors = [r for r in range(cfg.world)
-                     if r not in fault_plan.crash_victims(cfg.world)
-                     and r not in fault_plan.preempt_victims()]
+        # rebuild (recompile priced), finish degraded.  The detection
+        # stamp, fault trigger and survivor set are the shared arc
+        # (serving/requeue.py — re-raises non-shrinkable faults).
+        detection_ms, survivors = requeue.detect_shrink(
+            e, injector=injector, fault_plan=fault_plan,
+            world=cfg.world, step=engine.engine_steps)
         if not survivors:
             raise
-        leftovers = engine.drain_unfinished()
+        leftovers = requeue.requeue_unfinished(engine)
         done0 = list(engine.completed)
         t_origin = engine._t0
         steps0 = engine.engine_steps
@@ -1465,8 +1454,8 @@ def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
                                       for r in survivors])
         engine2.live = engine.live  # the stream outlives the shrink
         recovery_ms = (time.monotonic() - t0) * 1e3
-        done1, wall = engine2.run(leftovers, injector=injector,
-                                  t_origin=t_origin)
+        done1, wall = requeue.run_requeued(
+            engine2, leftovers, injector=injector, t_origin=t_origin)
         completed = done0 + done1
         final = engine2
         final.engine_steps += steps0

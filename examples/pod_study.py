@@ -254,6 +254,16 @@ DISAGG_FLAGS = [
     "--decode_ranks", "1", "--multi_step_n", "4",
 ]
 
+# --fleet (ISSUE 18): the same sweep over a two-replica FLEET — the
+# seeded router places every arrival (p2c on the live load score), each
+# replica keeps its own page pool, and the report's serving_summary
+# carries the fleet_routing/fleet_replicas/fleet_goodput_per_chip_s
+# columns next to the latency bands.  Capacity doubles (2 engines), so
+# the same calibrate-then-sweep protocol finds this arm's own knee.
+FLEET_FLAGS = [
+    "--replicas", "2", "--routing", "p2c",
+]
+
 
 def _serve_argv(records: Path, arrival: str, tags: list[str],
                 extra: list[str] | None = None) -> list:
@@ -272,10 +282,21 @@ def run_serving_plan(args, records: Path) -> int:
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (repo, env.get("PYTHONPATH")) if p)
+    # the disagg/fleet arms need a multi-device mesh; honor a caller's
+    # own XLA_FLAGS (same discipline as run_plan)
+    if not env.get("XLA_FLAGS"):
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={args.devices}")
     failed = 0
     disagg = bool(getattr(args, "disagg", False))
-    extra = DISAGG_FLAGS if disagg else None
-    eng_tag = f"engine={'disagg' if disagg else 'mono'}"
+    fleet = bool(getattr(args, "fleet", False))
+    if disagg:
+        extra, eng = DISAGG_FLAGS, "disagg"
+    elif fleet:
+        extra, eng = FLEET_FLAGS, "fleet"
+    else:
+        extra, eng = None, "mono"
+    eng_tag = f"engine={eng}"
 
     # 1. capacity calibration: a saturating rate (every request queued
     # at t~0) — measured_rps IS the engine's drain capacity here
@@ -1001,6 +1022,16 @@ def main() -> int:
                          "and once with into different --out_dir for "
                          "the Pareto comparison (docs/studies/"
                          "disagg_r17 automates exactly that)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="with --serving: run the sweep over a "
+                         "two-replica FLEET (ISSUE 18; seeded p2c "
+                         "router over independent engines, each with "
+                         "its own page pool) — the serving_summary "
+                         "carries the fleet_* columns; compare "
+                         "against a plain --serving run into a "
+                         "different --out_dir for the equal-chips "
+                         "question (docs/studies/fleet_r18 holds the "
+                         "committed routing/autoscale/crash bars)")
     ap.add_argument("--kv_density", action="store_true",
                     help="run the serving-density study instead of the "
                          "proxy grid (ISSUE 12): dense vs int8 vs fp8 "
@@ -1032,6 +1063,10 @@ def main() -> int:
                     help="skip the sweep; re-analyze an existing "
                          "records.jsonl in --out_dir")
     args = ap.parse_args()
+    if args.disagg and args.fleet:
+        ap.error("--disagg and --fleet are different serving arms — "
+                 "run them into separate --out_dir (the engine refuses "
+                 "the composition too)")
     if args.backend == "pjrt-hier" and args.tier != "native":
         ap.error("--backend pjrt-hier applies to --tier native (the jax "
                  "tier composes ICI x DCN through jax.distributed instead)")

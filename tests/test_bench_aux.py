@@ -335,6 +335,15 @@ def test_live_metrics_line_schema_locked(tmp_path):
                          "ttft_ms", "tpot_ms", "queue_depth",
                          "active_slots", "kv_occupancy",
                          "engine_steps"}
+    # single-engine: unattributed — the key is absent so pre-fleet
+    # consumers keep parsing byte-identical lines; a fleet replica's
+    # stream carries it (ISSUE 18)
+    fleet_line = LiveMetricsWriter.snapshot_line(
+        t_s=0.5, window_s=0.5, window_completed=done, queue_depth=3,
+        active_slots=2, kv_occupancy=0.625, engine_steps=40, run=1,
+        replica_id=2)
+    assert fleet_line["replica_id"] == 2
+    assert set(fleet_line) - set(line) == {"replica_id"}
     assert line["run"] == 1  # (run, t_s) orders the feed — t_s is
     #                          run-relative and restarts per engine run
     assert line["completed"] == 5 and line["queue_depth"] == 3
@@ -367,6 +376,65 @@ def test_live_metrics_line_schema_locked(tmp_path):
     import bench
     args = bench._parse_args(["--live-metrics", str(path)])
     assert args.live_metrics == str(path)
+
+
+def test_fleet_line_schema_locked():
+    """bench.py's fleet_ab aux line (ISSUE 18) is a BENCH artifact:
+    lock the three-arm routing A/B schema — ms headline from the
+    PREFIX_AFFINITY arm's round-median TTFT p50 (sentinel-comparable),
+    {value, best, band, n} sub-objects for TTFT p50/p99 + tokens/s on
+    ALL THREE arms, the affinity arm's hit-rate and prefix-reuse
+    bands, and the band-disjoint routing verdict vs round_robin."""
+    import bench
+
+    def _round(ttft50, ttft99, tps, *, hit=None, reuse=None):
+        r = {"serving": {"ttft_ms": {"p50": ttft50, "p99": ttft99},
+                         "tokens_per_s": tps}}
+        if hit is not None:
+            r["fleet"] = {"replicas": 2, "affinity_hit_rate": hit,
+                          "prefix_reuse_tokens": reuse}
+        else:
+            r["fleet"] = {"replicas": 2}
+        return r
+
+    rr = [_round(10.0, 22.0, 100.0), _round(11.0, 24.0, 95.0),
+          _round(10.5, 23.0, 98.0)]
+    p2 = [_round(9.0, 20.0, 105.0), _round(9.5, 21.0, 102.0),
+          _round(9.2, 20.5, 104.0)]
+    pa = [_round(4.0, 12.0, 130.0, hit=0.8, reuse=256.0),
+          _round(4.5, 13.0, 125.0, hit=0.75, reuse=224.0),
+          _round(4.2, 12.5, 128.0, hit=0.8, reuse=256.0)]
+    line = bench._fleet_line(
+        {"round_robin": rr, "p2c": p2, "prefix_affinity": pa},
+        suffix=", test", token_parity=True)
+    assert line["unit"] == "ms"
+    assert line["value"] == 4.2 and line["n"] == 3
+    assert line["band"] == [4.0, 4.5] and line["best"] == 4.0
+    for arm in ("round_robin", "p2c", "prefix_affinity"):
+        for key in ("ttft_p50_ms", "ttft_p99_ms", "tokens_per_s"):
+            sub = line[arm][key]
+            for k in ("value", "best", "band", "n"):
+                assert k in sub, (arm, key, k)
+    for key in ("affinity_hit_rate", "prefix_reuse_tokens"):
+        for k in ("value", "best", "band", "n"):
+            assert k in line["prefix_affinity"][key], (key, k)
+    assert line["prefix_affinity"]["affinity_hit_rate"]["value"] == 0.8
+    # TTFT bands [10.0, 11.0] vs [4.0, 4.5]: disjoint AND lower — the
+    # routing verdict the fleet study prices
+    assert line["ttft_band_disjoint_drop"] is True
+    assert line["token_parity"] is True
+    # overlapping bands must NOT claim the win
+    flat = bench._fleet_line(
+        {"round_robin": rr, "p2c": rr,
+         "prefix_affinity": [dict(r, fleet={"replicas": 2,
+                                            "affinity_hit_rate": 0.0,
+                                            "prefix_reuse_tokens": 0.0})
+                             for r in rr]})
+    assert flat["ttft_band_disjoint_drop"] is False
+    assert "token_parity" not in flat
+    # sentinel comparability: an ms line, auto-compared by --check
+    from dlnetbench_tpu.sentinel import is_ms_line
+    assert is_ms_line(line)
 
 
 def _ab_round(e2e_p99, tokens_per_s, *, n=1, spd=1.0, dev_us=50000.0,

@@ -1,0 +1,494 @@
+"""Fleet-scale serving (ISSUE 18): the seeded router (round_robin /
+p2c / prefix_affinity), the diurnal arrival shape, the shared re-queue
+arc, fleet-vs-single-engine token parity, assignment replay
+determinism, the committed two-replica record fixture's parser ->
+merge round trip, and the elastic/crash e2e arcs."""
+from __future__ import annotations
+
+import copy
+import json
+import math
+import time
+import types
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from dlnetbench_tpu.metrics import telemetry
+from dlnetbench_tpu.models import transformer as tfm
+from dlnetbench_tpu.serving.arrivals import ArrivalPlan, Request
+from dlnetbench_tpu.serving.fleet import FleetConfig, FleetServer, run_fleet
+from dlnetbench_tpu.serving.kv_cache import CacheConfig, PagedKVCache
+from dlnetbench_tpu.serving.router import ROUTING_POLICIES, Router
+from dlnetbench_tpu.serving.scheduler import Engine, ServingConfig
+
+DATA = Path(__file__).parent / "data"
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def tiny_model(**over) -> tfm.TransformerConfig:
+    kw = dict(vocab_size=64, embed_dim=32, num_heads=4, num_kv_heads=2,
+              ff_dim=64, num_layers=2, seq_len=32, gated=True,
+              max_positions=0, dtype="float32")
+    kw.update(over)
+    return tfm.TransformerConfig(**kw)
+
+
+def fleet_serving(**over) -> ServingConfig:
+    kw = dict(slots=2, page_size=8, num_pages=32, max_seq_len=32,
+              slo_ttft_ms=250.0, slo_tpot_ms=100.0, attn_impl="gather",
+              warmup_requests=0)
+    kw.update(over)
+    return ServingConfig(**kw)
+
+
+def burst_trace(n: int, *, prompt=6, output=3) -> ArrivalPlan:
+    """All arrivals at t=0: the whole batch routes before any engine
+    step, so the router-visible state evolves identically run over run
+    (the replay-determinism precondition router.py documents)."""
+    return ArrivalPlan(kind="replay", trace=[
+        {"t": 0.0, "prompt_len": prompt + (i % 3),
+         "output_len": output + (i % 2)} for i in range(n)])
+
+
+def _fake_engine(queued=0, pending=0, occupied=0, slots=2):
+    """A router-visible engine surface: accepted-but-unfinished work
+    plus the slot capacity the bounce condition reads."""
+    return types.SimpleNamespace(
+        queue=[object()] * queued, pending=[object()] * pending,
+        slots=[object()] * occupied + [None] * (slots - occupied),
+        cfg=types.SimpleNamespace(slots=slots))
+
+
+def _req(rid: int):
+    return types.SimpleNamespace(rid=rid)
+
+
+# ---------------------------------------------------------------------
+# the router: policies, load signal, seeded replayability
+
+
+def test_router_refusals_and_policy_set():
+    assert ROUTING_POLICIES == ("round_robin", "p2c", "prefix_affinity")
+    with pytest.raises(ValueError, match="unknown policy"):
+        Router("random", 2)
+    with pytest.raises(ValueError, match="num_replicas"):
+        Router("round_robin", 0)
+    r = Router("round_robin", 2)
+    with pytest.raises(RuntimeError, match="no active replica"):
+        r.pick(_req(0), [_fake_engine(), _fake_engine()], [])
+
+
+def test_round_robin_cycles_and_skips_inactive():
+    engines = [_fake_engine() for _ in range(3)]
+    r = Router("round_robin", 3)
+    got = [r.pick(_req(i), engines, [0, 1, 2]) for i in range(6)]
+    assert got == [0, 1, 2, 0, 1, 2]
+    # replica 1 retired: the pointer keeps advancing, dead index skipped
+    got = [r.pick(_req(6 + i), engines, [0, 2]) for i in range(4)]
+    assert got == [0, 2, 0, 2]
+    assert r.counts == [4, 2, 4]
+    assert r.assignments[0] == (0, 0) and len(r.assignments) == 10
+
+
+def test_p2c_prefers_lighter_and_first_draw_wins_ties():
+    # two replicas: both draws always land on {0, 1}, so the pick is
+    # purely the load comparison — the heavier replica never wins
+    light, heavy = _fake_engine(queued=0), _fake_engine(queued=3)
+    r = Router("p2c", 2, seed=11)
+    got = {r.pick(_req(i), [light, heavy], [0, 1]) for i in range(8)}
+    assert got == {0}
+    # equal scores: strict < means the FIRST draw wins every tie, so
+    # the seeded stream alone determines the sequence
+    a = Router("p2c", 2, seed=3)
+    b = Router("p2c", 2, seed=3)
+    eng = [_fake_engine(), _fake_engine()]
+    seq_a = [a.pick(_req(i), eng, [0, 1]) for i in range(16)]
+    seq_b = [b.pick(_req(i), eng, [0, 1]) for i in range(16)]
+    assert seq_a == seq_b                      # same seed, same stream
+    assert set(seq_a) == {0, 1}                # both replicas drawn
+    # one active replica: zero draws consumed (router.py's contract)
+    c = Router("p2c", 2, seed=3)
+    state0 = c._rng.state
+    assert c.pick(_req(0), eng, [1]) == 1
+    assert c._rng.state == state0
+
+
+def test_load_score_counts_all_accepted_work():
+    assert Router.load_score(_fake_engine()) == 0
+    assert Router.load_score(
+        _fake_engine(queued=2, pending=1, occupied=2)) == 5
+    # the bounce condition: every slot spoken for by resident OR
+    # already-queued work
+    assert Router._is_full(_fake_engine(occupied=2, slots=2))
+    assert Router._is_full(_fake_engine(queued=2, slots=2))
+    assert not Router._is_full(_fake_engine(occupied=1, slots=2))
+
+
+def test_load_histogram_indexes_by_score():
+    engines = [_fake_engine(queued=2), _fake_engine()]
+    r = Router("round_robin", 2)
+    r.pick(_req(0), engines, [0, 1])   # replica 0, score 2
+    r.pick(_req(1), engines, [0, 1])   # replica 1, score 0
+    assert r.load_samples == [2, 0]
+    assert r.load_histogram() == [1, 0, 1]
+    assert Router("round_robin", 2).load_histogram() == []
+
+
+def test_prefix_match_len_probe_is_readonly():
+    """The routing probe reports resident prefix tokens (capped at
+    prompt_len - 1, like plan_admission) WITHOUT touching the pool's
+    admission-time hit-rate counters — N probes per request across a
+    fleet must not dilute the per-pool rate the density study reports."""
+    cache = PagedKVCache(CacheConfig(
+        num_layers=2, num_kv_heads=2, head_dim=16, num_pages=16,
+        page_size=4, max_seqs=2, max_pages_per_seq=4).validate())
+    toks = np.arange(8)
+    cache.allocate(0, 8)
+    cache.publish(0, toks)
+    before = cache.prefix_lookups
+    # same prompt: 7 of 8 tokens match (the final token always
+    # re-prefills); a foreign prompt matches nothing
+    assert cache.prefix_match_len(toks) == 7
+    assert cache.prefix_match_len(np.arange(50, 58)) == 0
+    assert cache.prefix_match_len(None) == 0
+    assert cache.prefix_match_len(toks[:1]) == 0
+    assert cache.prefix_lookups == before
+    assert cache.prefix_hits == 0
+
+
+# ---------------------------------------------------------------------
+# the diurnal arrival shape
+
+
+def test_diurnal_fixture_roundtrip():
+    plan = ArrivalPlan.loads(f"@{DATA / 'arrival_diurnal.json'}")
+    assert plan.kind == "diurnal" and plan.num_requests == 24
+    assert len(plan.phases) == 4 and plan.phases[0][0] == 0.0
+    assert plan.to_dict() == json.loads(
+        (DATA / "arrival_diurnal.json").read_text())
+    a = plan.sample()
+    b = ArrivalPlan.from_dict(json.loads(plan.dumps())).sample()
+    assert [(r.arrival_s, r.prompt_len, r.output_len) for r in a] \
+        == [(r.arrival_s, r.prompt_len, r.output_len) for r in b]
+    assert len(a) == 24
+    assert all(a[i].arrival_s <= a[i + 1].arrival_s
+               for i in range(len(a) - 1))
+
+
+def test_diurnal_phases_modulate_arrival_density():
+    """A trough-then-peak curve must thin the early arrivals and pack
+    the late ones: mean inter-arrival gap in the low phase >> in the
+    high phase (the shape the autoscaler study rides)."""
+    plan = ArrivalPlan(kind="diurnal", rate_rps=50.0, num_requests=60,
+                       seed=9, prompt_len=4, output_len=2,
+                       phases=[[0.0, 0.2], [0.5, 4.0]])
+    ts = [r.arrival_s for r in plan.sample()]
+    span = plan.num_requests / plan.rate_rps   # the plan's day length
+    gaps_lo = [b - a for a, b in zip(ts, ts[1:]) if a < 0.5 * span]
+    gaps_hi = [b - a for a, b in zip(ts, ts[1:]) if a >= 0.5 * span]
+    assert gaps_lo and gaps_hi
+    mean = lambda xs: sum(xs) / len(xs)                       # noqa: E731
+    assert mean(gaps_lo) > 3 * mean(gaps_hi)
+
+
+# ---------------------------------------------------------------------
+# config refusals
+
+
+def test_fleet_config_refusals():
+    with pytest.raises(ValueError, match="replicas"):
+        FleetConfig(replicas=0).validate()
+    with pytest.raises(ValueError, match="unknown routing"):
+        FleetConfig(routing="sticky").validate()
+    with pytest.raises(ValueError, match="min_replicas"):
+        FleetConfig(replicas=2, min_replicas=3).validate()
+    with pytest.raises(ValueError, match="autoscale"):
+        FleetConfig(replicas=1, autoscale=True).validate()
+    with pytest.raises(ValueError, match="scale_window_s"):
+        FleetConfig(scale_window_s=0.0).validate()
+    with pytest.raises(ValueError, match="scale_idle_frac"):
+        FleetConfig(scale_idle_frac=1.0).validate()
+    # a fleet of monolithic engines: disaggregate has no stated split
+    with pytest.raises(ValueError, match="disaggregate"):
+        FleetServer(tiny_model(),
+                    fleet_serving(world=2, disaggregate=True,
+                                  prefill_ranks=1, decode_ranks=1),
+                    FleetConfig(replicas=2))
+    # affinity without tries is a slower p2c — refuse loudly
+    with pytest.raises(ValueError, match="prefix_sharing"):
+        FleetServer(tiny_model(), fleet_serving(prefix_sharing=False),
+                    FleetConfig(routing="prefix_affinity"))
+    with pytest.raises(ValueError, match="devices"):
+        FleetServer(tiny_model(), fleet_serving(),
+                    FleetConfig(replicas=2),
+                    devices=jax.devices()[:1])
+
+
+# ---------------------------------------------------------------------
+# the shared re-queue arc (serving/requeue.py)
+
+
+def test_requeue_keeps_original_stamps_and_orders_by_arrival():
+    from dlnetbench_tpu.serving import requeue
+
+    reqs = [Request(rid=2, arrival_s=0.7, prompt_len=4, output_len=2),
+            Request(rid=0, arrival_s=0.1, prompt_len=4, output_len=2),
+            Request(rid=1, arrival_s=0.1, prompt_len=4, output_len=2)]
+    src = types.SimpleNamespace(drain_unfinished=lambda: list(reqs))
+    out = requeue.requeue_unfinished(src)
+    assert [(r.rid, r.arrival_s) for r in out] \
+        == [(0, 0.1), (1, 0.1), (2, 0.7)]   # ORIGINAL stamps, in order
+
+
+def test_detect_shrink_classifies_and_rereaises():
+    from dlnetbench_tpu.faults.inject import RankFailure
+    from dlnetbench_tpu.faults.plan import FaultEvent, FaultPlan
+    from dlnetbench_tpu.serving import requeue
+
+    inj = types.SimpleNamespace(crash_raised_at=time.monotonic())
+    fp = FaultPlan(events=[FaultEvent(kind="crash", ranks=[0],
+                                      iteration=1)], policy="shrink")
+    det, surv = requeue.detect_shrink(
+        RankFailure(0, 1), injector=inj, fault_plan=fp, world=2, step=3)
+    assert det >= 0 and surv == [1]
+    # anything else is not this arc's to absorb
+    with pytest.raises(ValueError, match="boom"):
+        requeue.detect_shrink(ValueError("boom"), injector=inj,
+                              fault_plan=fp, world=2, step=3)
+    ff = FaultPlan(events=[FaultEvent(kind="crash", ranks=[0],
+                                      iteration=1)], policy="fail_fast")
+    with pytest.raises(RankFailure):
+        requeue.detect_shrink(RankFailure(0, 1), injector=inj,
+                              fault_plan=ff, world=2, step=3)
+
+
+# ---------------------------------------------------------------------
+# fleet e2e: lossless routing (token parity) + replayable assignment
+
+
+def test_fleet_token_parity_and_assignment_replay():
+    """Routing is lossless placement: a 2-replica fleet's greedy
+    streams are IDENTICAL to a single engine's over the same weights
+    and requests, for every policy.  And routing is replayable: the
+    same plan + seed + policy reproduces the same assignment log run
+    over run (t=0 burst — router.py's determinism precondition)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("fleet needs >= 2 devices")
+    mc = tiny_model()
+    cfg = fleet_serving(prefix_sharing=True)
+    plan = burst_trace(8)
+    params = tfm.init_params(jax.random.PRNGKey(0), mc)
+
+    single = Engine(mc, cfg, params=params)
+    single.run(plan.sample())
+    ref_streams = {rid: list(t) for rid, t in
+                   single.token_streams.items()}
+    assert len(ref_streams) == 8
+
+    for policy in ROUTING_POLICIES:
+        srv = FleetServer(mc, cfg,
+                          FleetConfig(replicas=2, routing=policy,
+                                      route_seed=4),
+                          params=params, devices=jax.devices()[:2])
+        completed, _ = srv.run(plan.sample())
+        assert len(completed) == 8
+        assert srv.token_streams == ref_streams, policy
+        first = list(srv.router.assignments)
+        assert sum(srv.router.counts) == 8
+        blk = srv.fleet_block(completed)
+        assert blk["requests_per_replica"] == srv.router.counts
+        assert sum(blk["load_histogram"]) == 8
+        assert blk["chip_seconds_used"] > 0
+        assert blk["chip_seconds_saved"] == 0.0   # no autoscaler
+        # replay: the measured run starts from the seeded origin
+        completed2, _ = srv.run(plan.sample())
+        assert len(completed2) == 8
+        assert list(srv.router.assignments) == first, policy
+    # round_robin on a burst splits the batch evenly by construction
+    rr = FleetServer(mc, cfg, FleetConfig(replicas=2), params=params,
+                     devices=jax.devices()[:2])
+    rr.run(plan.sample())
+    assert rr.router.counts == [4, 4]
+
+
+# ---------------------------------------------------------------------
+# the record pathway: committed two-replica fixture round trip
+
+
+def test_fleet_record_fixture_roundtrip():
+    """The committed fleet record (a REAL 2-replica p2c run of
+    serving/fleet.run_fleet) flows parser -> merge -> summary with the
+    routing provenance and chip-second columns populated."""
+    from dlnetbench_tpu.analysis.bandwidth import serving_summary
+    from dlnetbench_tpu.metrics.merge import merge_records
+    from dlnetbench_tpu.metrics.parser import (load_records,
+                                               records_to_dataframe,
+                                               validate_record)
+    records = load_records(DATA / "record_fleet.jsonl")
+    assert len(records) == 1
+    rec = records[0]
+    validate_record(rec)
+    g = rec["global"]
+    assert g["fleet_routing"] == "p2c" and g["fleet_replicas"] == 2
+    flt = g["fleet"]
+    assert sum(flt["requests_per_replica"]) == 10
+    assert flt["chip_seconds_used"] > 0
+    assert flt["slo_goodput_per_chip_s"] > 0
+
+    df = records_to_dataframe(records)
+    for col in ("fleet_routing", "fleet_replicas",
+                "fleet_replica_req_max", "fleet_replica_req_min",
+                "fleet_chip_seconds_used", "fleet_chip_seconds_saved",
+                "fleet_slo_goodput_per_chip_s", "fleet_scale_events"):
+        assert col in df.columns, col
+    assert df["fleet_replica_req_max"].iloc[0] == \
+        max(flt["requests_per_replica"])
+
+    merged = merge_records(records)   # single-process identity
+    validate_record(merged)
+    row = serving_summary([merged]).iloc[0]
+    assert row["routing"] == "p2c" and row["replicas"] == 2
+    assert row["goodput_per_chip_s"] == flt["slo_goodput_per_chip_s"]
+    assert not math.isnan(row["chip_seconds_saved"])
+
+
+def test_fleet_merge_volatile_vs_identity_split():
+    """The ``fleet`` measurement block is VOLATILE (live load scores
+    and chip-second spend differ per host — merge pools them), but
+    ``fleet_routing``/``fleet_replicas`` are run IDENTITY: a p2c
+    record must never merge with a round_robin one."""
+    from dlnetbench_tpu.metrics.merge import merge_records
+    from dlnetbench_tpu.metrics.parser import load_records
+
+    base = load_records(DATA / "record_fleet.jsonl")[0]
+    a, b = copy.deepcopy(base), copy.deepcopy(base)
+    a["global"]["num_processes"] = b["global"]["num_processes"] = 2
+    b["process"] = 1
+    # split the two replicas' rank rows across the two hosts
+    a["ranks"] = [r for r in a["ranks"] if r["rank"] == 0]
+    b["ranks"] = [dict(r, process_index=1) for r in b["ranks"]
+                  if r["rank"] == 1]
+    b["global"]["fleet"] = dict(
+        b["global"]["fleet"], chip_seconds_used=99.0,
+        load_histogram=[0, 1])     # volatile: differing is fine
+    merged = merge_records([a, b])
+    assert merged["global"]["fleet_routing"] == "p2c"
+    assert sorted(r["rank"] for r in merged["ranks"]) == [0, 1]
+
+    c = copy.deepcopy(b)
+    c["global"]["fleet_routing"] = "round_robin"
+    with pytest.raises(ValueError, match="fleet_routing"):
+        merge_records([a, c])
+    d = copy.deepcopy(b)
+    d["global"]["fleet_replicas"] = 4
+    with pytest.raises(ValueError, match="fleet_replicas"):
+        merge_records([a, d])
+    # pre-fleet single-engine records never grew the columns
+    mono = load_records(DATA / "record_serving.jsonl")
+    from dlnetbench_tpu.metrics.parser import records_to_dataframe
+    assert "fleet_routing" not in records_to_dataframe(mono).columns
+
+
+def test_single_engine_serving_summary_defaults():
+    """Pre-fleet records summarize with the neutral provenance — one
+    replica, no routing policy — so fleet and single-engine rows sit
+    in one table."""
+    from dlnetbench_tpu.analysis.bandwidth import serving_summary
+    from dlnetbench_tpu.metrics.parser import load_records
+
+    row = serving_summary(
+        load_records(DATA / "record_serving.jsonl")).iloc[0]
+    assert row["routing"] == "-" and row["replicas"] == 1
+    assert math.isnan(row["goodput_per_chip_s"])
+
+
+# ---------------------------------------------------------------------
+# elastic capacity + crash arcs (heavy: real schedules, wall clocks)
+
+
+@pytest.mark.slow
+def test_autoscale_drains_trough_and_rebuilds_for_peak():
+    """Two bursts with a dead trough between them: the autoscaler
+    drains a replica in the trough (chip-seconds saved on the meter)
+    and rebuilds it for the second burst (recompile priced into the
+    scale event); every request still completes."""
+    if len(jax.devices()) < 2:
+        pytest.skip("fleet needs >= 2 devices")
+    mc = tiny_model()
+    cfg = fleet_serving()
+    trace = [{"t": 0.002 * i, "prompt_len": 6, "output_len": 3}
+             for i in range(4)]
+    # the second burst lands SIMULTANEOUSLY so one routing tick sees
+    # the whole backlog (spaced arrivals would drain one-per-step on a
+    # warm survivor and never build queue pressure)
+    trace += [{"t": 2.2, "prompt_len": 6, "output_len": 8}
+              for _ in range(8)]
+    plan = ArrivalPlan(kind="replay", trace=trace)
+    srv = FleetServer(
+        mc, cfg,
+        FleetConfig(replicas=2, autoscale=True, min_replicas=1,
+                    scale_window_s=0.15, scale_idle_frac=0.5,
+                    scale_cooldown_s=0.3))
+    completed, _ = srv.run(plan.sample())
+    assert len(completed) == len(trace)        # nothing lost to scaling
+    kinds = [e["kind"] for e in srv.scale_events]
+    assert "scale_down" in kinds
+    assert "scale_up" in kinds
+    up = next(e for e in srv.scale_events if e["kind"] == "scale_up")
+    assert up["scale_up_ms"] > 0 and up["reason"] in (
+        "queue_pressure", "slo_breach")
+    # the autoscaler's retiree parks WARM: revival is a state reset,
+    # not a recompile, and the event says so
+    assert up["warm"] is True
+    used, saved = srv.chip_seconds()
+    assert saved > 0           # the trough's retired seconds, metered
+    assert used > 0
+    blk = srv.fleet_block(completed)
+    assert blk["chip_seconds_saved"] == round(saved, 4)
+    assert blk["scale_events"] == srv.scale_events
+
+
+@pytest.mark.slow
+def test_replica_crash_reroutes_to_survivor():
+    """Crash replica 0 mid-plan under shrink: its in-flight work
+    re-queues with ORIGINAL stamps, the router stops offering the dead
+    replica, the survivor absorbs everything, and the record stamps
+    the crash event + fault provenance."""
+    if len(jax.devices()) < 2:
+        pytest.skip("fleet needs >= 2 devices")
+    from dlnetbench_tpu.faults.plan import FaultEvent, FaultPlan
+    from dlnetbench_tpu.metrics.emit import result_to_record
+    from dlnetbench_tpu.metrics.parser import validate_record
+
+    mc = tiny_model()
+    cfg = fleet_serving()
+    trace = [{"t": 0.01 * i, "prompt_len": 6, "output_len": 4}
+             for i in range(10)]
+    plan = ArrivalPlan(kind="replay", trace=trace)
+    fp = FaultPlan(events=[FaultEvent(kind="crash", ranks=[0],
+                                      iteration=4)], policy="shrink")
+    res = run_fleet(mc, cfg, plan, FleetConfig(replicas=2),
+                    fault_plan=fp)
+    assert res.num_runs == len(trace)          # every request completes
+    g = res.global_meta
+    assert g["fault_policy"] == "shrink"
+    crash = [e for e in g["fleet"]["scale_events"]
+             if e["kind"] == "replica_crash"]
+    assert len(crash) == 1 and crash[0]["replica"] == 0
+    assert crash[0]["detection_ms"] >= 0
+    # post-crash requests all landed on the survivor: replica 0's
+    # count stops where the crash caught it
+    per = g["fleet"]["requests_per_replica"]
+    assert per[1] > per[0]
+    validate_record(result_to_record(res))

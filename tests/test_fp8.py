@@ -56,6 +56,7 @@ def test_fp8_dot_straight_through_grads():
                         atol=1e-2, rtol=1e-2)
 
 
+@pytest.mark.slow  # ~70s e2e train step; dot/VJP parity rides the fast lane
 def test_transformer_fp8_mlp_trains():
     """mlp_dtype='float8' plumbs through the dense SwiGLU stack: a tiny
     train step runs, loss is finite, grads flow into the MLP weights."""

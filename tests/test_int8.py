@@ -218,6 +218,7 @@ def test_int8_backward_config_validation():
                           int8_backward="switchback")
 
 
+@pytest.mark.slow  # ~60s/recipe e2e train step; dot/VJP parity rides the fast lane
 @pytest.mark.parametrize("int8_backward", ["master", "switchback"])
 def test_transformer_int8_mlp_trains(int8_backward):
     """mlp_dtype='int8' plumbs through the dense SwiGLU stack (both

@@ -65,6 +65,7 @@ def test_dwd_kernel_unit_multistep_accumulation(shapes):
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-4)
 
 
+@pytest.mark.slow  # ~60s e2e train step; the kernel-unit cases ride the fast lane
 def test_transformer_pallas_backward_path():
     """The mlp_backward='pallas' config wires through _block and trains
     (grad finite) on the CPU mesh."""

@@ -511,3 +511,36 @@ def test_moe_ab_line_is_comparable():
     old = sentinel.check({"headline": _line(10.0, [9.9, 10.1])},
                          cur)
     assert old["verdict"] == "clean"
+
+
+@pytest.mark.sentinel
+def test_fleet_ab_line_is_comparable():
+    """The fleet_ab aux line (ISSUE 18) rides the headline like every
+    ms line: the sentinel compares it by the prefix_affinity arm's
+    TTFT p50, band-aware lower-is-better, and the nested per-policy
+    bands never confuse the comparison."""
+    def fleet_line(value, band):
+        return {"metric": "fleet_ab: round_robin vs p2c vs "
+                          "prefix_affinity routing at equal chips",
+                "value": value, "unit": "ms", "best": band[0],
+                "band": band, "n": 3,
+                "round_robin": {"ttft_p50_ms": {
+                    "value": value * 1.5, "best": band[0] * 1.5,
+                    "band": [b * 1.5 for b in band], "n": 3}},
+                "ttft_band_disjoint_drop": True}
+
+    assert sentinel.is_ms_line(fleet_line(5.0, [4.5, 5.5]))
+    base = {"headline": _line(10.0, [9.9, 10.1]),
+            "fleet_ab": fleet_line(5.0, [4.5, 5.5])}
+    cur = {"headline": _line(10.0, [9.9, 10.1]),
+           "fleet_ab": fleet_line(10.0, [9.5, 10.5])}
+    sent = sentinel.check(base, cur)
+    assert sent["verdict"] == "regression"
+    assert sent["regressions"] == ["fleet_ab"]
+    ok = sentinel.check(base, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "fleet_ab": fleet_line(5.2, [4.6, 5.6])})
+    assert ok["verdict"] == "clean"
+    # a baseline predating the line compares clean (new line ignored)
+    old = sentinel.check({"headline": _line(10.0, [9.9, 10.1])}, cur)
+    assert old["verdict"] == "clean"

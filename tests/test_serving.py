@@ -47,7 +47,16 @@ def tiny_serving(**over):
 
 def test_arrival_plan_validation_errors():
     with pytest.raises(ValueError, match="unknown kind"):
-        ArrivalPlan(kind="diurnal").validate()
+        ArrivalPlan(kind="lunar").validate()
+    with pytest.raises(ValueError, match="phases"):
+        ArrivalPlan(kind="diurnal", rate_rps=10.0,
+                    num_requests=5, phases=[]).validate()
+    with pytest.raises(ValueError, match="phases"):
+        ArrivalPlan(kind="diurnal", rate_rps=10.0, num_requests=5,
+                    phases=[[0.5, 2.0], [0.2, 1.0]]).validate()
+    with pytest.raises(ValueError, match="multiplier"):
+        ArrivalPlan(kind="diurnal", rate_rps=10.0, num_requests=5,
+                    phases=[[0.0, -1.0]]).validate()
     with pytest.raises(ValueError, match="rate_rps > 0"):
         ArrivalPlan(kind="poisson", rate_rps=-3.0,
                     num_requests=5).validate()

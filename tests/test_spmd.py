@@ -35,6 +35,7 @@ def test_spmd_step_runs_and_learns(eight_devices):
         assert np.all(np.isfinite(np.asarray(leaf, dtype=np.float32)))
 
 
+@pytest.mark.slow  # ~20s sharded train step; bf16 twin covers the fast lane
 def test_spmd_int8_mlp_step_runs_and_learns(eight_devices):
     """mlp_int8=True (expert matmuls quantized per-tensor, int32 MXU
     accumulation, straight-through backward) on the full dp x pp x tp
