@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test check-bench check-resilience check-serving check-tuning \
 	check-longcontext check-decode check-density check-telemetry \
-	check-moe check-disagg check-fleet sentinel-scan
+	check-moe check-disagg check-fleet check-sampling sentinel-scan
 
 # tier-1: the full default test lane (see ROADMAP.md for the canonical
 # driver invocation with its timeout/log plumbing)
@@ -168,6 +168,23 @@ check-fleet:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
 	    tests/test_bench_aux.py::test_fleet_line_schema_locked \
 	    tests/test_sentinel.py::test_fleet_ab_line_is_comparable
+
+# the sampling lane (ISSUE 19, docs/SERVING.md "Sampling, speculation
+# & constrained decode"): the fmix32 key-derivation golden values, the
+# filter pipeline + inverse-CDF math, the JSON grammar automaton, the
+# N-step==1-step bit-identity lock, the crash-shrink replay property,
+# the chi-square distribution-equality locks (plain draws AND the
+# rejection-sampling verify rule), composition with speculative decode
+# and prefix sharing, the committed record_sampling.jsonl parser ->
+# merge round trip (comparable identity vs volatile acceptance curve),
+# the CLI flag surface, and the sampling_ab bench-line schema +
+# sentinel comparability.  ~90s wall.
+check-sampling:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q -m 'sampling and not slow' \
+	    tests/test_sampling.py
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest -q \
+	    tests/test_bench_aux.py::test_sampling_ab_line_schema_locked \
+	    tests/test_sentinel.py::test_sampling_ab_line_is_comparable
 
 # stat-band-aware walk over the committed driver artifacts: fails when
 # the LATEST BENCH_r*.json regressed against its predecessor

@@ -573,6 +573,11 @@ def _run_bench(args, tracer) -> int:
         # engines at EQUAL pool bytes — admitted concurrency, tokens/s
         # and the per-recipe decode-parity bars
         kv_density = _aux("kv density A/B", _bench_kv_density)
+        # the ISSUE-19 sampling evidence: seeded sampling with vs
+        # without lossless speculative sampling at T=0.8, plus the
+        # classic-vs-fused bit-identity witness — tiny engines, three
+        # compiles (the bench HEADLINE stays greedy)
+        sampling_ab = _aux("sampling A/B", _bench_sampling_ab)
         # the ISSUE-16 disaggregation evidence: monolithic vs split
         # prefill/decode meshes at equal chips on one seeded plan —
         # two tiny engines + the migration channel, one compile each
@@ -646,6 +651,7 @@ def _run_bench(args, tracer) -> int:
         **({"straggler_ab": straggler} if straggler else {}),
         **({"checkpoint_ab": ckpt_ab} if ckpt_ab else {}),
         **({"serving_decode": serving} if serving else {}),
+        **({"sampling_ab": sampling_ab} if sampling_ab else {}),
         **({"kv_density_ab": kv_density} if kv_density else {}),
         **({"disagg_ab": disagg_ab} if disagg_ab else {}),
         **({"fleet_ab": fleet_ab} if fleet_ab else {}),
@@ -1268,6 +1274,141 @@ def _bench_fleet_ab() -> dict | None:
                f"shared_prefix={plan.shared_prefix_len} "
                f"pool={plan.prefix_pool}, {dev.device_kind}",
         token_parity=parity)
+    print(json.dumps(line))
+    return line
+
+
+def _sampling_ab_line(sampled_rounds: list[dict],
+                      spec_rounds: list[dict], suffix: str = "", *,
+                      token_identity: bool | None = None) -> dict:
+    """Assemble the sampling_ab aux line from paired per-round
+    ``serving`` blocks (pure — tests/test_bench_aux.py locks this
+    schema).  The two arms run SEEDED SAMPLING at T=0.8: the fused
+    N-step engine without speculation vs the same engine with
+    lossless speculative sampling (truncated drafter).  The headline
+    ``value`` is the SPECULATIVE arm's round-median e2e p99 in ms
+    (lower is better, sentinel-comparable like serving_decode; the
+    bench HEADLINE stays greedy — this line is the sampled tier's own
+    evidence).  Both arms ship artifact-grade ``{value, best, band,
+    n}`` bands, the spec arm adds its measured acceptance-rate band,
+    the verdict is the ISSUE-19 question — did rejection-sampling
+    speculation push sampled tokens/s band-disjointly ABOVE the
+    non-spec sampled arm? — and ``token_identity`` locks the other
+    half of the tentpole: the classic 1-step sampled stream equals
+    the fused N-step sampled stream bit for bit."""
+    def _bands(rounds: list[dict]) -> dict:
+        return {
+            "e2e_p99_ms": stats_mod.summarize(
+                [r["e2e_ms"]["p99"] for r in rounds], ndigits=3),
+            "tpot_p50_ms": stats_mod.summarize(
+                [r["tpot_ms"]["p50"] for r in rounds], ndigits=3),
+            "tokens_per_s": stats_mod.summarize(
+                [r["tokens_per_s"] for r in rounds], ndigits=2),
+        }
+    sampled, spec = _bands(sampled_rounds), _bands(spec_rounds)
+    spec["acceptance_rate"] = stats_mod.summarize(
+        [((r.get("decode_loop") or {}).get("spec") or {})
+         .get("acceptance_rate", 0.0) for r in spec_rounds],
+        ndigits=4)
+    tps_s, tps_p = sampled["tokens_per_s"], spec["tokens_per_s"]
+    disjoint = (stats_mod.bands_overlap(tps_s["band"], tps_p["band"])
+                is False and tps_p["value"] > tps_s["value"])
+    p99 = spec["e2e_p99_ms"]
+    line = {
+        "metric": f"sampling_ab: seeded sampling T=0.8 — fused decode "
+                  f"vs lossless speculative sampling (rejection "
+                  f"verify, truncated drafter), same seeded plan "
+                  f"(serving/sampling){suffix}",
+        "value": p99["value"],
+        "unit": "ms",
+        "best": p99["best"],
+        "band": p99["band"],
+        "n": p99["n"],
+        "sampled": sampled,
+        "spec_sampled": spec,
+        "tokens_per_s_band_disjoint_gain": disjoint,
+        "verdict": ("speculative sampling pushed sampled tokens/s "
+                    "above the non-spec arm, bands disjoint — the "
+                    "rejection verify kept the speedup sampling used "
+                    "to forfeit" if disjoint else
+                    "tokens/s bands overlap — no speculation gain "
+                    "under sampling at this scale/noise"),
+    }
+    if token_identity is not None:
+        line["token_identity"] = bool(token_identity)
+    return stats_mod.flag_low_mode(line)
+
+
+def _bench_sampling_ab() -> dict | None:
+    """The ISSUE-19 A/B: two sampled engines — SAME weights, SAME
+    seeded saturating plan, SAME draw keys (seed/uid/position) —
+    fused N-step seeded sampling vs fused N-step + lossless
+    speculative sampling, interleaved per round (r4 pairing).  A
+    classic 1-step sampled engine runs once alongside as the
+    bit-identity witness (the tentpole's replay lock: the fused
+    stream must EQUAL the 1-step stream token for token — sampling
+    keyed by (seed, uid, position) makes N a pure perf knob)."""
+    import dataclasses
+
+    from dlnetbench_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+    from dlnetbench_tpu.serving import metrics as smetrics
+    from dlnetbench_tpu.serving.arrivals import ArrivalPlan
+    from dlnetbench_tpu.serving.scheduler import Engine, ServingConfig
+
+    mc = TransformerConfig(
+        vocab_size=256, embed_dim=64, num_heads=4, num_kv_heads=2,
+        ff_dim=128, num_layers=2, seq_len=64, gated=True,
+        max_positions=0, dtype="float32")
+    # gather attention on every backend: the bit-identity lock needs
+    # one attention basis (same reasoning as serving_decode's parity)
+    base = ServingConfig(slots=4, page_size=8, num_pages=48,
+                         max_seq_len=40, slo_ttft_ms=250.0,
+                         slo_tpot_ms=100.0, attn_impl="gather",
+                         temperature=0.8, top_p=0.95, sample_seed=7)
+    n_fused = 16
+    variants = {
+        "sampled": dataclasses.replace(base, multi_step_n=n_fused),
+        "spec_sampled": dataclasses.replace(
+            base, multi_step_n=n_fused, speculative=True, spec_k=4,
+            drafter="truncated", drafter_layers=1),
+    }
+    plan = ArrivalPlan(kind="poisson", rate_rps=5000.0,
+                       num_requests=8, seed=0, prompt_len=[8, 16],
+                       output_len=[16, 24])
+    params = init_params(jax.random.key(0), mc)
+    requests = plan.sample()
+    engines = {name: Engine(mc, cfg, params=params)
+               for name, cfg in variants.items()}
+    one_step = Engine(mc, base, params=params)
+    one_step.run(requests)          # the witness: one replay suffices
+    one_step.run(requests)
+    witness = dict(one_step.token_streams)
+    for eng in engines.values():
+        eng.run(requests)   # warm round (first-dispatch), discarded
+    rounds: dict[str, list] = {name: [] for name in engines}
+    identity = True
+    for _ in range(3):
+        for name, eng in engines.items():
+            completed, wall = eng.run(requests)
+            if name == "sampled":
+                identity = identity and (dict(eng.token_streams)
+                                         == witness)
+            rounds[name].append(smetrics.serving_block(
+                completed, plan, slo_ttft_ms=base.slo_ttft_ms,
+                slo_tpot_ms=base.slo_tpot_ms, wall_s=wall,
+                engine_steps=eng.engine_steps,
+                cache_stats=eng.cache.stats(),
+                queue_depth_max=eng.queue_depth_max,
+                batch_occupancy_mean=eng.batch_occupancy_mean(),
+                decode_loop=eng.decode_loop_block()))
+    dev = jax.devices()[0]
+    line = _sampling_ab_line(
+        rounds["sampled"], rounds["spec_sampled"],
+        suffix=f", {len(requests)} req slots={base.slots} "
+               f"N={n_fused} spec_k=4 T={base.temperature} "
+               f"top_p={base.top_p}, {dev.device_kind}",
+        token_identity=identity)
     print(json.dumps(line))
     return line
 

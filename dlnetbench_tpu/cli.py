@@ -560,6 +560,35 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
                         "the target + shared head")
     p.add_argument("--drafter_layers", type=int, default=1,
                    help="truncated drafter depth (< --layers)")
+    # seeded sampling + constrained decode (ISSUE 19).  Draws are
+    # keyed by (sample_seed, request uid, stream position) — stateless,
+    # so N-step fusing, adaptive N, and crash-shrink re-queue all
+    # replay bit-identical tokens (docs/SERVING.md 'Sampling,
+    # speculation & constrained decode')
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="sampling temperature: 0 = greedy (the "
+                        "default, byte-identical to pre-sampling "
+                        "engines); >0 turns on on-device seeded "
+                        "sampling")
+    p.add_argument("--sample_top_k", type=int, default=0,
+                   help="keep only the k highest-probability tokens "
+                        "before drawing (0 = off; needs "
+                        "--temperature > 0; --top_k is the MoE "
+                        "experts-per-token knob)")
+    p.add_argument("--top_p", type=float, default=1.0,
+                   help="nucleus sampling mass in (0, 1]: keep the "
+                        "smallest prefix of the sorted distribution "
+                        "whose mass reaches p (1.0 = off; needs "
+                        "--temperature > 0)")
+    p.add_argument("--sample_seed", type=int, default=0,
+                   help="the draw-key seed (replay identity: records "
+                        "under different seeds refuse to merge)")
+    p.add_argument("--grammar", default="", choices=["", "json"],
+                   help="constrained decode: mask generated tokens to "
+                        "a grammar automaton (json = depth-3 bracket "
+                        "grammar over token classes); composes with "
+                        "--speculative (out-of-grammar drafts "
+                        "auto-reject) and --prefix_sharing")
     p.add_argument("--num_experts", type=int, default=1,
                    help=">1 turns every layer's MLP into a MoE "
                         "(ISSUE 15): decode batches tokens per expert "
@@ -706,7 +735,10 @@ def _run_serve(args, parser) -> int:
         disaggregate=args.disaggregate,
         prefill_ranks=args.prefill_ranks,
         decode_ranks=args.decode_ranks,
-        migration_chunk_pages=args.migration_chunk_pages)
+        migration_chunk_pages=args.migration_chunk_pages,
+        temperature=args.temperature, top_k=args.sample_top_k,
+        top_p=args.top_p, sample_seed=args.sample_seed,
+        grammar=args.grammar)
     try:
         srv_cfg.validate()
         if srv_cfg.speculative:

@@ -284,10 +284,22 @@ class CompiledLoop(CompiledStep):
     def __init__(self, fn: Callable, example_args: tuple,
                  carry_argnums: tuple,
                  compiler_options: dict | None = None):
+        carry_argnums = tuple(carry_argnums)
+        if len(set(carry_argnums)) != len(carry_argnums) or any(
+                b <= a for a, b in zip(carry_argnums,
+                                       carry_argnums[1:])):
+            # the rebind walk below pairs carries with leading outputs
+            # IN ARGNUM ORDER — an out-of-order or repeated argnum
+            # would silently pair the wrong buffers (shape-compatible
+            # carries, e.g. two [6, slots] int32 blocks, would pass
+            # the structural check and corrupt state at the rebind)
+            raise ValueError(
+                f"CompiledLoop: carry_argnums must be strictly "
+                f"increasing and unique, got {carry_argnums}")
         super().__init__(fn, example_args,
-                         donate_argnums=tuple(carry_argnums),
+                         donate_argnums=carry_argnums,
                          compiler_options=compiler_options)
-        self.carry_argnums = tuple(carry_argnums)
+        self.carry_argnums = carry_argnums
         out_leaves = jax.tree.leaves(self.out_info)
         pos = 0
         for argnum in self.carry_argnums:

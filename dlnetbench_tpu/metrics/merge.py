@@ -79,6 +79,16 @@ _VOLATILE_GLOBALS = {"energy_source", "energy_scope", "burn_ns_per_iter",
                      # legitimately differ across hosts/reruns of ONE
                      # plan — like every other serving measurement
                      "serving", "prefix_hit_rate", "prefix_bytes_saved",
+                     # spec-decode acceptance vs temperature (ISSUE
+                     # 19): a MEASUREMENT — acceptance moves with
+                     # params/load, so reruns of one plan legitimately
+                     # differ.  The "sampling" identity block
+                     # (temperature/top_k/top_p/sample_seed/grammar)
+                     # is deliberately NOT here: it stays comparable
+                     # automatically, so records drawn under different
+                     # seeds or temperatures refuse to merge — mixed
+                     # draw keys would average incomparable streams
+                     "spec_acceptance_by_temp",
                      # tuning provenance (ISSUE 9): each process
                      # consults its own DB on its own disk (and a host
                      # without the env set consults nothing) — per-

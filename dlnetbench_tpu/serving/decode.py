@@ -135,7 +135,8 @@ def _split_pools(cache_cfg: CacheConfig, pools: tuple):
 def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
                  params, pools, tokens, positions, write_ok,
                  block_tables, *, layers: int | None = None,
-                 moe_bias=None):
+                 moe_bias=None, sampler=None, uids=None, gstate=None,
+                 return_logits: bool = False):
     """ONE batched single-token step over the paged cache — the math
     both the single-step program and the fused multi-step loop body run
     (sharing the definition is what makes N-step-vs-1-step token parity
@@ -159,7 +160,21 @@ def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
     (``serving/moe_decode.moe_mlp_rounds``; ``moe_bias`` is the seeded
     skew-injection knob) and the return value grows a third element:
     ``(pools, next_tokens, (expert_load [E], rounds))`` summed over
-    the layer stack — the imbalance telemetry the engine records."""
+    the layer stack — the imbalance telemetry the engine records.
+
+    SAMPLING (ISSUE 19): with a ``serving/sampling.DeviceSampler``,
+    ``next_tokens`` is the seeded counter-keyed draw instead of the
+    argmax — keyed by ``(sample_seed, uids[b], positions[b], lane)``,
+    i.e. the FED position is the counter, so every program built on
+    this body (1-step, fused N-step, spec verify) draws bit-identical
+    tokens at the same stream position.  ``gstate`` [B] is the
+    grammar-automaton state used to mask logits; TRANSITIONS are the
+    caller's job (the fused loop advances its state row in-carry, the
+    classic engine advances host-side at the fence).  ``sampler=None``
+    is the byte-identical pre-ISSUE-19 greedy path.  ``return_logits``
+    appends the raw logits to the return (the speculative drafter
+    needs the distribution, not just a token; mutually exclusive with
+    MoE, which spec refuses anyway)."""
     b = tokens.shape[0]
     scale = cfg.head_dim ** -0.5
     page_size = cache_cfg.page_size
@@ -228,17 +243,23 @@ def _step_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig, attn,
     x = L.rmsnorm(x, params["final_norm"])
     head = params["embed"].T if cfg.tied_embeddings else params["head"]
     logits = jnp.dot(x, head, preferred_element_type=_F32)
-    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if sampler is None:
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        next_tokens = sampler.draw_tokens(logits, uids, positions,
+                                          gstate)
     pools_out = ((k_pages, v_pages, k_scale, v_scale) if quant
                  else (k_pages, v_pages))
     if moe:
         return pools_out, next_tokens, (moe_load, moe_rounds)
+    if return_logits:
+        return pools_out, next_tokens, logits
     return pools_out, next_tokens
 
 
 def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
                      *, attn_impl: str = "auto", mesh=None,
-                     moe_bias=None):
+                     moe_bias=None, sampler=None):
     """``decode_step(params, k_pages, v_pages, tokens, positions,
     block_tables, active) -> (k_pages, v_pages, next_tokens)``.
 
@@ -257,20 +278,46 @@ def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
 
     MoE configs (ISSUE 15) append the per-step imbalance stats to the
     outputs — ``(..., next_tokens, expert_load, rounds)`` — and take
-    the seeded ``moe_bias`` skew knob (serving/moe_decode.py)."""
+    the seeded ``moe_bias`` skew knob (serving/moe_decode.py).
+
+    With a ``sampler`` (serving/sampling.DeviceSampler — ISSUE 19)
+    the signature grows two trailing operands: ``decode_step(...,
+    active, uids, gstate)`` — per-slot request uids (the draw key) and
+    grammar-automaton states (the logit mask; grammar transitions stay
+    HOST-side here, since the classic engine fences every token
+    anyway).  The sampler-less signature and program are untouched."""
     check_config(cfg, decode=True)
     attn = _attn_fn(cache_cfg, attn_impl, mesh)
     moe = cfg.num_experts > 1
 
-    def _run(params, pools, tokens, positions, block_tables, active):
+    def _run(params, pools, tokens, positions, block_tables, active,
+             uids=None, gstate=None):
         out = _step_tokens(cfg, cache_cfg, attn, params, pools, tokens,
                            positions, active, block_tables,
-                           moe_bias=moe_bias)
+                           moe_bias=moe_bias, sampler=sampler,
+                           uids=uids, gstate=gstate)
         if moe:
             pools, nxt, (load, rounds) = out
             return (*pools, nxt, load, rounds)
         pools, nxt = out
         return (*pools, nxt)
+
+    if sampler is not None:
+        if cache_cfg.quantized:
+            def decode_step(params, k_pages, v_pages, k_scale, v_scale,
+                            tokens, positions, block_tables, active,
+                            uids, gstate):
+                return _run(params,
+                            (k_pages, v_pages, k_scale, v_scale),
+                            tokens, positions, block_tables, active,
+                            uids, gstate)
+            return decode_step
+
+        def decode_step(params, k_pages, v_pages, tokens, positions,
+                        block_tables, active, uids, gstate):
+            return _run(params, (k_pages, v_pages), tokens, positions,
+                        block_tables, active, uids, gstate)
+        return decode_step
 
     if cache_cfg.quantized:
         def decode_step(params, k_pages, v_pages, k_scale, v_scale,
@@ -287,17 +334,22 @@ def make_decode_step(cfg: TransformerConfig, cache_cfg: CacheConfig,
     return decode_step
 
 
-# rows of the packed device slot-state carry ([4, slots] int32 — ONE
+# rows of the packed device slot-state carry ([6, slots] int32 — ONE
 # array crosses the host<->device boundary per sync direction, not
-# four; device_state.py mirrors the same layout)
-STATE_LAST, STATE_POS, STATE_REM, STATE_LIMIT = 0, 1, 2, 3
-STATE_ROWS = 4
+# six; device_state.py mirrors the same layout).  ISSUE 19 grew the
+# block past 4 rows: STATE_UID carries the request id every sampled
+# draw is keyed by, STATE_GRAMMAR the per-slot grammar-automaton
+# state.  Both rows ride (as zeros) even in greedy engines — the loop
+# carries them untouched, so greedy token streams are unchanged.
+(STATE_LAST, STATE_POS, STATE_REM, STATE_LIMIT, STATE_UID,
+ STATE_GRAMMAR) = 0, 1, 2, 3, 4, 5
+STATE_ROWS = 6
 
 
 def make_multi_step_decode(cfg: TransformerConfig,
                            cache_cfg: CacheConfig, n_max: int, *,
                            attn_impl: str = "auto", mesh=None,
-                           moe_bias=None):
+                           moe_bias=None, sampler=None):
     """The device-resident fused decode loop (ISSUE 11 tentpole).
 
     ``multi_step(params, k_pages, v_pages, state, block_tables,
@@ -308,10 +360,13 @@ def make_multi_step_decode(cfg: TransformerConfig,
     program (``lax.while_loop`` — dynamic trip count, so an adaptive
     ``n_steps`` needs no recompile and the loop exits early the moment
     every slot is done).  Slot state lives in the packed ``state``
-    carry (``[4, slots]`` int32 — rows ``STATE_LAST`` the token each
+    carry (``[6, slots]`` int32 — rows ``STATE_LAST`` the token each
     slot feeds next, ``STATE_POS`` the cache write index = tokens
     cached, ``STATE_REM`` output tokens still owed, ``STATE_LIMIT``
-    the prompt+output reservation cap).  ``remaining > 0`` IS the
+    the prompt+output reservation cap, ``STATE_UID`` the request id
+    sampled draws key by, ``STATE_GRAMMAR`` the grammar-automaton
+    state — the last two carried untouched when greedy/unconstrained).
+    ``remaining > 0`` IS the
     active/done bit: a slot whose budget hits 0 deactivates itself
     in-loop, stops writing the cache, and waits for the host to evict
     it at the next sync.  ``tokens_out[b, j]`` holds slot ``b``'s j-th
@@ -335,7 +390,15 @@ def make_multi_step_decode(cfg: TransformerConfig,
     loop body and append the ACCUMULATED imbalance stats to the
     outputs — ``(..., steps_run, expert_load, rounds)`` summed over
     the loop trips — so one host sync still carries the whole
-    dispatch window's telemetry."""
+    dispatch window's telemetry.
+
+    With a ``sampler`` (ISSUE 19) each in-loop step draws via the
+    counter-keyed sampler (uid row + fed position — NO PRNG state in
+    the carry, which is exactly why N-step sampling is bit-identical
+    to 1-step and adaptive ``n_steps`` still recompiles nothing) and
+    the body advances the ``STATE_GRAMMAR`` row through the automaton
+    after each accepted token.  The signature is UNCHANGED — the state
+    block already carries everything sampling needs."""
     check_config(cfg, decode=True)
     if n_max < 1:
         raise ValueError(f"multi_step_decode: n_max must be >= 1, "
@@ -365,7 +428,9 @@ def make_multi_step_decode(cfg: TransformerConfig,
             act = rem > 0
             step_out = _step_tokens(cfg, cache_cfg, attn, params, pc,
                                     last, pos, act, block_tables,
-                                    moe_bias=moe_bias)
+                                    moe_bias=moe_bias, sampler=sampler,
+                                    uids=st[STATE_UID],
+                                    gstate=st[STATE_GRAMMAR])
             if moe:
                 pc, nxt, (load_s, rounds_s) = step_out
                 load = load + load_s
@@ -380,6 +445,10 @@ def make_multi_step_decode(cfg: TransformerConfig,
             st = st.at[STATE_LAST].set(jnp.where(act, nxt, last))
             st = st.at[STATE_POS].set(pos + step)
             st = st.at[STATE_REM].set(rem - step)
+            if sampler is not None and sampler.trans_dev is not None:
+                g = st[STATE_GRAMMAR]
+                st = st.at[STATE_GRAMMAR].set(
+                    jnp.where(act, sampler.advance(g, nxt), g))
             cnt = cnt + step
             return (i + 1, *pc, st, out, cnt, load, rounds)
 
@@ -411,7 +480,7 @@ def make_multi_step_decode(cfg: TransformerConfig,
 
 
 def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
-                       chunk: int, *, moe_bias=None):
+                       chunk: int, *, moe_bias=None, sampler=None):
     """``prefill_chunk(params, k_pages, v_pages, tokens, start, n_valid,
     block_row) -> (k_pages, v_pages, next_token)``.
 
@@ -439,7 +508,17 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
     ...)``): chunk writes re-quantize their pages against a fresh amax
     (``kv_cache.quant_write_span``) and the gathered pages dequantize
     before the score matmul; the dense signature/program is
-    untouched."""
+    untouched.
+
+    With a ``sampler`` (ISSUE 19) the signature grows ONE trailing
+    ``uid`` scalar operand (the request id) and the TTFT token becomes
+    the seeded draw keyed by ``(sample_seed, uid, start + last)`` —
+    the fed position of the last prompt token, i.e. the same counter
+    convention as every decode program, so the whole stream is one
+    consistent key sequence.  The grammar state for the FIRST
+    generated token is the automaton's start state (the synthetic
+    prompt is not grammar-conformant; the grammar constrains GENERATED
+    tokens only)."""
     check_config(cfg)
     scale = cfg.head_dim ** -0.5
     page_size = cache_cfg.page_size
@@ -457,7 +536,8 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
         # page for alignment slack
         pages_w = min(pmax, -(-(window - 1 + chunk) // page_size) + 1)
 
-    def _prefill(params, pools, tokens, start, n_valid, block_row):
+    def _prefill(params, pools, tokens, start, n_valid, block_row,
+                 uid=None):
         k_pages, v_pages, k_scale, v_scale = _split_pools(cache_cfg,
                                                           pools)
         positions = start + jnp.arange(chunk, dtype=jnp.int32)
@@ -564,7 +644,16 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
         x = L.rmsnorm(x, params["final_norm"])
         head = params["embed"].T if cfg.tied_embeddings else params["head"]
         logits = jnp.dot(x[last], head, preferred_element_type=_F32)
-        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if sampler is None:
+            next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            # the TTFT draw: counter = fed position of the LAST valid
+            # prompt token; grammar state = automaton start (batch of
+            # one through the shared batched draw)
+            g0 = jnp.full((1,), sampler.start_state, jnp.int32)
+            next_token = sampler.draw_tokens(
+                logits[None], jnp.reshape(uid, (1,)).astype(jnp.int32),
+                (start + last)[None], g0)[0]
         pools_out = ((k_pages, v_pages, k_scale, v_scale) if quant
                      else (k_pages, v_pages))
         if cfg.num_experts > 1:
@@ -573,14 +662,31 @@ def make_prefill_chunk(cfg: TransformerConfig, cache_cfg: CacheConfig,
 
     moe = cfg.num_experts > 1
 
-    def _wrap(params, pools, tokens, start, n_valid, block_row):
+    def _wrap(params, pools, tokens, start, n_valid, block_row,
+              uid=None):
         out = _prefill(params, pools, tokens, start, n_valid,
-                       block_row)
+                       block_row, uid)
         if moe:
             pools, nxt, (load, rounds) = out
             return (*pools, nxt, load, rounds)
         pools, nxt = out
         return (*pools, nxt)
+
+    if sampler is not None:
+        if quant:
+            def prefill_chunk(params, k_pages, v_pages, k_scale,
+                              v_scale, tokens, start, n_valid,
+                              block_row, uid):
+                return _wrap(params,
+                             (k_pages, v_pages, k_scale, v_scale),
+                             tokens, start, n_valid, block_row, uid)
+            return prefill_chunk
+
+        def prefill_chunk(params, k_pages, v_pages, tokens, start,
+                          n_valid, block_row, uid):
+            return _wrap(params, (k_pages, v_pages), tokens, start,
+                         n_valid, block_row, uid)
+        return prefill_chunk
 
     if quant:
         def prefill_chunk(params, k_pages, v_pages, k_scale, v_scale,

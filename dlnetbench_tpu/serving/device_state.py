@@ -4,10 +4,12 @@ The ISSUE 11 engine split: ``scheduler.Engine`` keeps HOST-side
 scheduling state (the arrival queue, pending list, page free-list,
 per-request bookkeeping) while everything the fused decode loop needs
 per step lives HERE, on device, between syncs — packed into ONE
-``[4, slots]`` int32 carry (``decode.STATE_*`` rows: last token,
-position, remaining budget, reservation limit; ``remaining > 0`` IS
-the active/done bit) plus the block tables and, when speculative, the
-per-slot ngram table.  Packing matters: a sync crosses the boundary
+``[6, slots]`` int32 carry (``decode.STATE_*`` rows: last token,
+position, remaining budget, reservation limit, request uid, grammar
+state; ``remaining > 0`` IS the active/done bit — the uid/grammar
+rows are ISSUE 19's sampling provenance and ride as zeros in greedy
+engines) plus the block tables and, when speculative, the per-slot
+ngram table.  Packing matters: a sync crosses the boundary
 as one transfer per array, and the first draft of this module moved
 six tiny arrays per direction — the sync cost rivaled the dispatch
 cost the loop exists to amortize.
@@ -38,9 +40,10 @@ import time
 import jax.numpy as jnp
 import numpy as np
 
-from dlnetbench_tpu.serving.decode import (STATE_LAST, STATE_LIMIT,
-                                           STATE_POS, STATE_REM,
-                                           STATE_ROWS)
+from dlnetbench_tpu.serving.decode import (STATE_GRAMMAR, STATE_LAST,
+                                           STATE_LIMIT, STATE_POS,
+                                           STATE_REM, STATE_ROWS,
+                                           STATE_UID)
 
 
 class SyncContractError(RuntimeError):
@@ -98,7 +101,8 @@ class DeviceDecodeState:
 
     def admit(self, slot: int, *, last_token: int, position: int,
               remaining: int, seq_limit: int, block_row,
-              ngram_row=None) -> None:
+              ngram_row=None, uid: int = 0,
+              grammar_state: int = 0) -> None:
         """A slot enters the decode phase (prefill just completed):
         seed its device-visible state.  ``remaining`` is the output
         budget still owed (``remaining > 0`` is the active bit);
@@ -111,12 +115,20 @@ class DeviceDecodeState:
         column host-side BEFORE this call — so the whole shared-page
         admission (aliased columns + the COW replacement) reaches the
         device in the ONE dirty-tracked block-table flush at the next
-        dispatch, never as an extra crossing."""
+        dispatch, never as an extra crossing.
+
+        ``uid`` (ISSUE 19) is the request id every sampled draw keys
+        by (warm requests ride negative rids — the int32 row holds
+        them; the sampler folds the two's-complement bits), and
+        ``grammar_state`` the slot's automaton state after its TTFT
+        token.  Both default to 0 — greedy engines never set them."""
         self._require_fresh("admit")
         self.state[STATE_LAST, slot] = last_token
         self.state[STATE_POS, slot] = position
         self.state[STATE_REM, slot] = remaining
         self.state[STATE_LIMIT, slot] = seq_limit
+        self.state[STATE_UID, slot] = uid
+        self.state[STATE_GRAMMAR, slot] = grammar_state
         self.block_tables[slot, :] = block_row
         self._dirty |= {"state", "block_tables"}
         if self.ngram_table is not None:
@@ -194,6 +206,8 @@ class DeviceDecodeState:
             "positions": self.state[STATE_POS].copy(),
             "remaining": self.state[STATE_REM].copy(),
             "seq_limits": self.state[STATE_LIMIT].copy(),
+            "uids": self.state[STATE_UID].copy(),
+            "grammar_states": self.state[STATE_GRAMMAR].copy(),
             "active": (self.state[STATE_REM] > 0).copy(),
             "block_tables": self.block_tables.copy(),
         }

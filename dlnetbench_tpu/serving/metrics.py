@@ -290,6 +290,22 @@ def serving_block(completed: list[Completed], plan: ArrivalPlan, *,
     return block
 
 
+def acceptance_by_temp(points: list[tuple[float, float]]) -> list[dict]:
+    """Shape measured (temperature, acceptance_rate) pairs into the
+    ``spec_acceptance_by_temp`` record global (ISSUE 19): sorted by
+    temperature, rates clamped to [0, 1] and rounded.  VOLATILE at
+    merge — acceptance is a measurement (it moves with params and
+    load), unlike the comparable ``sampling`` identity block.  The
+    study sweeps temperature and concatenates per-run points into the
+    acceptance-vs-temperature curve artifact."""
+    out = []
+    for temp, rate in sorted(points, key=lambda p: float(p[0])):
+        out.append({"temperature": round(float(temp), 4),
+                    "acceptance_rate": round(
+                        min(1.0, max(0.0, float(rate))), 4)})
+    return out
+
+
 def build_result(completed: list[Completed], plan: ArrivalPlan,
                  global_meta: dict, *, section: str = "serving"
                  ) -> ProxyResult:

@@ -1,0 +1,465 @@
+"""Seeded counter-based on-device sampling + grammar-constrained
+decode (ISSUE 19 tentpole).
+
+Everything decode-side was greedy argmax until this module: the fused
+N-step loop, speculative decode, the whole PR-11 dispatch-floor story
+were unreachable for the workloads production serving actually runs —
+temperature/top-k/top-p sampling and JSON-constrained outputs.  A host
+round-trip per sampled token would resurrect the measured ~566 µs
+dispatch floor, so sampling must live INSIDE the compiled programs.
+
+The key-derivation contract (the load-bearing design decision): every
+uniform draw is a stateless function of ``(sample_seed, slot_uid,
+counter, lane)`` — there is NO carried PRNG state.
+
+* ``slot_uid`` is the request id (``Request.rid``; warm requests ride
+  negative rids), NOT the slot index — so a crash-shrink re-queue that
+  lands the request in a different slot of a rebuilt engine replays the
+  SAME tokens for every position it decodes again.
+* ``counter`` is the cache position of the token being FED when the
+  draw happens — i.e. the token that lands at absolute stream position
+  ``P`` is drawn with ``counter = P - 1`` whatever program drew it
+  (prefill's TTFT token, the classic 1-step step, the fused N-step
+  loop, a speculative bonus draw).  N-step fused sampling is therefore
+  **bit-identical** to 1-step sampling by construction: the key IS the
+  position, and adaptive-N recompiles nothing because no PRNG state
+  crosses the carry.
+* ``lane`` separates the independent draws one position needs
+  (``LANE_TOKEN`` the emitted-token draw, ``LANE_ACCEPT`` the
+  speculative accept test, ``LANE_RESID`` the residual resample,
+  ``LANE_DRAFT`` the drafter's own draw).  A (lane, counter) pair is
+  consumed for an EMITTED token at most once across a request's whole
+  lifetime — re-draws of discarded speculative overshoot reuse keys
+  whose values never entered the output, which is exactly as good as
+  fresh randomness under the PRF reading of the derivation.
+
+The derivation itself is a murmur3-fmix32-style 32-bit finalizer chain
+(host twin in plain masked Python ints, device twin in ``jnp.uint32``
+— bit-equal, locked by a golden-value test like the splitmix64 streams
+in serving/arrivals.py).  uint64 is unavailable in-graph under the
+repo's default x64-disabled JAX, which is why the derivation is 32-bit
+end to end; 24 high bits make the uniform (exact in f32).
+
+The filtering pipeline (one definition for the direct sampler, the
+speculative target distribution AND the truncated drafter's
+distribution — sharing it is what makes the rejection-sampling
+equality a structural property): grammar mask -> temperature ->
+top-k (kth-value threshold; ties keep extra entries, deterministically)
+-> top-p (sorted exclusive-cumsum mask; the top-1 token always
+survives) -> softmax.  ``temperature == 0`` is defined as the ONE-HOT
+distribution on the (masked) argmax, so the speculative accept rule
+``u·q(t) < p(t)`` degenerates to exact-match greedy acceptance and the
+whole stack has a single acceptance story.
+
+Grammar-constrained decode compiles a JSON-mode grammar to a dense
+``[states, vocab]`` token mask + transition table over the synthetic
+vocab (token class = ``token % 4``: ``[`` / ``]`` / scalar / comma —
+a depth-bounded balanced-bracket automaton whose every state admits at
+least one class, so a constrained slot can never strand maskless).
+The per-slot automaton state rides the packed device-state carry
+(decode.STATE_GRAMMAR); constrained + speculative composes because
+out-of-grammar drafts have zero target probability and auto-reject.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_F32 = jnp.float32
+_M32 = 0xFFFFFFFF
+_GOLDEN = 0x9E3779B9          # the same golden ratio the prompt
+#                               streams key by (serving/decode.py)
+_FMIX_C1 = 0x85EBCA6B         # murmur3 fmix32 constants
+_FMIX_C2 = 0xC2B2AE35
+_NEG = jnp.float32(-1e30)     # masked-logit sentinel (kv_cache's
+#                               MASK_VALUE discipline)
+
+# draw lanes: the independent uniforms one stream position can consume
+LANE_TOKEN = 0    # the emitted-token draw (and the spec bonus draw)
+LANE_ACCEPT = 1   # speculative accept test at this position
+LANE_RESID = 2    # residual-distribution resample at this position
+LANE_DRAFT = 3    # the drafter's own draw at this position
+
+
+# ---------------------------------------------------------------------
+# the keyed derivation: host twin (python ints) + device twin (uint32)
+
+def _fmix32_host(x: int) -> int:
+    x &= _M32
+    x ^= x >> 16
+    x = (x * _FMIX_C1) & _M32
+    x ^= x >> 13
+    x = (x * _FMIX_C2) & _M32
+    x ^= x >> 16
+    return x
+
+
+def key_bits(seed: int, uid: int, counter: int, lane: int) -> int:
+    """The 32-bit draw key for ``(seed, uid, counter, lane)`` — host
+    reference the device twin is golden-locked against.  Negative
+    uids (warm requests) fold as their two's-complement uint32, the
+    same value an in-graph int32->uint32 cast produces."""
+    h = _fmix32_host((seed & _M32) ^ _GOLDEN)
+    for v in (uid, counter, lane):
+        h = _fmix32_host(h ^ (v & _M32))
+    return h
+
+
+def key_u01(seed: int, uid: int, counter: int, lane: int) -> float:
+    """The uniform in [0, 1) the device draws for this key: the top
+    24 bits of ``key_bits`` (exact in f32)."""
+    return (key_bits(seed, uid, counter, lane) >> 8) / float(1 << 24)
+
+
+def _fmix32_dev(x):
+    x = x ^ (x >> 16)
+    x = x * np.uint32(_FMIX_C1)
+    x = x ^ (x >> 13)
+    x = x * np.uint32(_FMIX_C2)
+    x = x ^ (x >> 16)
+    return x
+
+
+# ---------------------------------------------------------------------
+# grammar: JSON-mode token-mask automaton over the synthetic vocab
+
+GRAMMARS = ("json",)
+N_TOKEN_CLASSES = 4
+CLASS_OPEN, CLASS_CLOSE, CLASS_SCALAR, CLASS_COMMA = 0, 1, 2, 3
+JSON_MAX_DEPTH = 3
+
+
+@dataclasses.dataclass
+class Grammar:
+    """A compiled token-mask automaton: ``mask[s, t]`` says token ``t``
+    is legal in state ``s``; ``trans[s, t]`` the state after emitting
+    it (meaningful only where ``mask`` holds — masked entries carry 0
+    and are unreachable by construction)."""
+    name: str
+    mask: np.ndarray    # [states, vocab] bool
+    trans: np.ndarray   # [states, vocab] int32
+    start: int
+
+    @property
+    def num_states(self) -> int:
+        return self.mask.shape[0]
+
+
+def _json_automaton(depth: int):
+    """The depth-bounded balanced-bracket JSON-mode automaton over the
+    four token classes.  States: ``S0`` (top level, expects a value —
+    a stream of scalars and balanced arrays), ``A_d`` (just opened
+    depth ``d``, expects a value or an immediate close), ``B_d``
+    (inside depth ``d`` after a value, expects comma or close),
+    ``V_d`` (after a comma at depth ``d``, strictly expects a value).
+    Every state admits at least one class — the automaton is total, so
+    a constrained slot always has a nonempty mask (locked by test)."""
+    s0 = 0
+
+    def a(d):
+        return d                       # 1..depth
+
+    def b(d):
+        return depth + d               # depth+1..2*depth
+
+    def v(d):
+        return 2 * depth + d           # 2*depth+1..3*depth
+
+    n = 3 * depth + 1
+    allowed = np.zeros((n, N_TOKEN_CLASSES), bool)
+    nxt = np.zeros((n, N_TOKEN_CLASSES), np.int32)
+
+    def arc(s, c, t):
+        allowed[s, c] = True
+        nxt[s, c] = t
+
+    arc(s0, CLASS_SCALAR, s0)
+    arc(s0, CLASS_OPEN, a(1))
+    for d in range(1, depth + 1):
+        arc(a(d), CLASS_SCALAR, b(d))
+        arc(a(d), CLASS_CLOSE, s0 if d == 1 else b(d - 1))
+        arc(b(d), CLASS_COMMA, v(d))
+        arc(b(d), CLASS_CLOSE, s0 if d == 1 else b(d - 1))
+        arc(v(d), CLASS_SCALAR, b(d))
+        if d < depth:
+            arc(a(d), CLASS_OPEN, a(d + 1))
+            arc(v(d), CLASS_OPEN, a(d + 1))
+    return allowed, nxt, s0
+
+
+def compile_grammar(name: str, vocab: int) -> Grammar:
+    """Grammar name -> dense ``[states, vocab]`` tables.  The synthetic
+    vocab maps token -> class as ``token % 4`` (the serving analogue of
+    the seeded synthetic prompts: replayable structure with no
+    tokenizer dependency)."""
+    if name not in GRAMMARS:
+        raise ValueError(f"sampling: unknown grammar {name!r} "
+                         f"(one of {GRAMMARS})")
+    if vocab < N_TOKEN_CLASSES:
+        raise ValueError(
+            f"sampling: grammar {name!r} needs vocab >= "
+            f"{N_TOKEN_CLASSES} (token class = token % "
+            f"{N_TOKEN_CLASSES}), got {vocab}")
+    allowed, nxt, start = _json_automaton(JSON_MAX_DEPTH)
+    cls = np.arange(vocab) % N_TOKEN_CLASSES
+    return Grammar(name=name, mask=allowed[:, cls],
+                   trans=nxt[:, cls].astype(np.int32), start=start)
+
+
+def validate_stream(grammar: Grammar, tokens, state: int | None = None
+                    ) -> bool:
+    """Host replay of the mask/transition tables over an emitted token
+    stream — the study's per-grid-point validity check (and the
+    table's own correctness oracle in tests)."""
+    s = grammar.start if state is None else state
+    for t in tokens:
+        if not grammar.mask[s, int(t)]:
+            return False
+        s = int(grammar.trans[s, int(t)])
+    return True
+
+
+# ---------------------------------------------------------------------
+# config + the consolidated validator (engine build AND arg-parse time)
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """The sampling knobs in one frozen record.  ``temperature == 0``
+    IS greedy (the one-hot distribution); ``grammar`` alone turns the
+    sampler on in masked-greedy mode."""
+    temperature: float = 0.0
+    top_k: int = 0          # 0 = off; >= 1 keeps the k highest logits
+    top_p: float = 1.0      # 1.0 = off; (0, 1) keeps the nucleus
+    sample_seed: int = 0
+    grammar: str = ""       # "" = unconstrained; else one of GRAMMARS
+
+    @property
+    def enabled(self) -> bool:
+        return self.temperature > 0.0 or bool(self.grammar)
+
+
+def check_sampling_config(*, temperature: float, top_k: int,
+                          top_p: float, sample_seed: int, grammar: str,
+                          speculative: bool = False,
+                          drafter: str = "ngram") -> SamplingConfig:
+    """The ONE sampling validator (the PR-11 ``check_spec_config``
+    pattern): ``ServingConfig.validate`` runs it at engine build and
+    ``cli serve`` runs it at arg-parse time, so every invalid combo is
+    a tidy usage error in both places, never an engine traceback.
+
+    The old blanket "speculative requires greedy" refusal is dead —
+    speculation is lossless under sampling via rejection-sampling
+    acceptance.  What speculation DOES require is a drafter with a
+    distribution: the ngram drafter proposes tokens but carries no
+    probabilities, and the accept rule ``u·q(t) < p(t)`` needs ``q``.
+    Greedy speculation keeps both drafters."""
+    if temperature < 0.0:
+        raise ValueError(f"sampling: temperature must be >= 0 "
+                         f"(0 = greedy), got {temperature}")
+    if top_k < 0:
+        raise ValueError(f"sampling: top_k must be >= 1 when set "
+                         f"(0 = off), got {top_k}")
+    if not (0.0 < top_p <= 1.0):
+        raise ValueError(f"sampling: top_p must be in (0, 1], "
+                         f"got {top_p}")
+    if grammar and grammar not in GRAMMARS:
+        raise ValueError(f"sampling: unknown grammar {grammar!r} "
+                         f"(one of {GRAMMARS})")
+    if temperature == 0.0 and top_k > 0:
+        raise ValueError(
+            f"sampling: top_k={top_k} without temperature > 0 — "
+            f"greedy ignores the cutoff; set --temperature or drop "
+            f"top_k")
+    if temperature == 0.0 and top_p < 1.0:
+        raise ValueError(
+            f"sampling: top_p={top_p} without temperature > 0 — "
+            f"greedy ignores the nucleus; set --temperature or drop "
+            f"top_p")
+    cfg = SamplingConfig(temperature=temperature, top_k=top_k,
+                         top_p=top_p, sample_seed=sample_seed,
+                         grammar=grammar)
+    if cfg.enabled and speculative and drafter != "truncated":
+        raise ValueError(
+            f"sampling: speculative sampling requires drafter probs — "
+            f"the {drafter!r} drafter proposes tokens without a "
+            f"distribution, and the rejection-sampling accept rule "
+            f"needs q(draft); use drafter='truncated' (greedy "
+            f"speculation keeps both drafters)")
+    return cfg
+
+
+# ---------------------------------------------------------------------
+# the device sampler
+
+class DeviceSampler:
+    """The in-graph half of the sampling stack, built once per engine:
+    closes over the knobs and the compiled grammar tables, and exposes
+    the keyed-uniform, filtered-distribution and inverse-CDF-draw
+    primitives every decode program shares (decode._step_tokens, the
+    fused loop, the speculative draft/verify bodies, the prefill TTFT
+    draw).  Also carries the HOST twins the classic 1-step engine's
+    bookkeeping uses (grammar transitions between fenced steps)."""
+
+    def __init__(self, cfg: SamplingConfig, vocab: int):
+        self.cfg = cfg
+        self.vocab = vocab
+        # fold the seed host-side once: the device chain starts at the
+        # already-mixed seed word (one fewer in-graph round per draw)
+        self._seed_h = _fmix32_host((cfg.sample_seed & _M32) ^ _GOLDEN)
+        self.grammar = (compile_grammar(cfg.grammar, vocab)
+                        if cfg.grammar else None)
+        self.mask_dev = (jnp.asarray(self.grammar.mask)
+                         if self.grammar else None)
+        self.trans_dev = (jnp.asarray(self.grammar.trans)
+                          if self.grammar else None)
+        self.start_state = self.grammar.start if self.grammar else 0
+
+    # ---- keyed uniforms ---------------------------------------------
+    def u01(self, uids, counters, lane: int):
+        """[B] uniforms in [0, 1) for ``(seed, uid, counter, lane)`` —
+        the device twin of ``key_u01`` (bit-equal, golden-locked)."""
+        h = jnp.uint32(self._seed_h)
+        h = _fmix32_dev(h ^ uids.astype(jnp.uint32))
+        h = _fmix32_dev(h ^ counters.astype(jnp.uint32))
+        h = _fmix32_dev(h ^ jnp.uint32(lane & _M32))
+        return (h >> 8).astype(_F32) * _F32(1.0 / (1 << 24))
+
+    # ---- the filtering pipeline -------------------------------------
+    def gmask(self, gstate):
+        """Per-slot legal-token mask [B, vocab] from the automaton
+        states, or None when unconstrained."""
+        if self.mask_dev is None:
+            return None
+        return self.mask_dev[gstate]
+
+    def _filter(self, logits):
+        """temperature -> top-k -> top-p on [B, vocab] logits (grammar
+        already masked by the caller); returns filtered logits ready
+        for the final softmax."""
+        x = logits / _F32(self.cfg.temperature)
+        k = self.cfg.top_k
+        if k and k < self.vocab:
+            kth = jnp.sort(x, axis=-1)[..., self.vocab - k]
+            x = jnp.where(x >= kth[..., None], x, _NEG)
+        p = self.cfg.top_p
+        if p < 1.0:
+            order = jnp.argsort(-x, axis=-1)
+            xs = jnp.take_along_axis(x, order, axis=-1)
+            ps = jax.nn.softmax(xs, axis=-1)
+            cum = jnp.cumsum(ps, axis=-1) - ps     # exclusive
+            keep_s = cum < _F32(p)                 # top-1 always kept
+            rows = jnp.arange(x.shape[0])[:, None]
+            keep = jnp.zeros(x.shape, bool).at[rows, order].set(keep_s)
+            x = jnp.where(keep, x, _NEG)
+        return x
+
+    def probs(self, logits, gstate=None):
+        """The filtered target distribution [B, vocab] — the ONE
+        definition the direct draw, the speculative accept/residual
+        math and the drafter distribution all share.  ``temperature ==
+        0`` returns the one-hot on the masked argmax (the greedy
+        distribution — the accept rule then IS exact-match greedy)."""
+        x = logits.astype(_F32)
+        m = self.gmask(gstate) if gstate is not None else None
+        if m is not None:
+            x = jnp.where(m, x, _NEG)
+        if self.cfg.temperature <= 0.0:
+            hot = jnp.argmax(x, axis=-1)
+            return jax.nn.one_hot(hot, self.vocab, dtype=_F32)
+        return jax.nn.softmax(self._filter(x), axis=-1)
+
+    # ---- draws ------------------------------------------------------
+    def draw_from_probs(self, p, u):
+        """Inverse-CDF categorical draw: one uniform per token.  The
+        ``u * cdf_total`` rescale + the ``p > 0`` guard make the edge
+        cases exact: a zero-probability (grammar-masked, filtered)
+        token is unreachable even at ``u == 0`` or at float-rounding
+        boundaries of the cumsum."""
+        cdf = jnp.cumsum(p, axis=-1)
+        lim = u * cdf[..., -1]
+        hit = (cdf >= lim[..., None]) & (p > 0)
+        return jnp.argmax(hit, axis=-1).astype(jnp.int32)
+
+    def draw_tokens(self, logits, uids, counters, gstate=None):
+        """The emitted-token draw (``LANE_TOKEN``) for one batched
+        step: ``counters`` is the fed position per slot (the key IS
+        the position — the whole bit-identity contract)."""
+        if self.cfg.temperature <= 0.0:
+            x = logits.astype(_F32)
+            m = self.gmask(gstate) if gstate is not None else None
+            if m is not None:
+                x = jnp.where(m, x, _NEG)
+            return jnp.argmax(x, axis=-1).astype(jnp.int32)
+        u = self.u01(uids, counters, LANE_TOKEN)
+        return self.draw_from_probs(self.probs(logits, gstate), u)
+
+    # ---- grammar state ----------------------------------------------
+    def advance(self, gstate, tokens):
+        """Automaton step [B] — identity when unconstrained (the
+        grammar row then just carries zeros)."""
+        if self.trans_dev is None:
+            return gstate
+        return self.trans_dev[gstate, tokens]
+
+    def host_advance(self, gstate: int, token: int) -> int:
+        """The classic 1-step engine's host-side twin of ``advance``
+        (it fences every token anyway, so the transition costs one
+        numpy lookup between steps, not a program operand)."""
+        if self.grammar is None:
+            return gstate
+        return int(self.grammar.trans[gstate, token])
+
+
+# ---------------------------------------------------------------------
+# distribution-equality machinery (the speculative parity lock)
+
+def chi_square(counts, probs, min_expected: float = 5.0
+               ) -> tuple[float, int]:
+    """Pearson chi-square of observed ``counts`` against the exact
+    distribution ``probs``, with small-expected bins pooled (the
+    textbook validity rule) — ``(statistic, degrees_of_freedom)``.
+    Plain numpy, no scipy: the container doesn't ship it and the test
+    must not gate on an optional dependency."""
+    counts = np.asarray(counts, float)
+    probs = np.asarray(probs, float)
+    n = counts.sum()
+    if n <= 0:
+        raise ValueError("chi_square: no samples")
+    exp = probs / probs.sum() * n
+    order = np.argsort(exp)
+    c_bins: list[float] = []
+    e_bins: list[float] = []
+    c_acc = e_acc = 0.0
+    for i in order:
+        c_acc += counts[i]
+        e_acc += exp[i]
+        if e_acc >= min_expected:
+            c_bins.append(c_acc)
+            e_bins.append(e_acc)
+            c_acc = e_acc = 0.0
+    if e_acc > 0:
+        if e_bins:
+            c_bins[-1] += c_acc
+            e_bins[-1] += e_acc
+        else:
+            c_bins.append(c_acc)
+            e_bins.append(e_acc)
+    stat = float(sum((c - e) ** 2 / e
+                     for c, e in zip(c_bins, e_bins) if e > 0))
+    return stat, max(len(e_bins) - 1, 1)
+
+
+def chi_square_critical(df: int, z: float = 3.090) -> float:
+    """Upper critical value via the Wilson–Hilferty cube approximation
+    (``z = 3.090`` is the normal quantile for p ~= 0.001).  Within a
+    fraction of a percent of the exact table for df >= 3 — plenty for
+    a pass/fail bar with seeded, deterministic statistics."""
+    if df < 1:
+        raise ValueError(f"chi_square_critical: df must be >= 1, "
+                         f"got {df}")
+    t = 1.0 - 2.0 / (9.0 * df) + z * math.sqrt(2.0 / (9.0 * df))
+    return df * t ** 3

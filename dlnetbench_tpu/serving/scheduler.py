@@ -101,9 +101,28 @@ class ServingConfig:
                                 # layers of the target + shared head)
     drafter_layers: int = 1     # truncated drafter depth (must be
                                 # < num_layers; checked at build)
-    sampling: str = "greedy"    # greedy only today; speculative +
-                                # non-greedy is refused LOUDLY until
-                                # sampling-aware acceptance lands
+    temperature: float = 0.0    # ISSUE 19: softmax temperature for
+                                # on-device seeded sampling.  0.0 =
+                                # greedy argmax (the sampler is not
+                                # even built — bit-identical engine);
+                                # > 0 samples every generated token
+                                # in-graph, keyed by (sample_seed,
+                                # request rid, position) — stateless,
+                                # so N-step == 1-step bit-identically
+                                # and crash re-queues replay tokens
+    top_k: int = 0              # keep the k highest logits before the
+                                # draw (0 = off; needs temperature>0)
+    top_p: float = 1.0          # nucleus cutoff in (0, 1]; 1.0 = off
+                                # (needs temperature > 0)
+    sample_seed: int = 0        # the sampling stream seed (run
+                                # identity — COMPARABLE at merge)
+    grammar: str = ""           # "" = unconstrained; "json" masks
+                                # every generated token through the
+                                # JSON-mode automaton
+                                # (serving/sampling.compile_grammar);
+                                # composes with speculative (out-of-
+                                # grammar drafts auto-reject) and
+                                # with prefix_sharing
     cache_dtype: str = "bf16"   # paged-KV pool storage (ISSUE 12):
                                 # "bf16" = unquantized (pools in the
                                 # model dtype — the quant path is not
@@ -199,15 +218,17 @@ class ServingConfig:
                 f"only — cache_dtype={self.cache_dtype!r} re-quantizes "
                 f"pages on every draft/verify overwrite and has no "
                 f"stated parity bar (docs/SERVING.md 'Cache density')")
-        if self.sampling != "greedy":
-            if self.speculative:
-                raise ValueError(
-                    f"serving: speculative decode is lossless under "
-                    f"GREEDY acceptance only — speculative + "
-                    f"sampling={self.sampling!r} is refused until "
-                    f"sampling-aware acceptance lands")
-            raise ValueError(f"serving: unknown sampling "
-                             f"{self.sampling!r} (greedy only)")
+        # ISSUE 19: the ONE sampling validator (check_spec_config
+        # pattern) — the same call cli.py runs at arg-parse time, so
+        # invalid combos fail identically in both places.  Speculative
+        # sampling is LOSSLESS now (rejection-sampling acceptance);
+        # what it needs is a drafter with a distribution.
+        from dlnetbench_tpu.serving.sampling import check_sampling_config
+        check_sampling_config(
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p, sample_seed=self.sample_seed,
+            grammar=self.grammar, speculative=self.speculative,
+            drafter=self.drafter)
         if self.moe_skew < 0:
             raise ValueError(f"serving: moe_skew must be >= 0, got "
                              f"{self.moe_skew}")
@@ -278,6 +299,9 @@ class _SlotState:
         self.generated = 0
         self.last_token = 0
         self.first_token_s: float | None = None
+        self.gstate = 0             # grammar-automaton state after the
+        #                             last generated token (ISSUE 19;
+        #                             stays 0 when unconstrained)
 
 
 class Engine:
@@ -367,6 +391,19 @@ class Engine:
             from dlnetbench_tpu.serving.moe_decode import skew_bias
             self._moe_bias = skew_bias(model_cfg.num_experts,
                                        cfg.moe_skew, cfg.moe_skew_seed)
+        # ISSUE 19: the device sampler is an engine-build constant —
+        # knobs + compiled grammar tables closed over every decode
+        # program.  None when greedy/unconstrained: the sampler-less
+        # programs are byte-identical to pre-ISSUE-19 builds.
+        from dlnetbench_tpu.serving import sampling as SMP
+        scfg = SMP.check_sampling_config(
+            temperature=cfg.temperature, top_k=cfg.top_k,
+            top_p=cfg.top_p, sample_seed=cfg.sample_seed,
+            grammar=cfg.grammar, speculative=cfg.speculative,
+            drafter=cfg.drafter)
+        self._sampler = (SMP.DeviceSampler(scfg,
+                                           model_cfg.vocab_size)
+                         if scfg.enabled else None)
         with spans.span("build", what="serving engine"):
             if self._loop_mode:
                 if cfg.speculative:
@@ -379,14 +416,16 @@ class Engine:
                         model_cfg, self.cache_cfg, cfg.multi_step_n,
                         spec_k=cfg.spec_k, drafter=cfg.drafter,
                         drafter_layers=cfg.drafter_layers,
-                        attn_impl=cfg.attn_impl, mesh=mesh)
+                        attn_impl=cfg.attn_impl, mesh=mesh,
+                        sampler=self._sampler)
                     carries = (1, 2, 3, 4)  # pools + packed state +
                     #                          ngram table
                 else:
                     loop_fn = D.make_multi_step_decode(
                         model_cfg, self.cache_cfg, cfg.multi_step_n,
                         attn_impl=cfg.attn_impl, mesh=mesh,
-                        moe_bias=self._moe_bias)
+                        moe_bias=self._moe_bias,
+                        sampler=self._sampler)
                     # pools (+ scale arrays on a quantized cache) +
                     # packed state — all loop carries
                     carries = (tuple(range(1, 6)) if self._quant
@@ -399,13 +438,15 @@ class Engine:
                     D.make_decode_step(model_cfg, self.cache_cfg,
                                        attn_impl=cfg.attn_impl,
                                        mesh=mesh,
-                                       moe_bias=self._moe_bias),
+                                       moe_bias=self._moe_bias,
+                                       sampler=self._sampler),
                     self._decode_example_args(),
                     donate_argnums=self._pool_argnums)
             self._prefill = executor.CompiledStep(
                 D.make_prefill_chunk(model_cfg, self.cache_cfg,
                                      cfg.prefill_chunk,
-                                     moe_bias=self._moe_bias),
+                                     moe_bias=self._moe_bias,
+                                     sampler=self._sampler),
                 self._prefill_example_args(),
                 donate_argnums=self._pool_argnums)
         decode_prog = self._loop if self._loop_mode else self._decode
@@ -504,17 +545,25 @@ class Engine:
     def _decode_example_args(self):
         cc = self.cache_cfg
         b = cc.max_seqs
-        return (self.params, *self._pool_avals(),
+        args = (self.params, *self._pool_avals(),
                 jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
                 jnp.zeros((b, cc.max_pages_per_seq), jnp.int32),
                 jnp.zeros((b,), bool))
+        if self._sampler is not None:
+            # ISSUE 19: per-slot request uids + grammar states
+            args += (jnp.zeros((b,), jnp.int32),
+                     jnp.zeros((b,), jnp.int32))
+        return args
 
     def _prefill_example_args(self):
         cc = self.cache_cfg
-        return (self.params, *self._pool_avals(),
+        args = (self.params, *self._pool_avals(),
                 jnp.zeros((self.cfg.prefill_chunk,), jnp.int32),
                 jnp.int32(0), jnp.int32(0),
                 jnp.zeros((cc.max_pages_per_seq,), jnp.int32))
+        if self._sampler is not None:
+            args += (jnp.int32(0),)   # ISSUE 19: the request uid
+        return args
 
     def _loop_example_args(self):
         """Abstract args for the fused decode-loop program (the
@@ -782,9 +831,11 @@ class Engine:
         chunk = jnp.asarray(chunk_np)
         row = jnp.asarray(self.cache.block_tables[slot])
         t0 = time.perf_counter()
+        extra = (() if self._sampler is None
+                 else (jnp.int32(st.req.rid),))
         outs = self._prefill(
             self.params, *self._pool_args(), chunk,
-            jnp.int32(start), jnp.int32(n), row)
+            jnp.int32(start), jnp.int32(n), row, *extra)
         if self._moe:
             # stash the DEVICE arrays — no np.asarray here, an
             # intermediate chunk must not fence (the contract above);
@@ -804,6 +855,12 @@ class Engine:
             self._fold_moe_pending()
             dev_s = time.perf_counter() - t0
             st.generated = 1
+            if (self._sampler is not None
+                    and self._sampler.grammar is not None):
+                # grammar state AFTER the TTFT token (the device-side
+                # loop picks up from here)
+                st.gstate = self._sampler.host_advance(
+                    self._sampler.start_state, st.last_token)
             st.first_token_s = self._now()
             self.token_streams.setdefault(st.req.rid, []).append(
                 st.last_token)
@@ -839,7 +896,8 @@ class Engine:
                  remaining=st.req.output_len - st.generated,
                  seq_limit=st.req.prompt_len + st.req.output_len,
                  block_row=self.cache.block_tables[slot],
-                 ngram_row=ngram_row)
+                 ngram_row=ngram_row,
+                 uid=st.req.rid, grammar_state=st.gstate)
 
     def admit_prefilled(self, req: Request, *, last_token: int,
                         admitted_s: float, first_token_s: float,
@@ -881,6 +939,12 @@ class Engine:
         st.generated = generated
         st.last_token = last_token
         st.first_token_s = first_token_s
+        if (self._sampler is not None
+                and self._sampler.grammar is not None):
+            # migration happens at the TTFT boundary (generated == 1):
+            # the automaton has consumed exactly the first token
+            st.gstate = self._sampler.host_advance(
+                self._sampler.start_state, st.last_token)
         self.slots[slot] = st
         self.concurrent_peak = max(
             self.concurrent_peak,
@@ -1041,11 +1105,20 @@ class Engine:
             tokens[i] = st.last_token
             positions[i] = int(self.cache.lengths[i])
             active[i] = True
+        extra = ()
+        if self._sampler is not None:
+            uids = np.zeros((b,), np.int32)
+            gst = np.zeros((b,), np.int32)
+            for i in decode_ix:
+                uids[i] = self.slots[i].req.rid
+                gst[i] = self.slots[i].gstate
+            extra = (jnp.asarray(uids), jnp.asarray(gst))
         t0 = time.perf_counter()
         outs = self._decode(
             self.params, *self._pool_args(),
             jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(self.cache.block_tables), jnp.asarray(active))
+            jnp.asarray(self.cache.block_tables), jnp.asarray(active),
+            *extra)
         rest = self._adopt_pools(outs)
         return {"fused": False, "t_step": t_step, "t0": t0,
                 "dev_s": dev_s, "decode_ix": decode_ix, "rest": rest}
@@ -1069,6 +1142,12 @@ class Engine:
             st = self.slots[i]
             self.cache.append(i)          # the fed token is now cached
             st.last_token = int(nxt[i])
+            if (self._sampler is not None
+                    and self._sampler.grammar is not None):
+                # the per-token fence IS the grammar transition point
+                # in classic mode — host-side, same automaton table
+                st.gstate = self._sampler.host_advance(
+                    st.gstate, st.last_token)
             st.generated += 1
             self._tokens_emitted += 1
             self.token_streams.setdefault(st.req.rid, []).append(
@@ -1351,6 +1430,18 @@ class Engine:
             # quantized caches must never merge — metrics/merge refuses
             # a mismatch exactly like a mismatched fault plan
             "kv_cache_dtype": cfg.cache_dtype,
+            # comparable global (ISSUE 19): sampled runs carry their
+            # full draw identity — records with different temperature/
+            # top_k/top_p/seed/grammar must never merge (draws are
+            # keyed by (seed, uid, position); mixing seeds would
+            # average incomparable token streams).  Absent on greedy
+            # runs so pre-sampling records stay byte-identical.
+            **({"sampling": {"temperature": cfg.temperature,
+                             "top_k": cfg.top_k,
+                             "top_p": cfg.top_p,
+                             "sample_seed": cfg.sample_seed,
+                             "grammar": cfg.grammar}}
+               if self._sampler is not None else {}),
             "serving_config": {
                 "slots": cfg.slots, "page_size": cfg.page_size,
                 "num_pages": cfg.num_pages,
@@ -1494,6 +1585,15 @@ def run_serving(model_cfg: TransformerConfig, cfg: ServingConfig,
         pstats = final.cache.stats().get("prefix", {})
         meta["prefix_hit_rate"] = pstats.get("hit_rate", 0.0)
         meta["prefix_bytes_saved"] = pstats.get("bytes_saved", 0)
+    if cfg.speculative and final._sampler is not None:
+        # VOLATILE at merge (metrics/merge.py): the measured
+        # acceptance-vs-temperature point for THIS run — acceptance is
+        # a measurement (it varies with params/load), unlike the
+        # `sampling` identity block above
+        meta["spec_acceptance_by_temp"] = M.acceptance_by_temp([
+            (cfg.temperature,
+             (final._accepted / final._drafted
+              if final._drafted else 0.0))])
     if fault_plan is not None:
         meta["fault_plan"] = fault_plan.to_dict()
         meta["fault_policy"] = fault_plan.policy

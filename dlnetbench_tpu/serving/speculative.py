@@ -11,9 +11,14 @@ for roughly the device cost of one wide step.
 Under greedy acceptance this is LOSSLESS: the verify pass computes the
 target model's own greedy continuation at every drafted position, and
 only drafts that MATCH it are kept — the emitted stream is exactly the
-1-step greedy stream whatever the drafter proposes (locked by test;
-``ServingConfig`` refuses speculative + non-greedy until sampling
-lands).  One basis caveat: the verify pass runs the dense-gather
+1-step greedy stream whatever the drafter proposes (locked by test).
+Under SAMPLING (ISSUE 19) it is lossless too, via rejection-sampling
+acceptance against the filtered target distribution (accept draft t
+with probability min(1, p(t)/q(t)), residual resample at the first
+reject, bonus draw on full acceptance — see make_spec_decode_loop;
+the chi-square distribution-equality test in tests/test_sampling.py
+is the parity lock).  One basis caveat: the verify pass runs the
+dense-gather
 attention math (the Pallas ``paged_attention`` kernel is single-query
 and cannot serve K1 positions), so the parity lock is EXACT where the
 1-step engine shares that math — the CPU mesh, or ``attn_impl=
@@ -84,7 +89,8 @@ def check_spec_config(cfg: TransformerConfig, *, spec_k: int,
 
 def _verify_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig,
                    params, k_pages, v_pages, tokens, positions,
-                   write_ok, block_tables):
+                   write_ok, block_tables, *,
+                   return_logits: bool = False):
     """The batched multi-token TARGET pass: feed ``tokens`` [B, K1]
     starting at cache index ``positions`` [B] per slot, write their k/v
     (where ``write_ok`` [B, K1] allows), attend causally over
@@ -97,7 +103,12 @@ def _verify_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig,
     the dense gather form (length-masked fp32 softmax over the slot's
     gathered pages — kv_cache._gather_attention's math extended to K1
     queries); the Pallas decode kernel is single-query and does not
-    apply."""
+    apply.
+
+    ``return_logits`` (ISSUE 19) appends the raw ``[B, K1, vocab]``
+    logits to the return — the rejection-sampling accept pass needs
+    the full target distribution at every drafted position, not just
+    its argmax."""
     b, k1 = tokens.shape
     page_size = cache_cfg.page_size
     num_pages = cache_cfg.num_pages
@@ -150,6 +161,8 @@ def _verify_tokens(cfg: TransformerConfig, cache_cfg: CacheConfig,
     head = params["embed"].T if cfg.tied_embeddings else params["head"]
     logits = jnp.dot(x, head, preferred_element_type=_F32)
     out = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, K1]
+    if return_logits:
+        return k_pages, v_pages, out, logits
     return k_pages, v_pages, out
 
 
@@ -169,14 +182,15 @@ def make_spec_decode_loop(cfg: TransformerConfig,
                           cache_cfg: CacheConfig, n_max: int, *,
                           spec_k: int, drafter: str,
                           drafter_layers: int = 1,
-                          attn_impl: str = "auto", mesh=None):
+                          attn_impl: str = "auto", mesh=None,
+                          sampler=None):
     """The fused draft/verify/accept loop (ISSUE 11 tentpole, spec
     flavor).
 
     ``spec_loop(params, k_pages, v_pages, state, ngram_table,
     block_tables, n_rounds) -> (k_pages, v_pages, state, ngram_table,
     tokens_out, counts, rounds_run, drafted, accepted)`` — ``state``
-    is the packed ``[4, slots]`` int32 carry (decode.STATE_* rows;
+    is the packed ``[6, slots]`` int32 carry (decode.STATE_* rows;
     ``remaining > 0`` is the active bit, ``STATE_LIMIT`` the per-slot
     reservation cap the write guard enforces).
 
@@ -190,10 +204,48 @@ def make_spec_decode_loop(cfg: TransformerConfig,
     worst-case all-accepted capacity; ``counts`` says how much is
     real.  ``drafted``/``accepted`` accumulate the RAW acceptance
     stats (pre-clamp — the drafter's quality, not the budget's), which
-    ride the record as the acceptance-rate metric."""
+    ride the record as the acceptance-rate metric.
+
+    With a ``sampler`` (ISSUE 19) the loop runs LOSSLESS speculative
+    SAMPLING — standard rejection-sampling acceptance against the
+    target distribution instead of greedy exact-match:
+
+    * drafts are SAMPLED from the truncated drafter's own filtered
+      distribution ``q_j`` (``LANE_DRAFT`` keyed uniforms; the ngram
+      drafter is refused here — it proposes tokens with no
+      distribution, and the accept rule needs ``q``);
+    * draft ``j`` is accepted iff ``u_j · q_j(d_j) < p_j(d_j)``
+      (``LANE_ACCEPT``) where ``p_j`` is the FILTERED target
+      distribution at that position — exactly the min(1, p/q) accept
+      probability, strict so a zero-target-probability draft (e.g.
+      out-of-grammar) can NEVER be accepted;
+    * the first rejected position resamples from the normalized
+      residual ``max(p - q, 0)`` (``LANE_RESID``; falls back to ``p``
+      itself when the residual is empty, which happens exactly when
+      ``q`` dominates ``p`` nowhere — e.g. identical one-hots at
+      temperature 0);
+    * full acceptance draws the bonus token from ``p_k``
+      (``LANE_TOKEN`` at the bonus position — the same key the
+      non-spec sampler would use there).
+
+    The emitted-stream distribution provably equals the unfused
+    single-step sampler's (the chi-square parity lock in
+    tests/test_sampling.py); ``temperature == 0`` distributions are
+    one-hots, so the rule degenerates to exact-match greedy and the
+    greedy parity lock still holds.  Grammar states ride
+    ``STATE_GRAMMAR`` and advance along the EMITTED tokens; target
+    probs are masked per-position through the draft chain's automaton
+    states, so constrained + speculative composes for free."""
     check_config(cfg, decode=True)
     check_spec_config(cfg, spec_k=spec_k, drafter=drafter,
                       drafter_layers=drafter_layers)
+    if sampler is not None and drafter != "truncated":
+        # mirrored at sampling.check_sampling_config — rejection
+        # sampling needs q(draft), which only the truncated drafter has
+        raise ValueError(
+            "spec_decode_loop: speculative sampling requires drafter "
+            f"probs — drafter {drafter!r} has no distribution; use "
+            "drafter='truncated'")
     if cache_cfg.quantized:
         # the ServingConfig-level refusal, mirrored at the builder:
         # the verify pass overwrites drafter rows and every overwrite
@@ -212,8 +264,14 @@ def make_spec_decode_loop(cfg: TransformerConfig,
     k1 = spec_k + 1
     cap = n_max * k1
 
-    from dlnetbench_tpu.serving.decode import (STATE_LAST, STATE_LIMIT,
-                                               STATE_POS, STATE_REM)
+    from dlnetbench_tpu.serving.decode import (STATE_GRAMMAR,
+                                               STATE_LAST, STATE_LIMIT,
+                                               STATE_POS, STATE_REM,
+                                               STATE_UID)
+    from dlnetbench_tpu.serving.sampling import (LANE_ACCEPT,
+                                                 LANE_DRAFT,
+                                                 LANE_RESID,
+                                                 LANE_TOKEN)
 
     def spec_loop(params, k_pages, v_pages, state, ngram_table,
                   block_tables, n_rounds):
@@ -233,18 +291,37 @@ def make_spec_decode_loop(cfg: TransformerConfig,
             last, pos, rem, limits = (st[STATE_LAST], st[STATE_POS],
                                       st[STATE_REM], st[STATE_LIMIT])
             act = rem > 0
+            uids = st[STATE_UID]
+            g0 = st[STATE_GRAMMAR]
+            q_list, q_at_draft = [], []
             # ---- draft k tokens per slot
             if drafter == "ngram":
                 drafts = _draft_ngram(table, last, spec_k)
             else:
                 dkp, dvp = kp, vp
                 prev, dpos, ds = last, pos, []
+                gd = g0
                 for _ in range(spec_k):
                     ok = act & (dpos < limits)
-                    (dkp, dvp), prev = _step_tokens(
-                        cfg, cache_cfg, attn, params, (dkp, dvp),
-                        prev, dpos, ok, block_tables,
-                        layers=drafter_layers)
+                    if sampler is None:
+                        (dkp, dvp), prev = _step_tokens(
+                            cfg, cache_cfg, attn, params, (dkp, dvp),
+                            prev, dpos, ok, block_tables,
+                            layers=drafter_layers)
+                    else:
+                        # SAMPLE the draft from the drafter's own
+                        # filtered distribution q_j (grammar-masked
+                        # through the draft chain's automaton states)
+                        (dkp, dvp), _, dlog = _step_tokens(
+                            cfg, cache_cfg, attn, params, (dkp, dvp),
+                            prev, dpos, ok, block_tables,
+                            layers=drafter_layers, return_logits=True)
+                        qj = sampler.probs(dlog, gd)
+                        u_d = sampler.u01(uids, dpos, LANE_DRAFT)
+                        prev = sampler.draw_from_probs(qj, u_d)
+                        q_list.append(qj)
+                        q_at_draft.append(qj[rows, prev])
+                        gd = sampler.advance(gd, prev)
                     ds.append(prev)
                     dpos = dpos + 1
                 kp, vp = dkp, dvp
@@ -253,32 +330,88 @@ def make_spec_decode_loop(cfg: TransformerConfig,
             fed = jnp.concatenate([last[:, None], drafts], axis=1)
             pos2 = pos[:, None] + jnp.arange(k1, dtype=jnp.int32)
             write_ok = act[:, None] & (pos2 < limits[:, None])
-            kp, vp, tgt = _verify_tokens(cfg, cache_cfg, params, kp,
-                                         vp, fed, pos, write_ok,
-                                         block_tables)
-            # ---- greedy accept: longest prefix where draft == target
-            match = (drafts == tgt[:, :spec_k]).astype(jnp.int32)
-            acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)   # [B]
-            emit = jnp.where(act, jnp.minimum(acc + 1, rem), 0)
-            # ---- append emitted target tokens at each slot's count
+            if sampler is None:
+                kp, vp, tgt = _verify_tokens(cfg, cache_cfg, params,
+                                             kp, vp, fed, pos,
+                                             write_ok, block_tables)
+                # greedy accept: longest prefix where draft == target
+                match = (drafts == tgt[:, :spec_k]).astype(jnp.int32)
+                acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                emit = jnp.where(act, jnp.minimum(acc + 1, rem), 0)
+                etoks = tgt
+            else:
+                kp, vp, tgt, vlogits = _verify_tokens(
+                    cfg, cache_cfg, params, kp, vp, fed, pos,
+                    write_ok, block_tables, return_logits=True)
+                # grammar state BEFORE emitting at index j = start
+                # state advanced through drafts[:j]
+                gs = [g0]
+                for j in range(spec_k):
+                    gs.append(sampler.advance(gs[j], drafts[:, j]))
+                p_js = [sampler.probs(vlogits[:, j], gs[j])
+                        for j in range(k1)]
+                # rejection-sampling accept: u·q(d) < p(d), strict —
+                # an out-of-grammar draft has p(d) == 0 and can never
+                # pass, whatever u
+                p_at_draft = jnp.stack(
+                    [p_js[j][rows, drafts[:, j]]
+                     for j in range(spec_k)], axis=1)
+                q_d = jnp.stack(q_at_draft, axis=1)
+                u_acc = jnp.stack(
+                    [sampler.u01(uids, pos + j, LANE_ACCEPT)
+                     for j in range(spec_k)], axis=1)
+                accept = (u_acc * q_d < p_at_draft).astype(jnp.int32)
+                acc = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
+                emit = jnp.where(act, jnp.minimum(acc + 1, rem), 0)
+                # emitted token at index j: the draft while j < acc,
+                # the residual resample at the first reject, the bonus
+                # draw from p_k after full acceptance
+                cols = []
+                for j in range(k1):
+                    if j < spec_k:
+                        resid = jnp.maximum(p_js[j] - q_list[j], 0.0)
+                        z = jnp.sum(resid, axis=-1, keepdims=True)
+                        rdist = jnp.where(
+                            z > 0, resid / jnp.maximum(z, 1e-30),
+                            p_js[j])
+                        u_r = sampler.u01(uids, pos + j, LANE_RESID)
+                        r_j = sampler.draw_from_probs(rdist, u_r)
+                        cols.append(jnp.where(j < acc, drafts[:, j],
+                                              r_j))
+                    else:
+                        u_b = sampler.u01(uids, pos + spec_k,
+                                          LANE_TOKEN)
+                        cols.append(sampler.draw_from_probs(
+                            p_js[spec_k], u_b))
+                etoks = jnp.stack(cols, axis=1)
+            # ---- append emitted tokens at each slot's count
             for j in range(k1):
                 w = act & (j < emit)
                 idx = jnp.where(w, cnt + j, cap)
-                out = out.at[rows, idx].set(tgt[:, j], mode="drop")
+                out = out.at[rows, idx].set(etoks[:, j], mode="drop")
             # ---- ngram table learns every emitted (prev -> next) pair
             if drafter == "ngram":
                 prevs = jnp.concatenate([last[:, None],
-                                         tgt[:, :spec_k]], axis=1)
+                                         etoks[:, :spec_k]], axis=1)
                 vocab = table.shape[1]
                 for j in range(k1):
                     w = act & (j < emit)
                     row = jnp.where(w, prevs[:, j], vocab)
-                    table = table.at[rows, row].set(tgt[:, j],
+                    table = table.at[rows, row].set(etoks[:, j],
                                                     mode="drop")
             st = st.at[STATE_LAST].set(jnp.where(
-                act, tgt[rows, jnp.maximum(emit - 1, 0)], last))
+                act, etoks[rows, jnp.maximum(emit - 1, 0)], last))
             st = st.at[STATE_POS].set(pos + emit)
             st = st.at[STATE_REM].set(rem - emit)
+            if sampler is not None and sampler.trans_dev is not None:
+                # grammar state advances along the EMITTED tokens only
+                g_new = g0
+                for j in range(k1):
+                    g_new = jnp.where(j < emit,
+                                      sampler.advance(g_new,
+                                                      etoks[:, j]),
+                                      g_new)
+                st = st.at[STATE_GRAMMAR].set(g_new)
             cnt = cnt + emit
             drafted = drafted + jnp.sum(jnp.where(act, spec_k, 0))
             accepted = accepted + jnp.sum(jnp.where(act, acc, 0))

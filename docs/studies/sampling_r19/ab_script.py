@@ -1,0 +1,276 @@
+"""Generate the ISSUE 19 sampling study artifact: seeded on-device
+sampling + lossless speculative sampling + grammar-constrained decode
+on this machine, committed as ``sampling_ab.json``.
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python docs/studies/sampling_r19/ab_script.py
+
+Fails (non-zero exit) unless EVERY acceptance bar holds at generation
+time:
+
+1. bit-identity — the fused N-step sampled engine emits EXACTLY the
+   classic 1-step engine's token streams (draws keyed by
+   (sample_seed, uid, position) make N a pure perf knob), with and
+   without the grammar constraint;
+2. distribution equality — chi-square of the on-device sampler's
+   draws against the filtered target distribution passes, AND the
+   rejection-sampling verify rule (draft from q, accept with prob
+   min(1, p/q), residual resample) emits tokens chi-square
+   indistinguishable from p for a drafter q it visibly disagrees
+   with (the LOSSLESS claim);
+3. throughput — speculative sampling's tokens/s band sits DISJOINTLY
+   ABOVE the non-speculative sampling baseline (the classic 1-step
+   sampled engine, the same baseline the r14 decode study judged
+   against) at T=0.8 on the same seeded saturating plan;
+4. grammar grid — every token stream on every grid point (classic,
+   fused, fused+speculative, classic+prefix-sharing) validates
+   against the JSON grammar;
+5. acceptance curve — the spec acceptance-vs-temperature sweep lands
+   >= 3 points with rates in [0, 1] in the artifact.
+
+Protocol mirrors docs/studies/decode_loop_r14: interleaved rounds on
+one warmed process, min/max bands over round medians, comparisons
+against the 1-step baseline.  Sampling runs PURE temperature
+(top_k=0, top_p=1.0) — the ISSUE bar pins T=0.8, and on the CPU mesh
+top-p's ~20 extra XLA sorts per spec round are pure overhead noise.
+"""
+import dataclasses
+import json
+import sys
+from pathlib import Path
+
+OUT = Path(__file__).resolve().parent
+sys.path.insert(0, str(OUT.parents[2]))   # repo root
+
+ROUNDS = 3
+N_FUSED = 16
+
+
+def _build():
+    import jax
+
+    from dlnetbench_tpu.models.transformer import (TransformerConfig,
+                                                   init_params)
+    from dlnetbench_tpu.serving.arrivals import ArrivalPlan
+    from dlnetbench_tpu.serving.scheduler import ServingConfig
+
+    mc = TransformerConfig(
+        vocab_size=256, embed_dim=64, num_heads=4, num_kv_heads=2,
+        ff_dim=128, num_layers=2, seq_len=64, gated=True,
+        max_positions=0, dtype="float32")
+    base = ServingConfig(slots=4, page_size=8, num_pages=48,
+                         max_seq_len=40, slo_ttft_ms=250.0,
+                         slo_tpot_ms=100.0, attn_impl="gather",
+                         temperature=0.8, sample_seed=7)
+    plan = ArrivalPlan(kind="poisson", rate_rps=5000.0,
+                       num_requests=8, seed=0, prompt_len=[8, 16],
+                       output_len=[16, 24])
+    params = init_params(jax.random.key(0), mc)
+    return mc, base, plan, params
+
+
+def _chi_locks() -> dict:
+    """Bar 2: the two DeviceSampler-level chi-square parity locks
+    (same math as tests/test_sampling.py, reported with numbers)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlnetbench_tpu.serving import sampling as SMP
+
+    out = {}
+    n, vocab = 4096, 16
+    rng = np.random.RandomState(1)
+    cfg = SMP.check_sampling_config(temperature=0.8, top_k=0,
+                                    top_p=0.9, sample_seed=5,
+                                    grammar="")
+    s = SMP.DeviceSampler(cfg, vocab)
+    row = rng.randn(vocab).astype(np.float32)
+    toks = np.asarray(s.draw_tokens(
+        jnp.asarray(np.tile(row, (n, 1))),
+        jnp.asarray(np.arange(n, dtype=np.int32)),
+        jnp.full((n,), 9, jnp.int32)))
+    p = np.asarray(s.probs(jnp.asarray(row[None])))[0]
+    stat, df = SMP.chi_square(np.bincount(toks, minlength=vocab), p)
+    crit = SMP.chi_square_critical(df)
+    out["plain_draws"] = {"stat": round(stat, 3), "df": df,
+                          "critical_p001": round(crit, 3),
+                          "pass": stat < crit}
+
+    rng = np.random.RandomState(2)
+    cfg = SMP.check_sampling_config(temperature=0.8, top_k=0,
+                                    top_p=1.0, sample_seed=5,
+                                    grammar="")
+    s = SMP.DeviceSampler(cfg, vocab)
+    tlog = rng.randn(vocab).astype(np.float32)
+    dlog = rng.randn(vocab).astype(np.float32)
+    p = s.probs(jnp.asarray(np.tile(tlog, (n, 1))))
+    q = s.probs(jnp.asarray(np.tile(dlog, (n, 1))))
+    uids = jnp.asarray(np.arange(n, dtype=np.int32))
+    pos = jnp.full((n,), 7, jnp.int32)
+    rows = jnp.arange(n)
+    d = s.draw_from_probs(q, s.u01(uids, pos, SMP.LANE_DRAFT))
+    accept = (s.u01(uids, pos, SMP.LANE_ACCEPT) * q[rows, d]
+              < p[rows, d])
+    resid = jnp.maximum(p - q, 0.0)
+    z = jnp.sum(resid, axis=-1, keepdims=True)
+    rdist = jnp.where(z > 0, resid / jnp.maximum(z, 1e-30), p)
+    r = s.draw_from_probs(rdist, s.u01(uids, pos, SMP.LANE_RESID))
+    emitted = np.asarray(jnp.where(accept, d, r))
+    counts = np.bincount(emitted, minlength=vocab)
+    stat, df = SMP.chi_square(counts, np.asarray(p)[0])
+    crit = SMP.chi_square_critical(df)
+    stat_q, df_q = SMP.chi_square(counts, np.asarray(q)[0])
+    out["rejection_verify_vs_target"] = {
+        "stat": round(stat, 3), "df": df,
+        "critical_p001": round(crit, 3), "pass": stat < crit,
+        "draft_accept_rate": round(float(np.mean(accept)), 4),
+        # the lock has teeth: the same counts REJECT the drafter dist
+        "drafter_dist_stat": round(stat_q, 3),
+        "drafter_dist_rejected":
+            stat_q > SMP.chi_square_critical(df_q)}
+    return out
+
+
+def main() -> int:
+    import numpy as np
+
+    from dlnetbench_tpu.metrics import stats as stats_mod
+    from dlnetbench_tpu.serving import metrics as smetrics
+    from dlnetbench_tpu.serving import sampling as SMP
+    from dlnetbench_tpu.serving.scheduler import Engine
+
+    mc, base, plan, params = _build()
+    requests = plan.sample()
+    spec_kw = dict(speculative=True, spec_k=4, drafter="truncated",
+                   drafter_layers=1)
+    arms = {
+        "one_step": base,                               # the baseline
+        "fused": dataclasses.replace(base, multi_step_n=N_FUSED),
+        "spec": dataclasses.replace(base, multi_step_n=N_FUSED,
+                                    **spec_kw),
+    }
+    engines = {k: Engine(mc, v, params=params) for k, v in arms.items()}
+    streams = {}
+    for name, eng in engines.items():
+        eng.run(requests)                      # warm round, discarded
+        streams[name] = dict(eng.token_streams)
+
+    # bar 1: bit-identity (plain, then under the grammar constraint)
+    identity = streams["one_step"] == streams["fused"]
+    gstreams = {}
+    for n_steps in (1, N_FUSED):
+        eng = Engine(mc, dataclasses.replace(base, grammar="json",
+                                             multi_step_n=n_steps),
+                     params=params)
+        eng.run(requests)
+        gstreams[n_steps] = dict(eng.token_streams)
+    identity_grammar = gstreams[1] == gstreams[N_FUSED]
+
+    # bar 3: interleaved timed rounds, bands over round values
+    rounds = {name: [] for name in engines}
+    for _ in range(ROUNDS):
+        for name, eng in engines.items():
+            completed, wall = eng.run(requests)
+            rounds[name].append(smetrics.serving_block(
+                completed, plan, slo_ttft_ms=base.slo_ttft_ms,
+                slo_tpot_ms=base.slo_tpot_ms, wall_s=wall,
+                engine_steps=eng.engine_steps,
+                cache_stats=eng.cache.stats(),
+                queue_depth_max=eng.queue_depth_max,
+                batch_occupancy_mean=eng.batch_occupancy_mean(),
+                decode_loop=eng.decode_loop_block()))
+    bands = {name: stats_mod.summarize(
+        [r["tokens_per_s"] for r in rs], ndigits=2)
+        for name, rs in rounds.items()}
+    spec_b, base_b = bands["spec"], bands["one_step"]
+    disjoint = (stats_mod.bands_overlap(spec_b["band"], base_b["band"])
+                is False and spec_b["value"] > base_b["value"])
+    acc = stats_mod.summarize(
+        [((r.get("decode_loop") or {}).get("spec") or {})
+         .get("acceptance_rate", 0.0) for r in rounds["spec"]],
+        ndigits=4)
+
+    # bar 2: the chi-square parity locks
+    chi = _chi_locks()
+    chi_ok = (chi["plain_draws"]["pass"]
+              and chi["rejection_verify_vs_target"]["pass"]
+              and chi["rejection_verify_vs_target"]
+                     ["drafter_dist_rejected"])
+
+    # bar 4: the grammar grid — every stream on every point validates
+    g = SMP.compile_grammar("json", mc.vocab_size)
+    grid = {
+        "classic": dict(multi_step_n=1),
+        "fused": dict(multi_step_n=N_FUSED),
+        "fused_spec": dict(multi_step_n=N_FUSED, **spec_kw),
+        "classic_prefix_sharing": dict(multi_step_n=1,
+                                       prefix_sharing=True),
+    }
+    grammar_grid = {}
+    grammar_ok = True
+    for name, kw in grid.items():
+        eng = Engine(mc, dataclasses.replace(base, grammar="json",
+                                             **kw), params=params)
+        completed, _ = eng.run(requests)
+        valid = all(SMP.validate_stream(g, toks)
+                    for toks in eng.token_streams.values())
+        grammar_grid[name] = {"completed": len(completed),
+                              "all_streams_valid": valid}
+        grammar_ok = grammar_ok and valid and (len(completed)
+                                               == len(requests))
+
+    # bar 5: acceptance vs temperature (speculative engines swept)
+    curve = []
+    for temp in (0.3, 0.8, 1.5):
+        eng = Engine(mc, dataclasses.replace(
+            base, temperature=temp, multi_step_n=N_FUSED, **spec_kw),
+            params=params)
+        eng.run(requests)
+        dl = (eng.decode_loop_block() or {}).get("spec") or {}
+        curve.append({"temperature": temp,
+                      "acceptance_rate": round(
+                          float(dl.get("acceptance_rate", 0.0)), 4)})
+    curve_ok = (len(curve) >= 3
+                and all(0.0 <= pt["acceptance_rate"] <= 1.0
+                        for pt in curve))
+
+    bars = {
+        "bit_identity_1step_vs_fused": bool(identity),
+        "bit_identity_under_grammar": bool(identity_grammar),
+        "chi_square_distribution_equality": bool(chi_ok),
+        "spec_tokens_per_s_band_disjoint_above_nonspec":
+            bool(disjoint),
+        "grammar_grid_all_valid": bool(grammar_ok),
+        "acceptance_curve_present": bool(curve_ok),
+    }
+    artifact = {
+        "study": "sampling_r19",
+        "config": {"model": "d64_l2_h4kv2_v256", "slots": base.slots,
+                   "multi_step_n": N_FUSED, "spec_k": 4,
+                   "drafter": "truncated", "temperature": 0.8,
+                   "top_k": 0, "top_p": 1.0,
+                   "sample_seed": base.sample_seed,
+                   "requests": plan.num_requests, "rounds": ROUNDS},
+        "tokens_per_s": bands,
+        "spec_acceptance_rate": acc,
+        "chi_square": chi,
+        "grammar_grid": grammar_grid,
+        "spec_acceptance_by_temp": curve,
+        "bars": bars,
+    }
+    (OUT / "sampling_ab.json").write_text(
+        json.dumps(artifact, indent=1) + "\n")
+    print(json.dumps(bars, indent=1))
+    print(f"tokens/s one_step={base_b['value']} band={base_b['band']} "
+          f"fused={bands['fused']['value']} "
+          f"spec={spec_b['value']} band={spec_b['band']} "
+          f"acc={acc['value']}")
+    if not all(bars.values()):
+        print("ACCEPTANCE EVIDENCE MISSING", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -843,3 +843,51 @@ def test_moe_ab_line_schema_locked():
     # sentinel comparability: --check picks it up as "moe_ab"
     from dlnetbench_tpu.sentinel import is_ms_line
     assert is_ms_line(line)
+
+
+def test_sampling_ab_line_schema_locked():
+    """bench.py's sampling_ab aux line (ISSUE 19): the headline
+    ``value`` is the SPECULATIVE-sampled arm's round-median e2e p99 in
+    ms (sentinel-comparable; the bench headline stays greedy), both
+    arms ship {value, best, band, n} bands for e2e p99 / TPOT p50 /
+    tokens/s, the spec arm adds its measured acceptance-rate band, the
+    verdict is the band-disjoint tokens/s gain, and token_identity
+    locks the classic-vs-fused sampled bit-identity."""
+    import bench
+
+    def _round(p99, tps, *, acc=None):
+        r = {"e2e_ms": {"p99": p99}, "tpot_ms": {"p50": 1.0},
+             "tokens_per_s": tps}
+        if acc is not None:
+            r["decode_loop"] = {"spec": {"acceptance_rate": acc}}
+        return r
+
+    sampled = [_round(50.0, 100.0), _round(52.0, 95.0),
+               _round(51.0, 98.0)]
+    spec = [_round(30.0, 150.0, acc=0.5), _round(32.0, 145.0, acc=0.55),
+            _round(31.0, 148.0, acc=0.5)]
+    line = bench._sampling_ab_line(sampled, spec, suffix=", test",
+                                   token_identity=True)
+    assert line["unit"] == "ms"
+    assert line["value"] == 31.0 and line["n"] == 3
+    assert line["band"] == [30.0, 32.0] and line["best"] == 30.0
+    for arm in ("sampled", "spec_sampled"):
+        for key in ("e2e_p99_ms", "tpot_p50_ms", "tokens_per_s"):
+            sub = line[arm][key]
+            for k in ("value", "best", "band", "n"):
+                assert k in sub, (arm, key, k)
+    acc = line["spec_sampled"]["acceptance_rate"]
+    assert acc["value"] == 0.5 and acc["n"] == 3
+    # tokens/s bands [95, 100] vs [145, 150]: disjoint AND higher —
+    # the ISSUE-19 speculation-under-sampling verdict
+    assert line["tokens_per_s_band_disjoint_gain"] is True
+    assert line["token_identity"] is True
+    # overlapping bands must NOT claim the win
+    flat = bench._sampling_ab_line(sampled, [
+        _round(50.0, 99.0, acc=0.2), _round(51.0, 101.0, acc=0.2),
+        _round(50.5, 100.0, acc=0.2)])
+    assert flat["tokens_per_s_band_disjoint_gain"] is False
+    assert "token_identity" not in flat
+    # sentinel comparability: an ms line, auto-compared by --check
+    from dlnetbench_tpu.sentinel import is_ms_line
+    assert is_ms_line(line)

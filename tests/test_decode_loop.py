@@ -270,10 +270,14 @@ def test_device_state_roundtrip_property():
             kw = dict(last_token=rng.uniform_int(0, vocab - 1),
                       position=rng.uniform_int(0, 10),
                       remaining=rng.uniform_int(1, 6),
-                      seq_limit=16)
+                      seq_limit=16,
+                      # ISSUE 19 rows (negative uid = a warm rid)
+                      uid=rng.uniform_int(0, 20) - 5,
+                      grammar_state=rng.uniform_int(0, 9))
             ds.admit(s, block_row=row, ngram_row=tab_row, **kw)
             ref["state"][:, s] = [kw["last_token"], kw["position"],
-                                  kw["remaining"], kw["seq_limit"]]
+                                  kw["remaining"], kw["seq_limit"],
+                                  kw["uid"], kw["grammar_state"]]
             ref["bt"][s] = row
             ref["tab"][s] = tab_row
         elif op == 1:                     # evict a slot
@@ -296,6 +300,10 @@ def test_device_state_roundtrip_property():
                                   ref["state"][D.STATE_POS])
     np.testing.assert_array_equal(view["remaining"],
                                   ref["state"][D.STATE_REM])
+    np.testing.assert_array_equal(view["uids"],
+                                  ref["state"][D.STATE_UID])
+    np.testing.assert_array_equal(view["grammar_states"],
+                                  ref["state"][D.STATE_GRAMMAR])
     np.testing.assert_array_equal(view["block_tables"], ref["bt"])
     np.testing.assert_array_equal(view["ngram_table"], ref["tab"])
     # every crossing was priced
@@ -414,12 +422,18 @@ def test_spec_config_validation():
         tiny_serving(speculative=True, spec_k=0).validate()
     with pytest.raises(ValueError, match="drafter"):
         tiny_serving(speculative=True, drafter="oracle").validate()
-    with pytest.raises(ValueError, match="greedy"):
-        tiny_serving(sampling="top_p").validate()
-    # speculative + non-greedy is the LOUD refusal (until sampling-
-    # aware acceptance lands)
-    with pytest.raises(ValueError, match="speculative.*GREEDY|GREEDY"):
-        tiny_serving(speculative=True, sampling="top_p").validate()
+    # sampling knobs validate through check_sampling_config (ISSUE 19)
+    with pytest.raises(ValueError, match="temperature"):
+        tiny_serving(top_p=0.9).validate()
+    with pytest.raises(ValueError, match="top_p"):
+        tiny_serving(temperature=0.8, top_p=1.5).validate()
+    # speculative sampling needs a drafter DISTRIBUTION: the ngram
+    # drafter emits argmax tokens only, so rejection sampling has no
+    # q(t) to accept against — the old "spec requires greedy" refusal
+    # is gone, replaced by this per-drafter guard
+    with pytest.raises(ValueError, match="drafter probs"):
+        tiny_serving(speculative=True, temperature=0.8,
+                     drafter="ngram").validate()
     # a full-depth truncated drafter is refused at build (it IS the
     # target: no draft speedup, double cost)
     cfg = tiny_model()

@@ -544,3 +544,35 @@ def test_fleet_ab_line_is_comparable():
     # a baseline predating the line compares clean (new line ignored)
     old = sentinel.check({"headline": _line(10.0, [9.9, 10.1])}, cur)
     assert old["verdict"] == "clean"
+
+
+def test_sampling_ab_line_is_comparable():
+    """The sampling_ab aux line (ISSUE 19) rides the headline like
+    every ms line: the sentinel compares it by the speculative-sampled
+    arm's e2e p99, band-aware lower-is-better, and the nested per-arm
+    bands never confuse the comparison."""
+    def sampling_line(value, band):
+        return {"metric": "sampling_ab: seeded sampling T=0.8 — fused "
+                          "decode vs lossless speculative sampling",
+                "value": value, "unit": "ms", "best": band[0],
+                "band": band, "n": 3,
+                "sampled": {"tokens_per_s": {
+                    "value": value * 2.0, "best": band[0] * 2.0,
+                    "band": [b * 2.0 for b in band], "n": 3}},
+                "tokens_per_s_band_disjoint_gain": True}
+
+    assert sentinel.is_ms_line(sampling_line(5.0, [4.5, 5.5]))
+    base = {"headline": _line(10.0, [9.9, 10.1]),
+            "sampling_ab": sampling_line(5.0, [4.5, 5.5])}
+    cur = {"headline": _line(10.0, [9.9, 10.1]),
+           "sampling_ab": sampling_line(10.0, [9.5, 10.5])}
+    sent = sentinel.check(base, cur)
+    assert sent["verdict"] == "regression"
+    assert sent["regressions"] == ["sampling_ab"]
+    ok = sentinel.check(base, {
+        "headline": _line(10.0, [9.9, 10.1]),
+        "sampling_ab": sampling_line(5.2, [4.6, 5.6])})
+    assert ok["verdict"] == "clean"
+    # a baseline predating the line compares clean (new line ignored)
+    old = sentinel.check({"headline": _line(10.0, [9.9, 10.1])}, cur)
+    assert old["verdict"] == "clean"
